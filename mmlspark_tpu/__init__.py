@@ -1,0 +1,36 @@
+"""mmlspark_tpu — a TPU-native ML-pipeline framework.
+
+A brand-new framework with the capabilities of MMLSpark (Microsoft ML for
+Apache Spark, reference at /root/reference): composable columnar ML pipelines —
+image/binary ingestion, image transforms, automatic featurization of
+mixed-type tabular data, text featurization, one-call classifier/regressor
+training, metadata-driven evaluation and model selection, a pretrained model
+zoo, and deep-learning transformers for batched inference and distributed
+training — designed TPU-first on JAX/XLA/Pallas/pjit rather than ported.
+
+Where the reference runs CNTK via JNI inside Spark executors and shells out to
+``mpiexec cntk`` for MPI training (reference: cntk-model/src/main/scala/
+CNTKModel.scala, cntk-train/src/main/scala/CNTKLearner.scala), this framework
+batches columnar partitions into padded device arrays for jit-compiled JAX
+functions and trains in-process with ``shard_map``/``pjit`` using XLA
+collectives over ICI/DCN.
+"""
+
+__version__ = "0.1.0"
+
+from mmlspark_tpu.core.params import Param, Params
+from mmlspark_tpu.core.stage import Transformer, Estimator, PipelineStage
+from mmlspark_tpu.core.pipeline import Pipeline, PipelineModel
+from mmlspark_tpu.data.table import DataTable
+
+__all__ = [
+    "Param",
+    "Params",
+    "PipelineStage",
+    "Transformer",
+    "Estimator",
+    "Pipeline",
+    "PipelineModel",
+    "DataTable",
+    "__version__",
+]

@@ -1,0 +1,7 @@
+"""Core runtime: param DSL, stage/pipeline contracts, schema metadata protocol,
+serialization, configuration, and logging.
+
+Analog of the reference's ``src/core/{contracts,schema,serialize,env}``
+(reference: core/contracts/src/main/scala/Params.scala,
+core/schema/src/main/scala/SparkSchema.scala).
+"""

@@ -1,0 +1,51 @@
+"""Framework configuration — env-var-overridable namespaced settings.
+
+Analog of the reference's ``MMLConfig`` typesafe-config wrapper
+(reference: core/env/src/main/scala/Configuration.scala:18-51). Settings
+resolve in order: explicit ``set()`` > environment variable
+``MMLSPARK_TPU_<NAME>`` > default.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable
+
+
+_DEFAULTS: dict[str, Any] = {
+    "cache_dir": os.path.join(
+        os.path.expanduser("~"), ".cache", "mmlspark_tpu"),
+    "datasets_dir": os.path.join(
+        os.path.expanduser("~"), ".cache", "mmlspark_tpu", "datasets"),
+    "model_repo_url": "",          # remote zoo endpoint ("" = local only)
+    "default_minibatch_size": 64,
+    "log_level": "INFO",
+    "timings": True,               # per-stage timing logs (Timer analog)
+}
+
+_overrides: dict[str, Any] = {}
+
+
+def get(name: str, default: Any = None) -> Any:
+    if name in _overrides:
+        return _overrides[name]
+    env = os.environ.get(f"MMLSPARK_TPU_{name.upper()}")
+    if env is not None:
+        base = _DEFAULTS.get(name, default)
+        if isinstance(base, bool):
+            return env.lower() in ("1", "true", "yes")
+        if isinstance(base, int):
+            return int(env)
+        return env
+    return _DEFAULTS.get(name, default)
+
+
+def set(name: str, value: Any) -> None:  # noqa: A001 - config namespace
+    _overrides[name] = value
+
+
+def reset(name: str | None = None) -> None:
+    if name is None:
+        _overrides.clear()
+    else:
+        _overrides.pop(name, None)

@@ -1,0 +1,45 @@
+"""Logging + timing utilities.
+
+Analog of the reference's ``Logging`` (core/env/src/main/scala/Logging.scala:14-23)
+and the ``Timer`` wrapper stage's measurement core
+(pipeline-stages/src/main/scala/Timer.scala:54-123). The pipeline-visible
+``TimerStage`` lives in ``mmlspark_tpu.stages.misc``; this module provides
+the timing primitive and the logger factory.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from contextlib import contextmanager
+from typing import Iterator
+
+from mmlspark_tpu.core import config
+
+
+def get_logger(name: str = "mmlspark_tpu") -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(logging.Formatter(
+            "%(asctime)s %(name)s %(levelname)s: %(message)s"))
+        logger.addHandler(handler)
+        logger.setLevel(config.get("log_level"))
+        logger.propagate = False
+    return logger
+
+
+@contextmanager
+def timed(label: str, logger: logging.Logger | None = None,
+          rows: int | None = None) -> Iterator[dict]:
+    """Context manager measuring wall time; yields a dict that receives
+    ``elapsed_s`` on exit. Logs when the ``timings`` config flag is on."""
+    record: dict = {"label": label}
+    t0 = time.perf_counter()
+    try:
+        yield record
+    finally:
+        record["elapsed_s"] = time.perf_counter() - t0
+        if config.get("timings") and logger is not None:
+            extra = f" ({rows} rows)" if rows is not None else ""
+            logger.info("%s took %.3fs%s", label, record["elapsed_s"], extra)

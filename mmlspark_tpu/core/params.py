@@ -1,0 +1,234 @@
+"""Typed parameter DSL for pipeline stages.
+
+Every knob on every stage is a :class:`Param` descriptor with a default, a
+doc string, an optional domain/validator, and an optional type. Params are
+introspectable at the class level, which powers the auto-generated API docs,
+the stage registry, the fuzzing suite, and JSON persistence — the analog of
+the reference's ``MMLParams``/``Wrappable`` DSL whose introspection powers
+PySpark codegen (reference: core/contracts/src/main/scala/Params.scala:10-110,
+codegen/src/main/scala/PySparkWrapperGenerator.scala:34-81).
+
+Unlike the reference there is no JVM/py4j boundary, so "codegen" degenerates
+to doc/stub generation; the single source of truth is the descriptor.
+"""
+
+from __future__ import annotations
+
+import copy as _copy
+from typing import Any, Callable, Iterable, Mapping, Sequence
+
+
+class ParamValidationError(ValueError):
+    """Raised when a param value fails its domain/validator check."""
+
+
+class Param:
+    """A typed, validated, documented parameter declared on a stage class.
+
+    Use class-level declaration::
+
+        class MyStage(Transformer):
+            input_col = Param(default="input", doc="name of the input column")
+            n = Param(default=8, doc="batch size", type_=int,
+                      validator=Param.gt(0))
+    """
+
+    __slots__ = ("name", "default", "doc", "type_", "validator", "is_complex",
+                 "owner")
+
+    # sentinel: a param with no default that must be set before use
+    REQUIRED = object()
+
+    def __init__(
+        self,
+        default: Any = None,
+        doc: str = "",
+        type_: type | tuple[type, ...] | None = None,
+        validator: Callable[[Any], bool] | None = None,
+        is_complex: bool = False,
+    ):
+        self.name: str | None = None  # filled by __set_name__
+        self.default = default
+        self.doc = doc
+        self.type_ = type_
+        self.validator = validator
+        # complex params hold values not representable as JSON (models,
+        # pytrees, nested stages); they are persisted by the serializer
+        # registry instead (analog of ComplexParam,
+        # reference: core/serialize/src/main/scala/ComplexParam.scala:10-31)
+        self.is_complex = is_complex
+        self.owner: type | None = None
+
+    def __set_name__(self, owner: type, name: str) -> None:
+        self.name = name
+        self.owner = owner
+
+    def __get__(self, obj: Any, objtype: type | None = None) -> Any:
+        if obj is None:
+            return self
+        return obj.get(self.name)
+
+    def __set__(self, obj: Any, value: Any) -> None:
+        obj.set(**{self.name: value})
+
+    def validate(self, value: Any) -> Any:
+        """Validate (and lightly coerce) a candidate value; return it."""
+        if value is None or value is Param.REQUIRED:
+            return value
+        if self.type_ is not None:
+            # int is acceptable where float is declared
+            if self.type_ is float and isinstance(value, int) and not isinstance(value, bool):
+                value = float(value)
+            if self.type_ is int and isinstance(value, bool):
+                raise ParamValidationError(
+                    f"param {self.name!r}: got bool where int expected")
+            if not isinstance(value, self.type_):
+                raise ParamValidationError(
+                    f"param {self.name!r}: expected {self.type_}, "
+                    f"got {type(value).__name__} ({value!r})")
+        if self.validator is not None and not self.validator(value):
+            raise ParamValidationError(
+                f"param {self.name!r}: value {value!r} outside domain "
+                f"({getattr(self.validator, '_doc', 'validator failed')})")
+        return value
+
+    def __repr__(self) -> str:
+        return (f"Param({self.name!r}, default={self.default!r}, "
+                f"doc={self.doc!r})")
+
+    # ---- domain combinators (analog of ParamDomain factories,
+    # reference: core/contracts/src/main/scala/Params.scala:38-108) ----
+
+    @staticmethod
+    def _mk(fn: Callable[[Any], bool], doc: str) -> Callable[[Any], bool]:
+        fn._doc = doc  # type: ignore[attr-defined]
+        return fn
+
+    @staticmethod
+    def gt(lo: float) -> Callable[[Any], bool]:
+        return Param._mk(lambda v: v > lo, f"> {lo}")
+
+    @staticmethod
+    def ge(lo: float) -> Callable[[Any], bool]:
+        return Param._mk(lambda v: v >= lo, f">= {lo}")
+
+    @staticmethod
+    def lt(hi: float) -> Callable[[Any], bool]:
+        return Param._mk(lambda v: v < hi, f"< {hi}")
+
+    @staticmethod
+    def le(hi: float) -> Callable[[Any], bool]:
+        return Param._mk(lambda v: v <= hi, f"<= {hi}")
+
+    @staticmethod
+    def in_range(lo: float, hi: float) -> Callable[[Any], bool]:
+        return Param._mk(lambda v: lo <= v <= hi, f"in [{lo}, {hi}]")
+
+    @staticmethod
+    def one_of(*choices: Any) -> Callable[[Any], bool]:
+        cs = set(choices)
+        return Param._mk(lambda v: v in cs, f"one of {sorted(map(str, cs))}")
+
+    @staticmethod
+    def nonempty() -> Callable[[Any], bool]:
+        return Param._mk(lambda v: len(v) > 0, "non-empty")
+
+
+class Params:
+    """Base class giving a stage its param store and introspection surface.
+
+    Values live in ``self._values``; unset params fall back to the class-level
+    default. ``params()`` exposes the full descriptor map in declaration
+    order (MRO-aware) for docs/fuzzing/persistence.
+    """
+
+    def __init__(self, **kwargs: Any):
+        self._values: dict[str, Any] = {}
+        self.set(**kwargs)
+
+    # -- introspection --
+
+    @classmethod
+    def params(cls) -> dict[str, Param]:
+        # per-class cache; params are declared statically so no invalidation
+        cached = cls.__dict__.get("_params_cache")
+        if cached is not None:
+            return cached
+        out: dict[str, Param] = {}
+        for klass in reversed(cls.__mro__):
+            for k, v in vars(klass).items():
+                if isinstance(v, Param):
+                    out[k] = v
+        cls._params_cache = out
+        return out
+
+    @classmethod
+    def param(cls, name: str) -> Param:
+        p = cls.params().get(name)
+        if p is None:
+            raise KeyError(f"{cls.__name__} has no param {name!r}")
+        return p
+
+    # -- get/set --
+
+    def get(self, name: str) -> Any:
+        p = type(self).param(name)
+        if name in self._values:
+            return self._values[name]
+        if p.default is Param.REQUIRED:
+            raise ParamValidationError(
+                f"required param {name!r} of {type(self).__name__} not set")
+        return p.default
+
+    def is_set(self, name: str) -> bool:
+        return name in self._values
+
+    def set(self, **kwargs: Any) -> "Params":
+        """Set params by keyword; validates each. Returns self (chainable)."""
+        declared = type(self).params()
+        for name, value in kwargs.items():
+            p = declared.get(name)
+            if p is None:
+                raise KeyError(
+                    f"{type(self).__name__} has no param {name!r}; "
+                    f"available: {sorted(declared)}")
+            self._values[name] = p.validate(value)
+        return self
+
+    def get_all(self, include_defaults: bool = True) -> dict[str, Any]:
+        """Current param map (explicitly-set values over defaults)."""
+        out = {}
+        for name, p in type(self).params().items():
+            if name in self._values:
+                out[name] = self._values[name]
+            elif include_defaults and p.default is not Param.REQUIRED:
+                out[name] = p.default
+        return out
+
+    def explain_params(self) -> str:
+        """Human-readable param documentation (doc-gen building block)."""
+        lines = []
+        for name, p in type(self).params().items():
+            cur = self._values.get(name, p.default)
+            dom = getattr(p.validator, "_doc", None)
+            extra = f", domain: {dom}" if dom else ""
+            lines.append(f"{name}: {p.doc} (default: {p.default!r}{extra}, "
+                         f"current: {cur!r})")
+        return "\n".join(lines)
+
+    def copy(self, **overrides: Any) -> "Params":
+        """Deep copy of this stage with optional param overrides."""
+        other = _copy.deepcopy(self)
+        other.set(**overrides)
+        return other
+
+    def _simple_param_values(self) -> dict[str, Any]:
+        """Explicitly-set, JSON-representable params (for persistence)."""
+        declared = type(self).params()
+        return {k: v for k, v in self._values.items()
+                if not declared[k].is_complex}
+
+    def _complex_param_values(self) -> dict[str, Any]:
+        declared = type(self).params()
+        return {k: v for k, v in self._values.items()
+                if declared[k].is_complex}

@@ -1,0 +1,64 @@
+"""Pipeline / PipelineModel — ordered stage composition with persistence.
+
+The analog of SparkML's ``Pipeline`` as the reference uses it everywhere
+(e.g. featurize/src/main/scala/Featurize.scala:82-98 returns a fitted
+Pipeline). ``fit`` walks the stages: estimators are fitted on the running
+table and replaced by their models; transformers pass through.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+from mmlspark_tpu.core.params import Param
+from mmlspark_tpu.core.stage import Estimator, PipelineStage, Transformer
+from mmlspark_tpu.data.table import DataTable
+
+
+class Pipeline(Estimator):
+    stages = Param(default=None, doc="ordered list of pipeline stages",
+                   is_complex=True)
+
+    def __init__(self, stages: Sequence[PipelineStage] | None = None,
+                 **kwargs: Any):
+        super().__init__(**kwargs)
+        if stages is not None:
+            self.set(stages=list(stages))
+
+    def fit(self, table: DataTable) -> "PipelineModel":
+        fitted: list[Transformer] = []
+        current = table
+        stages = self.stages or []
+        last_est = max((i for i, s in enumerate(stages)
+                        if isinstance(s, Estimator)), default=-1)
+        for i, stage in enumerate(stages):
+            if isinstance(stage, Estimator):
+                model = stage.fit(current)
+            elif isinstance(stage, Transformer):
+                model = stage
+            else:
+                raise TypeError(
+                    f"stage {i} ({type(stage).__name__}) is neither "
+                    "Transformer nor Estimator")
+            # only transform while a later estimator still needs the table
+            if i < last_est:
+                current = model.transform(current)
+            fitted.append(model)
+        return PipelineModel(stages=fitted)
+
+
+class PipelineModel(Transformer):
+    stages = Param(default=None, doc="ordered list of fitted transformers",
+                   is_complex=True)
+
+    def __init__(self, stages: Sequence[Transformer] | None = None,
+                 **kwargs: Any):
+        super().__init__(**kwargs)
+        if stages is not None:
+            self.set(stages=list(stages))
+
+    def transform(self, table: DataTable) -> DataTable:
+        current = table
+        for stage in self.stages or []:
+            current = stage.transform(current)
+        return current

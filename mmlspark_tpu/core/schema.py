@@ -1,0 +1,157 @@
+"""The schema/metadata protocol linking scorers to evaluators.
+
+The reference encodes two kinds of information in Spark column metadata under
+an ``mml`` tag: (a) score-column *roles* — which column holds scores /
+scored labels / scored probabilities for a given model, and what kind of
+score it is (classification vs regression) — and (b) *categorical levels* for
+indexed columns (reference: core/schema/src/main/scala/SparkSchema.scala:23-227,
+SchemaConstants.scala:7-43, Categoricals.scala:21-90). Evaluators like
+``ComputeModelStatistics`` read these instead of taking column names as
+params.
+
+Here the same contract rides the :class:`~mmlspark_tpu.data.table.DataTable`
+sidecar ``meta`` dict. Helper functions below are the single point of
+truth for key names so scorers and evaluators cannot drift apart.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import numpy as np
+
+from mmlspark_tpu.data.table import DataTable
+
+
+class SchemaConstants:
+    """Metadata keys and well-known column names/kinds.
+
+    Analog of reference SchemaConstants.scala:7-43.
+    """
+
+    MML_TAG = "mml"
+
+    # column purposes
+    SCORES_COLUMN = "scores"
+    SCORED_LABELS_COLUMN = "scored_labels"
+    SCORED_PROBABILITIES_COLUMN = "scored_probabilities"
+    LABEL_COLUMN = "label"
+    FEATURES_COLUMN = "features"
+
+    # score-value kinds
+    CLASSIFICATION_KIND = "Classification"
+    REGRESSION_KIND = "Regression"
+
+    # metadata keys
+    K_COLUMN_PURPOSE = "column_purpose"
+    K_MODEL_UID = "model_uid"
+    K_SCORE_VALUE_KIND = "score_value_kind"
+    K_CATEGORICAL_LEVELS = "categorical_levels"
+    K_IS_CATEGORICAL = "is_categorical"
+    K_IMAGE = "is_image"
+    K_VECTOR_SIZE = "vector_size"
+
+
+def set_score_column(
+    table: DataTable,
+    model_uid: str,
+    column: str,
+    purpose: str,
+    kind: str,
+) -> DataTable:
+    """Stamp a column as a score output of a model (SparkSchema.setScoresColumnName analog)."""
+    return table.with_meta(
+        column,
+        **{SchemaConstants.K_COLUMN_PURPOSE: purpose,
+           SchemaConstants.K_MODEL_UID: model_uid,
+           SchemaConstants.K_SCORE_VALUE_KIND: kind})
+
+
+def set_label_column(table: DataTable, model_uid: str, column: str,
+                     kind: str) -> DataTable:
+    return table.with_meta(
+        column,
+        **{SchemaConstants.K_COLUMN_PURPOSE: SchemaConstants.LABEL_COLUMN,
+           SchemaConstants.K_MODEL_UID: model_uid,
+           SchemaConstants.K_SCORE_VALUE_KIND: kind})
+
+
+def find_score_column(
+    table: DataTable,
+    purpose: str,
+    model_uid: str | None = None,
+) -> str | None:
+    """Locate the column stamped with a given purpose (optionally per model)."""
+    for col in table.columns:
+        m = table.column_meta(col)
+        if m.get(SchemaConstants.K_COLUMN_PURPOSE) != purpose:
+            continue
+        if model_uid is not None and m.get(SchemaConstants.K_MODEL_UID) != model_uid:
+            continue
+        return col
+    return None
+
+
+def get_score_value_kind(table: DataTable, column: str) -> str | None:
+    return table.column_meta(column).get(SchemaConstants.K_SCORE_VALUE_KIND)
+
+
+# ---- categorical levels (Categoricals.scala analog) ----
+
+def set_categorical_levels(
+    table: DataTable, column: str, levels: Sequence[Any]
+) -> DataTable:
+    return table.with_meta(
+        column,
+        **{SchemaConstants.K_IS_CATEGORICAL: True,
+           SchemaConstants.K_CATEGORICAL_LEVELS: list(levels)})
+
+
+def get_categorical_levels(table: DataTable, column: str) -> list[Any] | None:
+    m = table.column_meta(column)
+    if not m.get(SchemaConstants.K_IS_CATEGORICAL):
+        return None
+    return m.get(SchemaConstants.K_CATEGORICAL_LEVELS)
+
+
+def is_categorical(table: DataTable, column: str) -> bool:
+    return bool(table.column_meta(column).get(SchemaConstants.K_IS_CATEGORICAL))
+
+
+# ---- image columns (ImageSchema analog) ----
+
+IMAGE_FIELDS = ("path", "height", "width", "channels", "data")
+"""An image cell is a dict with these keys: decoded HWC uint8 BGR bytes in
+``data`` (reference: core/schema/src/main/scala/ImageSchema.scala:12-17 uses
+(path, height, width, type, bytes))."""
+
+
+def make_image(path: str, array_hwc: np.ndarray) -> dict[str, Any]:
+    a = np.ascontiguousarray(array_hwc, dtype=np.uint8)
+    if a.ndim == 2:
+        a = a[:, :, None]
+    return {"path": path, "height": a.shape[0], "width": a.shape[1],
+            "channels": a.shape[2], "data": a}
+
+
+def is_image_column(table: DataTable, column: str) -> bool:
+    if table.column_meta(column).get(SchemaConstants.K_IMAGE):
+        return True
+    col = table[column]
+    if len(col) and isinstance(col[0], dict):
+        return set(IMAGE_FIELDS).issubset(col[0].keys())
+    return False
+
+
+def mark_image_column(table: DataTable, column: str) -> DataTable:
+    return table.with_meta(column, **{SchemaConstants.K_IMAGE: True})
+
+
+def find_unused_column_name(table: DataTable, base: str) -> str:
+    """DatasetExtensions.findUnusedColumnName analog."""
+    name = base
+    i = 1
+    while name in table:
+        name = f"{base}_{i}"
+        i += 1
+    return name

@@ -1,0 +1,205 @@
+"""Stage/model persistence: type-driven serializer registry.
+
+Every stage saves to a directory: ``metadata.json`` holds the class path and
+the JSON-representable params; each *complex* param (models, pytrees, arrays,
+nested stages…) is written under ``complex/<name>/`` by a serializer chosen
+by value type. This is the analog of the reference's ``Serializer``
+type-dispatch plus constructor serialization (reference:
+core/serialize/src/main/scala/Serializer.scala:51-133,
+ConstructorWriter.scala:22-90) — but since Python classes are constructed
+from kwargs, "constructor serialization" degenerates to: save all set params,
+reinstantiate the class, restore them.
+
+Numeric pytrees go through ``flax.serialization`` msgpack so fitted JAX
+models round-trip; arbitrary host objects fall back to pickle (same trust
+model as Java serialization in the reference).
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import pickle
+import shutil
+from typing import Any
+
+import numpy as np
+
+
+_FORMAT_VERSION = 1
+
+
+def _json_default(v: Any) -> Any:
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, bytes):
+        return {"__bytes_hex__": v.hex()}
+    raise TypeError(f"not JSON-serializable: {type(v)}")
+
+
+def _json_object_hook(d: dict) -> Any:
+    if "__bytes_hex__" in d and len(d) == 1:
+        return bytes.fromhex(d["__bytes_hex__"])
+    return d
+
+
+def class_path(cls: type) -> str:
+    return f"{cls.__module__}.{cls.__qualname__}"
+
+
+def load_class(path: str) -> type:
+    module, _, name = path.rpartition(".")
+    obj: Any = importlib.import_module(module)
+    for part in name.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+# ---- complex-value serializers (Serializer.typeToSerializer analog) ----
+
+def _is_pytree_of_arrays(v: Any) -> bool:
+    import jax
+    try:
+        leaves = jax.tree_util.tree_leaves(v)
+    except Exception:
+        return False
+    if not leaves:
+        return isinstance(v, (dict, list, tuple))
+    return all(isinstance(l, (np.ndarray, np.generic, int, float, bool))
+               or type(l).__module__.startswith("jax")
+               for l in leaves)
+
+
+def save_value(value: Any, directory: str) -> None:
+    """Write one complex value into ``directory`` with a ``kind`` tag."""
+    from mmlspark_tpu.core.stage import PipelineStage
+    from mmlspark_tpu.data.table import DataTable
+
+    os.makedirs(directory, exist_ok=True)
+
+    def tag(kind: str, extra: dict | None = None) -> None:
+        with open(os.path.join(directory, "kind.json"), "w") as f:
+            json.dump({"kind": kind, **(extra or {})}, f,
+                      default=_json_default)
+
+    if isinstance(value, PipelineStage):
+        tag("stage")
+        value.save(os.path.join(directory, "stage"))
+    elif isinstance(value, (list, tuple)) and value and all(
+            isinstance(s, PipelineStage) for s in value):
+        tag("stage_list", {"n": len(value),
+                           "tuple": isinstance(value, tuple)})
+        for i, s in enumerate(value):
+            s.save(os.path.join(directory, f"stage_{i}"))
+    elif isinstance(value, np.ndarray):
+        tag("ndarray")
+        np.save(os.path.join(directory, "value.npy"), value,
+                allow_pickle=value.dtype == object)
+    elif isinstance(value, DataTable):
+        tag("datatable", {"meta": value.meta})
+        with open(os.path.join(directory, "table.pkl"), "wb") as f:
+            pickle.dump({k: value[k] for k in value.columns}, f)
+    elif _is_pytree_of_arrays(value):
+        import jax
+        from flax import serialization
+        tag("pytree")
+        host = jax.tree_util.tree_map(np.asarray, value)
+        with open(os.path.join(directory, "tree.msgpack"), "wb") as f:
+            f.write(serialization.to_bytes(host))
+        with open(os.path.join(directory, "treedef.pkl"), "wb") as f:
+            pickle.dump(jax.tree_util.tree_structure(value), f)
+    else:
+        tag("pickle")
+        with open(os.path.join(directory, "value.pkl"), "wb") as f:
+            pickle.dump(value, f)
+
+
+def load_value(directory: str) -> Any:
+    from mmlspark_tpu.data.table import DataTable
+
+    with open(os.path.join(directory, "kind.json")) as f:
+        info = json.load(f)
+    kind = info["kind"]
+    if kind == "stage":
+        return load_stage(os.path.join(directory, "stage"))
+    if kind == "stage_list":
+        out = [load_stage(os.path.join(directory, f"stage_{i}"))
+               for i in range(info["n"])]
+        return tuple(out) if info.get("tuple") else out
+    if kind == "ndarray":
+        return np.load(os.path.join(directory, "value.npy"),
+                       allow_pickle=True)
+    if kind == "datatable":
+        with open(os.path.join(directory, "table.pkl"), "rb") as f:
+            cols = pickle.load(f)
+        return DataTable(cols, info.get("meta"))
+    if kind == "pytree":
+        import jax
+        from flax import serialization
+        with open(os.path.join(directory, "treedef.pkl"), "rb") as f:
+            treedef = pickle.load(f)
+        # build a skeleton with the right structure, then restore bytes
+        skeleton = jax.tree_util.tree_unflatten(
+            treedef, [0] * treedef.num_leaves)
+        with open(os.path.join(directory, "tree.msgpack"), "rb") as f:
+            return serialization.from_bytes(skeleton, f.read())
+    if kind == "pickle":
+        with open(os.path.join(directory, "value.pkl"), "rb") as f:
+            return pickle.load(f)
+    raise ValueError(f"unknown serialized kind {kind!r} in {directory}")
+
+
+# ---- stage save/load entry points ----
+
+def save_stage(stage: Any, path: str, overwrite: bool = True) -> None:
+    if os.path.exists(path):
+        if not overwrite:
+            raise FileExistsError(path)
+        shutil.rmtree(path)
+    os.makedirs(path, exist_ok=True)
+    simple = stage._simple_param_values()
+    meta = {
+        "format_version": _FORMAT_VERSION,
+        "class": class_path(type(stage)),
+        "params": simple,
+        "uid": stage.uid,
+    }
+    with open(os.path.join(path, "metadata.json"), "w") as f:
+        json.dump(meta, f, default=_json_default, indent=1)
+    complex_vals = stage._complex_param_values()
+    for name, value in complex_vals.items():
+        save_value(value, os.path.join(path, "complex", name))
+    extra_dir = os.path.join(path, "extra")
+    stage._save_extra(extra_dir)
+
+
+def load_stage(path: str) -> Any:
+    with open(os.path.join(path, "metadata.json")) as f:
+        meta = json.load(f, object_hook=_json_object_hook)
+    cls = load_class(meta["class"])
+    stage = cls.__new__(cls)
+    stage._values = {}
+    stage._uid = meta.get("uid")
+    # JSON round-trips tuples to lists; params validate/coerce on set
+    params = {}
+    declared = cls.params()
+    for k, v in meta["params"].items():
+        if k in declared:
+            if isinstance(v, list) and isinstance(declared[k].type_, type) \
+                    and declared[k].type_ is tuple:
+                v = tuple(v)
+            params[k] = v
+    stage._post_init()
+    stage.set(**params)
+    cdir = os.path.join(path, "complex")
+    if os.path.isdir(cdir):
+        for name in os.listdir(cdir):
+            if name in declared:
+                stage._values[name] = load_value(os.path.join(cdir, name))
+    stage._load_extra(os.path.join(path, "extra"))
+    return stage

@@ -1,0 +1,142 @@
+"""Pipeline-stage contracts: Transformer / Estimator plus column-role mixins.
+
+Analog of SparkML's ``Transformer``/``Estimator`` as used throughout the
+reference, with the reference's shared column-role mixins
+``HasInputCol/HasOutputCol/HasLabelCol/...`` (reference:
+core/contracts/src/main/scala/Params.scala:112-176). Stages are registered
+on subclass creation, which powers the fuzz suite and doc generation the way
+jar-reflection powers the reference's ``Fuzzing.scala`` and codegen
+(reference: core/utils/src/main/scala/JarLoadingUtils.scala:17-80).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable
+
+from mmlspark_tpu.core.params import Param, Params
+from mmlspark_tpu.core import serialize as _ser
+from mmlspark_tpu.data.table import DataTable
+
+
+_UID_COUNTER = itertools.count()
+
+# global registry: class path → class; drives fuzzing + docgen
+STAGE_REGISTRY: dict[str, type] = {}
+
+
+class PipelineStage(Params):
+    """Base of every stage. Named, parameterized, persistable."""
+
+    def __init__(self, **kwargs: Any):
+        self._post_init()
+        super().__init__(**kwargs)
+
+    def _post_init(self) -> None:
+        # split from __init__ so deserialization can bypass param validation
+        if not hasattr(self, "_uid") or self._uid is None:
+            self._uid = f"{type(self).__name__}_{next(_UID_COUNTER)}"
+
+    def __init_subclass__(cls, **kwargs: Any):
+        super().__init_subclass__(**kwargs)
+        if not cls.__name__.startswith("_"):
+            STAGE_REGISTRY[_ser.class_path(cls)] = cls
+
+    @property
+    def uid(self) -> str:
+        return self._uid
+
+    # -- persistence contract (every stage is writable/readable,
+    #    analog of MLWritable via ComplexParamsWritable) --
+
+    def save(self, path: str, overwrite: bool = True) -> None:
+        _ser.save_stage(self, path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str) -> "PipelineStage":
+        return _ser.load_stage(path)
+
+    def _save_extra(self, directory: str) -> None:
+        """Hook for state outside the param store (rare)."""
+
+    def _load_extra(self, directory: str) -> None:
+        pass
+
+    def __repr__(self) -> str:
+        sets = ", ".join(f"{k}={v!r}" for k, v in
+                         self._simple_param_values().items())
+        return f"{type(self).__name__}({sets})"
+
+
+class Transformer(PipelineStage):
+    """A stage mapping DataTable → DataTable."""
+
+    def transform(self, table: DataTable) -> DataTable:
+        raise NotImplementedError
+
+    def __call__(self, table: DataTable) -> DataTable:
+        return self.transform(table)
+
+
+class Estimator(PipelineStage):
+    """A stage that fits on a DataTable and yields a Transformer (model)."""
+
+    def fit(self, table: DataTable) -> Transformer:
+        raise NotImplementedError
+
+    def fit_transform(self, table: DataTable) -> DataTable:
+        return self.fit(table).transform(table)
+
+
+# ---- column-role mixins (Params.scala:112-176 analog) ----
+
+class HasInputCol:
+    input_col = Param(default="input", doc="name of the input column",
+                      type_=str)
+
+
+class HasOutputCol:
+    output_col = Param(default="output", doc="name of the output column",
+                       type_=str)
+
+
+class HasInputCols:
+    input_cols = Param(default=None, doc="names of the input columns",
+                       type_=(list, tuple))
+
+
+class HasOutputCols:
+    output_cols = Param(default=None, doc="names of the output columns",
+                        type_=(list, tuple))
+
+
+class HasLabelCol:
+    label_col = Param(default="label", doc="name of the label column",
+                      type_=str)
+
+
+class HasFeaturesCol:
+    features_col = Param(default="features", doc="name of the features column",
+                         type_=str)
+
+
+class UnaryTransformer(Transformer, HasInputCol, HasOutputCol):
+    """A transformer producing one output column from one input column."""
+
+    def _transform_column(self, values: Any, table: DataTable) -> Any:
+        raise NotImplementedError
+
+    def transform(self, table: DataTable) -> DataTable:
+        out = self._transform_column(table[self.input_col], table)
+        return table.with_column(self.output_col, out)
+
+
+class LambdaTransformer(Transformer):
+    """Wraps an arbitrary table→table function as a stage (UDFTransformer
+    analog). The function is persisted by pickle."""
+
+    fn = Param(default=None, doc="function DataTable -> DataTable",
+               is_complex=True)
+
+    def transform(self, table: DataTable) -> DataTable:
+        return self.fn(table)

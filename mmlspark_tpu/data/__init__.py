@@ -1,0 +1,9 @@
+"""Columnar data layer: DataTable, readers, and the model downloader.
+
+Analog of the reference's Spark DataFrame usage plus ``src/readers/`` and
+``src/downloader/``.
+"""
+
+from mmlspark_tpu.data.table import DataTable
+
+__all__ = ["DataTable"]

@@ -1,0 +1,304 @@
+"""DataTable — the columnar table every pipeline stage consumes and produces.
+
+The reference's stages operate on Spark DataFrames whose columns carry
+metadata (categorical levels, score-column roles) in an ``mml`` metadata tag
+(reference: core/schema/src/main/scala/SparkSchema.scala:23-129,
+Categoricals.scala:21-90). JAX is Python and single-process per host, so the
+TPU-native analog is a light immutable-ish columnar table:
+
+* columns are NumPy arrays (numeric / bool / fixed-width) or object arrays
+  (strings, bytes, dicts, variable-length vectors),
+* per-column metadata is a plain dict carried in ``table.meta[col]`` — the
+  sidecar-schema replacement for Spark's column metadata facility,
+* zero-copy round-trips to/from pandas and Arrow power the Spark offload
+  bridge (Arrow batches from executors) and local files.
+
+Partitioning: Spark's RDD partitions become an optional ``num_partitions``
+hint plus :meth:`partitions` iteration used by sampling/repartition stages;
+compute-heavy stages instead batch rows directly into device arrays.
+"""
+
+from __future__ import annotations
+
+import copy as _copy
+from typing import Any, Callable, Iterable, Iterator, Mapping, Sequence
+
+import numpy as np
+
+
+def _object_column(values: Any) -> np.ndarray:
+    out = np.empty(len(values), dtype=object)
+    for i, v in enumerate(values):
+        out[i] = v
+    return out
+
+
+def _as_column(values: Any) -> np.ndarray:
+    """Coerce input values to a 1-D numpy column (object dtype if ragged)."""
+    if isinstance(values, np.ndarray):
+        if values.ndim == 1:
+            return values
+        # 2-D numeric arrays become object columns of row vectors
+        return _object_column(values)
+    values = list(values)
+    if not values:
+        return np.empty(0, dtype=object)
+    first = values[0]
+    if isinstance(first, (str, bytes, dict, list, tuple, np.ndarray)) or first is None:
+        return _object_column(values)
+    arr = np.asarray(values)
+    if arr.ndim != 1:
+        return _object_column(values)
+    return arr
+
+
+class DataTable:
+    """An ordered mapping column-name → 1-D column, with per-column metadata."""
+
+    def __init__(
+        self,
+        columns: Mapping[str, Any] | None = None,
+        meta: Mapping[str, Mapping[str, Any]] | None = None,
+        num_partitions: int | None = None,
+    ):
+        self._cols: dict[str, np.ndarray] = {}
+        n = None
+        for name, values in (columns or {}).items():
+            col = _as_column(values)
+            if n is None:
+                n = len(col)
+            elif len(col) != n:
+                raise ValueError(
+                    f"column {name!r} has {len(col)} rows, expected {n}")
+            self._cols[name] = col
+        self._nrows = n or 0
+        # sidecar schema: per-column metadata (categorical levels, score
+        # roles, image flag, …) — the `mml` metadata-tag analog
+        self.meta: dict[str, dict[str, Any]] = {
+            k: dict(v) for k, v in (meta or {}).items() if k in self._cols
+        }
+        self.num_partitions = num_partitions
+
+    # ---- basic accessors ----
+
+    @property
+    def columns(self) -> list[str]:
+        return list(self._cols)
+
+    def __len__(self) -> int:
+        return self._nrows
+
+    @property
+    def num_rows(self) -> int:
+        return self._nrows
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._cols
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        if name not in self._cols:
+            raise KeyError(
+                f"no column {name!r}; available: {self.columns}")
+        return self._cols[name]
+
+    def column_meta(self, name: str) -> dict[str, Any]:
+        return self.meta.get(name, {})
+
+    def dtype(self, name: str) -> np.dtype:
+        return self[name].dtype
+
+    def __repr__(self) -> str:
+        cols = ", ".join(f"{k}:{v.dtype}" for k, v in self._cols.items())
+        return f"DataTable[{self._nrows} rows; {cols}]"
+
+    # ---- functional updates (tables are treated as immutable) ----
+
+    def with_column(
+        self,
+        name: str,
+        values: Any,
+        meta: Mapping[str, Any] | None = None,
+    ) -> "DataTable":
+        col = _as_column(values)
+        if self._cols and len(col) != self._nrows:
+            raise ValueError(
+                f"column {name!r} has {len(col)} rows, expected {self._nrows}")
+        out = self._shallow_copy()
+        out._cols[name] = col
+        if self._cols == {}:
+            out._nrows = len(col)
+        if meta is not None:
+            out.meta[name] = dict(meta)
+        return out
+
+    def with_meta(self, name: str, **meta: Any) -> "DataTable":
+        """Merge metadata entries into a column's sidecar schema."""
+        if name not in self._cols:
+            raise KeyError(f"no column {name!r}")
+        out = self._shallow_copy()
+        out.meta.setdefault(name, {})
+        out.meta[name] = {**out.meta[name], **meta}
+        return out
+
+    def select(self, *names: str) -> "DataTable":
+        for n in names:
+            if n not in self._cols:
+                raise KeyError(f"no column {n!r}; available: {self.columns}")
+        return DataTable(
+            {n: self._cols[n] for n in names},
+            {n: self.meta[n] for n in names if n in self.meta},
+            self.num_partitions,
+        )
+
+    def drop(self, *names: str) -> "DataTable":
+        keep = [n for n in self.columns if n not in names]
+        return self.select(*keep)
+
+    def rename(self, mapping: Mapping[str, str]) -> "DataTable":
+        cols = {mapping.get(k, k): v for k, v in self._cols.items()}
+        meta = {mapping.get(k, k): v for k, v in self.meta.items()}
+        return DataTable(cols, meta, self.num_partitions)
+
+    def take(self, indices: Any) -> "DataTable":
+        """Row subset/reorder by integer indices or boolean mask."""
+        indices = np.asarray(indices)
+        if indices.dtype == bool:
+            indices = np.flatnonzero(indices)
+        elif not np.issubdtype(indices.dtype, np.integer):
+            indices = indices.astype(np.intp)  # e.g. empty list → float64
+        return DataTable(
+            {k: v[indices] for k, v in self._cols.items()},
+            self.meta,
+            self.num_partitions,
+        )
+
+    def head(self, n: int) -> "DataTable":
+        return self.take(np.arange(min(n, self._nrows)))
+
+    def filter(self, predicate: Callable[[dict[str, Any]], bool]) -> "DataTable":
+        mask = np.fromiter(
+            (bool(predicate(row)) for row in self.iter_rows()),
+            dtype=bool, count=self._nrows)
+        return self.take(mask)
+
+    def concat(self, other: "DataTable") -> "DataTable":
+        if set(self.columns) != set(other.columns):
+            raise ValueError(
+                f"column mismatch: {self.columns} vs {other.columns}")
+        cols = {}
+        for k in self.columns:
+            a, b = self._cols[k], other._cols[k]
+            if a.dtype == object or b.dtype == object:
+                merged = np.empty(len(a) + len(b), dtype=object)
+                merged[:len(a)] = a
+                merged[len(a):] = b
+                cols[k] = merged
+            else:
+                cols[k] = np.concatenate([a, b])
+        meta = {**other.meta, **self.meta}
+        return DataTable(cols, meta, self.num_partitions)
+
+    def _shallow_copy(self) -> "DataTable":
+        out = DataTable.__new__(DataTable)
+        out._cols = dict(self._cols)
+        out._nrows = self._nrows
+        out.meta = {k: dict(v) for k, v in self.meta.items()}
+        out.num_partitions = self.num_partitions
+        return out
+
+    # ---- row iteration (for host-side stages; device stages batch columns) --
+
+    def iter_rows(self) -> Iterator[dict[str, Any]]:
+        names = self.columns
+        cols = [self._cols[n] for n in names]
+        for i in range(self._nrows):
+            yield {n: c[i] for n, c in zip(names, cols)}
+
+    def to_rows(self) -> list[dict[str, Any]]:
+        return list(self.iter_rows())
+
+    # ---- partitioning (analog of RDD partitions for sampling stages) ----
+
+    def partitions(self, n: int | None = None) -> list["DataTable"]:
+        n = n or self.num_partitions or 1
+        n = max(1, min(n, max(1, self._nrows)))
+        bounds = np.linspace(0, self._nrows, n + 1).astype(int)
+        return [self.take(np.arange(bounds[i], bounds[i + 1]))
+                for i in range(n)]
+
+    def repartition(self, n: int) -> "DataTable":
+        out = self._shallow_copy()
+        out.num_partitions = n
+        return out
+
+    # ---- conversions ----
+
+    @staticmethod
+    def from_rows(rows: Sequence[Mapping[str, Any]],
+                  meta: Mapping[str, Mapping[str, Any]] | None = None
+                  ) -> "DataTable":
+        if not rows:
+            return DataTable()
+        names = list(rows[0])
+        return DataTable({n: [r.get(n) for r in rows] for n in names}, meta)
+
+    @staticmethod
+    def from_pandas(df: Any, meta: Mapping[str, Mapping[str, Any]] | None = None
+                    ) -> "DataTable":
+        cols = {}
+        for name in df.columns:
+            s = df[name]
+            if str(s.dtype) == "object" or str(s.dtype).startswith(("str", "string")):
+                cols[name] = s.tolist()
+            else:
+                cols[name] = s.to_numpy()
+        return DataTable(cols, meta)
+
+    def to_pandas(self) -> Any:
+        import pandas as pd
+        return pd.DataFrame({k: v for k, v in self._cols.items()})
+
+    @staticmethod
+    def from_arrow(batch: Any, meta: Mapping[str, Mapping[str, Any]] | None = None
+                   ) -> "DataTable":
+        """From a pyarrow Table or RecordBatch (the Spark-bridge wire format)."""
+        cols: dict[str, Any] = {}
+        for name in batch.schema.names:
+            col = batch.column(name)
+            try:
+                cols[name] = col.to_numpy(zero_copy_only=False)
+            except Exception:
+                cols[name] = col.to_pylist()
+        return DataTable(cols, meta)
+
+    def to_arrow(self) -> Any:
+        import pyarrow as pa
+        arrays = {}
+        for k, v in self._cols.items():
+            if v.dtype == object:
+                arrays[k] = pa.array(list(v))
+            else:
+                arrays[k] = pa.array(v)
+        return pa.table(arrays)
+
+    @staticmethod
+    def from_csv(path: str, **kwargs: Any) -> "DataTable":
+        import pandas as pd
+        return DataTable.from_pandas(pd.read_csv(path, **kwargs))
+
+    # ---- batch extraction for device compute ----
+
+    def column_matrix(self, name: str, dtype: Any = np.float32) -> np.ndarray:
+        """Stack a column of equal-length vectors/scalars into a 2-D matrix.
+
+        This is the host-side marshalling step that replaces the reference's
+        per-element JNI FloatVector copies (reference:
+        cntk-model/src/main/scala/CNTKModel.scala:67-74) with one vectorized
+        contiguous copy ready for device transfer.
+        """
+        col = self._cols[name]
+        if col.dtype != object:
+            return col.astype(dtype)[:, None] if col.ndim == 1 else col.astype(dtype)
+        if self._nrows == 0:
+            return np.empty((0, 0), dtype=dtype)
+        return np.stack([np.asarray(v, dtype=dtype).reshape(-1) for v in col])

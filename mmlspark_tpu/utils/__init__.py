@@ -1,0 +1,17 @@
+"""Host/device environment utilities."""
+
+from mmlspark_tpu.utils.env import (
+    device_count,
+    device_kind,
+    get_devices,
+    local_device_count,
+    on_tpu,
+)
+
+__all__ = [
+    "get_devices",
+    "device_count",
+    "local_device_count",
+    "device_kind",
+    "on_tpu",
+]

@@ -1,0 +1,53 @@
+"""Device/topology discovery — the accelerator-environment glue.
+
+The reference discovers accelerators by shelling out to ``nvidia-smi -L``
+(reference: core/env/src/main/scala/EnvironmentUtils.scala:20-50); the
+TPU-native equivalent is JAX's device API, which also covers multi-host
+process topology (``jax.process_index``) for the distributed backend.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence
+
+
+def get_devices(backend: str | None = None) -> Sequence[Any]:
+    import jax
+    return jax.devices(backend) if backend else jax.devices()
+
+
+def device_count() -> int:
+    import jax
+    return jax.device_count()
+
+
+def local_device_count() -> int:
+    import jax
+    return jax.local_device_count()
+
+
+def process_index() -> int:
+    import jax
+    return jax.process_index()
+
+
+def process_count() -> int:
+    import jax
+    return jax.process_count()
+
+
+def device_kind() -> str:
+    devs = get_devices()
+    return devs[0].device_kind if devs else "none"
+
+
+def on_tpu() -> bool:
+    import jax
+    return jax.default_backend() == "tpu"
+
+
+def default_matmul_dtype():
+    """bfloat16 on TPU (MXU-native), float32 elsewhere."""
+    import jax.numpy as jnp
+    return jnp.bfloat16 if on_tpu() else jnp.float32

@@ -1,0 +1,44 @@
+"""Test harness: single-host JAX on a virtual 8-device CPU mesh.
+
+The reference tests all "distributed" logic on a multi-threaded local
+SparkSession (``local[*]``, reference:
+core/test/base/src/main/scala/SparkSessionFactory.scala:39-51); the analog
+here is the JAX CPU backend with 8 virtual devices via
+``--xla_force_host_platform_device_count``, so every sharding/collective
+path compiles and executes without TPU hardware.
+"""
+
+import os
+
+# must run before jax initializes
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture()
+def tmp_save_path(tmp_path):
+    return str(tmp_path / "stage")
+
+
+def make_tabular(n=100, seed=0):
+    """Small mixed-type table used across suites (GenerateDataset analog)."""
+    from mmlspark_tpu.data.table import DataTable
+    r = np.random.default_rng(seed)
+    return DataTable({
+        "num": r.normal(size=n),
+        "int": r.integers(0, 10, size=n),
+        "cat": [["red", "green", "blue"][i % 3] for i in range(n)],
+        "text": [f"word{i % 7} tok{i % 3}" for i in range(n)],
+        "label": (r.random(n) > 0.5).astype(np.int64),
+    })

@@ -1,0 +1,225 @@
+"""Core framework tests: params, DataTable, stages, pipeline, persistence,
+schema metadata protocol."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.params import Param, ParamValidationError, Params
+from mmlspark_tpu.core.pipeline import Pipeline, PipelineModel
+from mmlspark_tpu.core import schema as S
+from mmlspark_tpu.core.stage import (
+    Estimator, PipelineStage, STAGE_REGISTRY, Transformer, UnaryTransformer,
+)
+from mmlspark_tpu.data.table import DataTable
+
+
+class AddConst(UnaryTransformer):
+    amount = Param(default=1.0, doc="value added to input", type_=float)
+
+    def _transform_column(self, values, table):
+        return values.astype(np.float64) + self.amount
+
+
+class MeanCenter(Estimator):
+    input_col = Param(default="input", doc="column to center", type_=str)
+    output_col = Param(default="centered", doc="output column", type_=str)
+
+    def fit(self, table):
+        mu = float(np.mean(table[self.input_col]))
+        return MeanCenterModel(input_col=self.input_col,
+                               output_col=self.output_col, mean=mu)
+
+
+class MeanCenterModel(Transformer):
+    input_col = Param(default="input", doc="column to center", type_=str)
+    output_col = Param(default="centered", doc="output column", type_=str)
+    mean = Param(default=0.0, doc="fitted mean", type_=float)
+
+    def transform(self, table):
+        return table.with_column(
+            self.output_col, table[self.input_col] - self.mean)
+
+
+# ---- params ----
+
+def test_param_defaults_and_set():
+    t = AddConst()
+    assert t.amount == 1.0
+    t.set(amount=2.5)
+    assert t.amount == 2.5
+    t.amount = 3.0  # descriptor set
+    assert t.amount == 3.0
+
+
+def test_param_validation_type():
+    with pytest.raises(ParamValidationError):
+        AddConst(amount="nope")
+
+
+def test_param_validation_domain():
+    class P(Params):
+        k = Param(default=1, type_=int, validator=Param.gt(0))
+    with pytest.raises(ParamValidationError):
+        P(k=0)
+    assert P(k=5).k == 5
+
+
+def test_unknown_param_rejected():
+    with pytest.raises(KeyError):
+        AddConst(bogus=1)
+
+
+def test_params_introspection():
+    ps = AddConst.params()
+    assert {"amount", "input_col", "output_col"} <= set(ps)
+    doc = AddConst().explain_params()
+    assert "value added to input" in doc
+
+
+def test_copy_with_override():
+    a = AddConst(amount=2.0)
+    b = a.copy(amount=5.0)
+    assert a.amount == 2.0 and b.amount == 5.0
+
+
+# ---- DataTable ----
+
+def test_table_basic_ops():
+    t = DataTable({"a": [1, 2, 3], "b": ["x", "y", "z"]})
+    assert len(t) == 3
+    assert t.columns == ["a", "b"]
+    t2 = t.with_column("c", np.arange(3.0))
+    assert "c" in t2 and "c" not in t
+    assert t2.select("a", "c").columns == ["a", "c"]
+    assert t2.drop("a").columns == ["b", "c"]
+    assert len(t.head(2)) == 2
+    assert t.take([2, 0])["a"].tolist() == [3, 1]
+    assert len(t.filter(lambda r: r["a"] > 1)) == 2
+
+
+def test_table_mismatched_lengths():
+    with pytest.raises(ValueError):
+        DataTable({"a": [1, 2], "b": [1]})
+
+
+def test_table_concat_and_partitions():
+    t = DataTable({"a": np.arange(10)})
+    both = t.concat(t)
+    assert len(both) == 20
+    parts = both.partitions(4)
+    assert sum(len(p) for p in parts) == 20
+    assert len(parts) == 4
+
+
+def test_table_pandas_arrow_roundtrip():
+    t = DataTable({"a": np.arange(5.0), "s": ["a", "b", "c", "d", "e"]})
+    df = t.to_pandas()
+    t2 = DataTable.from_pandas(df)
+    np.testing.assert_allclose(t2["a"], t["a"])
+    assert list(t2["s"]) == list(t["s"])
+    arrow = t.to_arrow()
+    t3 = DataTable.from_arrow(arrow)
+    np.testing.assert_allclose(t3["a"], t["a"])
+
+
+def test_column_matrix_vectors():
+    t = DataTable({"v": [np.ones(4), np.zeros(4), np.full(4, 2.0)]})
+    m = t.column_matrix("v")
+    assert m.shape == (3, 4) and m.dtype == np.float32
+
+
+def test_table_meta_carried():
+    t = DataTable({"a": [1, 2]}).with_meta("a", role="label")
+    assert t.column_meta("a")["role"] == "label"
+    assert t.select("a").column_meta("a")["role"] == "label"
+
+
+# ---- stages & pipeline ----
+
+def test_unary_transformer():
+    t = DataTable({"input": np.arange(3.0)})
+    out = AddConst(amount=10.0).transform(t)
+    np.testing.assert_allclose(out["output"], [10, 11, 12])
+
+
+def test_pipeline_fit_transform():
+    t = DataTable({"input": np.array([1.0, 2.0, 3.0])})
+    pipe = Pipeline([
+        AddConst(amount=1.0),
+        MeanCenter(input_col="output", output_col="centered"),
+    ])
+    model = pipe.fit(t)
+    assert isinstance(model, PipelineModel)
+    out = model.transform(t)
+    np.testing.assert_allclose(out["centered"], [-1, 0, 1])
+
+
+def test_stage_registry_contains_classes():
+    names = {cls.__name__ for cls in STAGE_REGISTRY.values()}
+    assert {"Pipeline", "PipelineModel", "AddConst"} <= names
+
+
+# ---- persistence round-trips (RoundTripTestBase analog) ----
+
+def test_stage_save_load(tmp_path):
+    a = AddConst(amount=7.0, input_col="x", output_col="y")
+    p = str(tmp_path / "addconst")
+    a.save(p)
+    b = PipelineStage.load(p)
+    assert isinstance(b, AddConst)
+    assert b.amount == 7.0 and b.input_col == "x"
+
+
+def test_fitted_pipeline_save_load(tmp_path):
+    t = DataTable({"input": np.array([1.0, 2.0, 3.0])})
+    model = Pipeline([
+        AddConst(amount=1.0),
+        MeanCenter(input_col="output", output_col="centered"),
+    ]).fit(t)
+    p = str(tmp_path / "pipe")
+    model.save(p)
+    loaded = PipelineStage.load(p)
+    out1 = model.transform(t)
+    out2 = loaded.transform(t)
+    np.testing.assert_allclose(out1["centered"], out2["centered"])
+
+
+def test_pipeline_unfitted_save_load(tmp_path):
+    pipe = Pipeline([AddConst(amount=2.0)])
+    p = str(tmp_path / "unfitted")
+    pipe.save(p)
+    loaded = PipelineStage.load(p)
+    t = DataTable({"input": np.arange(3.0)})
+    out = loaded.fit(t).transform(t)
+    np.testing.assert_allclose(out["output"], [2, 3, 4])
+
+
+# ---- schema metadata protocol ----
+
+def test_score_column_protocol():
+    t = DataTable({"scores": np.zeros(3), "other": np.ones(3)})
+    t = S.set_score_column(t, "model_1", "scores",
+                           S.SchemaConstants.SCORES_COLUMN,
+                           S.SchemaConstants.CLASSIFICATION_KIND)
+    assert S.find_score_column(t, S.SchemaConstants.SCORES_COLUMN) == "scores"
+    assert S.get_score_value_kind(t, "scores") == \
+        S.SchemaConstants.CLASSIFICATION_KIND
+
+
+def test_categorical_levels_roundtrip():
+    t = DataTable({"c": np.array([0, 1, 2])})
+    t = S.set_categorical_levels(t, "c", ["a", "b", "c"])
+    assert S.is_categorical(t, "c")
+    assert S.get_categorical_levels(t, "c") == ["a", "b", "c"]
+
+
+def test_image_helpers():
+    img = S.make_image("p.png", np.zeros((4, 6, 3), dtype=np.uint8))
+    assert img["height"] == 4 and img["width"] == 6 and img["channels"] == 3
+    t = DataTable({"image": [img, img]})
+    assert S.is_image_column(t, "image")
+
+
+def test_find_unused_column_name():
+    t = DataTable({"x": [1], "x_1": [2]})
+    assert S.find_unused_column_name(t, "x") == "x_2"
