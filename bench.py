@@ -1,0 +1,90 @@
+"""Benchmark: CIFAR-10 ConvNet train throughput on the local accelerator.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+The reference publishes no performance numbers (BASELINE.md), so
+``vs_baseline`` is reported against the driver-defined north star:
+achieved MFU / 0.60 target MFU on the CIFAR-10 CNN featurize+train path.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def conv_flops_per_example(module, input_spec) -> float:
+    """Analytic forward FLOPs for the ConvNet (2*MACs); backward ≈ 2x fwd."""
+    h, w, cin = input_spec
+    flops = 0.0
+    for width in module.widths:
+        for _ in range(2):  # two convs per block
+            flops += 2 * h * w * 3 * 3 * cin * width
+            cin = width
+        h, w = h // 2, w // 2
+    flat = h * w * cin
+    flops += 2 * flat * module.dense_width
+    flops += 2 * module.dense_width * module.num_classes
+    return flops
+
+
+def peak_flops_per_chip() -> float:
+    """bf16 peak for the local accelerator (v5e ≈ 197 TFLOP/s)."""
+    import jax
+    kind = jax.devices()[0].device_kind.lower()
+    table = {
+        "v5 lite": 197e12, "v5e": 197e12, "v4": 275e12,
+        "v5p": 459e12, "v6": 918e12, "v6e": 918e12,
+    }
+    for k, v in table.items():
+        if k in kind:
+            return v
+    return 197e12  # assume v5e-class if unknown
+
+
+def main() -> None:
+    import jax
+
+    from mmlspark_tpu.models.zoo import ConvNetCifar
+    from mmlspark_tpu.train.loop import TrainConfig, Trainer
+
+    batch = 512
+    module = ConvNetCifar()
+    cfg = TrainConfig(batch_size=batch, epochs=1, optimizer="momentum",
+                      learning_rate=0.01, log_every=10**9)
+    trainer = Trainer(module, cfg)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(batch, 32, 32, 3)).astype(np.float32)
+    y = rng.integers(0, 10, size=batch)
+
+    trainer.state = trainer.init_state(x.shape[1:])
+    # warmup/compile
+    state, _ = trainer.step(trainer.state, x, y)
+    jax.block_until_ready(state["params"])
+
+    steps = 30
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = trainer.step(state, x, y)
+    jax.block_until_ready(state["params"])
+    dt = time.perf_counter() - t0
+
+    n_dev = jax.device_count()
+    images_per_s_per_chip = steps * batch / dt / n_dev
+    # fwd + bwd ≈ 3x forward FLOPs
+    step_flops = 3 * conv_flops_per_example(module, (32, 32, 3)) * batch
+    mfu = steps * step_flops / dt / (peak_flops_per_chip() * n_dev)
+
+    print(json.dumps({
+        "metric": "images/sec/chip (CIFAR-10 CNN train)",
+        "value": round(images_per_s_per_chip, 1),
+        "unit": "images/s/chip",
+        "vs_baseline": round(mfu / 0.60, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

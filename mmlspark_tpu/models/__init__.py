@@ -1,0 +1,13 @@
+"""Model layer: the JaxModel inference transformer, model bundles, and the
+built-in architecture zoo.
+
+Analog of the reference's DNN backend ``src/cntk-model/`` +
+``src/image-featurizer/`` + ``src/downloader/`` model zoo, rebuilt on
+JAX/flax: models are flax modules + pytree params instead of serialized
+CNTK graphs reached over JNI.
+"""
+
+from mmlspark_tpu.models.bundle import ModelBundle
+from mmlspark_tpu.models.jax_model import JaxModel
+
+__all__ = ["ModelBundle", "JaxModel"]

@@ -1,0 +1,132 @@
+"""Built-in model architectures (the model-zoo analog).
+
+The reference's zoo is a manifest of pretrained CNTK graphs (ConvNet
+CIFAR-10, ResNet-50, …) downloaded by ``ModelDownloader`` (reference:
+downloader/src/main/scala/{ModelDownloader,Schema}.scala). Here
+architectures are flax modules defined in-repo; weights come either from
+random init (training) or downloaded checkpoints
+(:mod:`mmlspark_tpu.data.downloader`).
+
+TPU-first choices: NHWC layout (XLA:TPU's native conv layout), bfloat16
+compute with float32 params/accumulation, channel counts in MXU-friendly
+multiples of 128 where the architecture allows, named output nodes for
+featurization cuts (the ``cutOutputLayers`` analog, reference:
+image-featurizer/src/main/scala/ImageFeaturizer.scala:116-140).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from mmlspark_tpu.models.bundle import ModelBundle
+
+
+class ConvNetCifar(nn.Module):
+    """CIFAR-10 ConvNet — flagship model, notebook-301 analog.
+
+    Mirrors the capability of the reference zoo's ``ConvNet_CIFAR10`` entry
+    (conv/pool stack + dense head). Compute runs in bfloat16 for the MXU;
+    params stay float32.
+
+    Output nodes (selectable like CNTK node names): ``features`` (penultimate
+    dense activations, used by ImageFeaturizer) and ``logits``.
+    """
+
+    num_classes: int = 10
+    widths: Sequence[int] = (64, 128, 256)
+    dense_width: int = 512
+    dtype: Any = jnp.bfloat16
+
+    OUTPUT_NAMES = ("features", "logits")
+
+    @nn.compact
+    def __call__(self, x, output: str = "logits", train: bool = False):
+        x = x.astype(self.dtype)
+        for i, w in enumerate(self.widths):
+            x = nn.Conv(w, (3, 3), dtype=self.dtype, name=f"conv{i}a")(x)
+            x = nn.relu(x)
+            x = nn.Conv(w, (3, 3), dtype=self.dtype, name=f"conv{i}b")(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.dense_width, dtype=self.dtype, name="dense0")(x)
+        x = nn.relu(x)
+        features = x.astype(jnp.float32)
+        if output == "features":
+            return features
+        logits = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return logits.astype(jnp.float32)
+
+
+class MLP(nn.Module):
+    """Plain MLP — used by TrainClassifier's NN family and tests."""
+
+    features: Sequence[int] = (128, 128)
+    num_outputs: int = 2
+    dtype: Any = jnp.float32
+
+    OUTPUT_NAMES = ("features", "logits")
+
+    @nn.compact
+    def __call__(self, x, output: str = "logits", train: bool = False):
+        x = x.astype(self.dtype)
+        for i, f in enumerate(self.features):
+            x = nn.Dense(f, dtype=self.dtype, name=f"dense{i}")(x)
+            x = nn.relu(x)
+        if output == "features":
+            return x.astype(jnp.float32)
+        return nn.Dense(self.num_outputs, name="head")(x).astype(jnp.float32)
+
+
+# ---- zoo registry ----
+
+ZOO: dict[str, Callable[..., ModelBundle]] = {}
+
+
+def register_model(name: str):
+    def deco(fn):
+        ZOO[name] = fn
+        return fn
+    return deco
+
+
+def init_bundle(module: Any, input_spec: tuple, name: str,
+                preprocess: str | None = None, seed: int = 0,
+                output_names: tuple | None = None) -> ModelBundle:
+    rng = jax.random.PRNGKey(seed)
+    dummy = jnp.zeros((1,) + tuple(input_spec), jnp.float32)
+    variables = module.init(rng, dummy)
+    return ModelBundle(
+        module=module,
+        params=variables["params"],
+        input_spec=tuple(input_spec),
+        output_names=output_names or getattr(
+            type(module), "OUTPUT_NAMES", ("logits",)),
+        preprocess=preprocess,
+        name=name,
+    )
+
+
+@register_model("ConvNet_CIFAR10")
+def conv_net_cifar(num_classes: int = 10, seed: int = 0, **kw) -> ModelBundle:
+    return init_bundle(ConvNetCifar(num_classes=num_classes, **kw),
+                       (32, 32, 3), "ConvNet_CIFAR10",
+                       preprocess="center_128", seed=seed)
+
+
+@register_model("MLP")
+def mlp(input_dim: int = 16, num_outputs: int = 2, seed: int = 0,
+        **kw) -> ModelBundle:
+    return init_bundle(MLP(num_outputs=num_outputs, **kw),
+                       (input_dim,), "MLP", seed=seed)
+
+
+def get_model(name: str, **kwargs: Any) -> ModelBundle:
+    if name not in ZOO:
+        raise KeyError(f"unknown zoo model {name!r}; available: {sorted(ZOO)}")
+    return ZOO[name](**kwargs)

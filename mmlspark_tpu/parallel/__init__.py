@@ -1,0 +1,17 @@
+"""Distributed layer: device meshes, sharding rules, and collectives.
+
+The TPU-native replacement for the reference's two communication planes —
+Spark shuffle/broadcast for data and an external MPI ring for training
+(reference: cntk-train/src/main/scala/CommandBuilders.scala:60-117) —
+expressed as XLA collectives over ICI/DCN via ``jax.sharding.Mesh`` +
+``jit``/``shard_map``. There is no external process and no MPI: gradients
+all-reduce over ICI inside the compiled step function.
+"""
+
+from mmlspark_tpu.parallel.mesh import (
+    MeshSpec,
+    default_mesh_spec,
+    make_mesh,
+)
+
+__all__ = ["MeshSpec", "make_mesh", "default_mesh_spec"]

@@ -1,0 +1,94 @@
+"""Device-mesh construction and axis conventions.
+
+Canonical mesh axes, in order:
+
+* ``dp``  — data parallelism (gradient all-reduce, batch sharding)
+* ``fsdp``— parameter/optimizer sharding across the data axis (zero-style)
+* ``tp``  — tensor parallelism (matmul column/row sharding)
+* ``sp``  — sequence/context parallelism (ring attention)
+* ``pp``  — pipeline stages
+* ``ep``  — expert parallelism (MoE)
+
+The reference's only strategy is single-node MPI data parallelism with GPU
+count discovered via ``nvidia-smi`` (reference:
+cntk-train/src/main/scala/CommandBuilders.scala:79-93,
+core/env/src/main/scala/EnvironmentUtils.scala:20-50); here every strategy
+is a mesh axis and XLA inserts the collectives. Multi-host: the same mesh
+spans all processes' devices (``jax.devices()`` is global after
+``jax.distributed.initialize``), with DCN-friendly axis ordering (dp
+outermost so cross-slice traffic is gradient-only).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Mapping, Sequence
+
+import numpy as np
+
+AXES = ("dp", "fsdp", "tp", "sp", "pp", "ep")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Logical parallelism layout; -1 on ``dp`` means "all remaining"."""
+
+    dp: int = -1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    pp: int = 1
+    ep: int = 1
+
+    def resolve(self, n_devices: int) -> dict[str, int]:
+        sizes = dataclasses.asdict(self)
+        fixed = math.prod(v for v in sizes.values() if v != -1)
+        free = [k for k, v in sizes.items() if v == -1]
+        if len(free) > 1:
+            raise ValueError(f"at most one -1 axis allowed, got {free}")
+        if free:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes "
+                    f"product {fixed}")
+            sizes[free[0]] = n_devices // fixed
+        total = math.prod(sizes.values())
+        if total != n_devices:
+            raise ValueError(
+                f"mesh {sizes} covers {total} devices, have {n_devices}")
+        return sizes
+
+
+def make_mesh(spec: MeshSpec | Mapping[str, int] | None = None,
+              devices: Sequence[Any] | None = None):
+    """Build a ``jax.sharding.Mesh`` over all (or given) devices."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    if spec is None:
+        spec = MeshSpec()
+    if isinstance(spec, Mapping):
+        spec = MeshSpec(**dict(spec))
+    sizes = spec.resolve(len(devices))
+    shape = tuple(sizes[a] for a in AXES)
+    arr = np.array(devices).reshape(shape)
+    return Mesh(arr, AXES)
+
+
+def default_mesh_spec(n_devices: int | None = None) -> MeshSpec:
+    """Pure data parallelism over every device — the reference-parity
+    strategy (MPI DP ring analog)."""
+    return MeshSpec(dp=-1)
+
+
+def batch_sharding(mesh) -> Any:
+    """Sharding for a [batch, ...] array: batch split over dp (and fsdp)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return NamedSharding(mesh, P(("dp", "fsdp")))
+
+
+def replicated(mesh) -> Any:
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return NamedSharding(mesh, P())

@@ -1,0 +1,11 @@
+"""Training layer: in-process distributed train loops, one-call trainers,
+and evaluation.
+
+Replaces the reference's out-of-process ``mpiexec cntk`` training
+(reference: cntk-train/src/main/scala/CNTKLearner.scala:52-162) with
+jit-compiled steps sharded over a device mesh.
+"""
+
+from mmlspark_tpu.train.loop import TrainConfig, Trainer, make_train_step
+
+__all__ = ["TrainConfig", "Trainer", "make_train_step"]

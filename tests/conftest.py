@@ -10,12 +10,18 @@ path compiles and executes without TPU hardware.
 
 import os
 
-# must run before jax initializes
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# must run before jax initializes; the environment presets JAX_PLATFORMS to
+# the TPU tunnel (axon) via sitecustomize, which survives env overrides —
+# jax.config.update below is what actually forces CPU
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

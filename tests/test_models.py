@@ -1,0 +1,172 @@
+"""JaxModel inference, model zoo, trainer, and mesh tests (CPU backend,
+8 virtual devices — the local[*] analog)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.stage import PipelineStage
+from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.core.schema import make_image
+from mmlspark_tpu.models.jax_model import JaxModel, coerce_input_matrix, minibatches
+from mmlspark_tpu.models.zoo import ZOO, get_model
+from mmlspark_tpu.parallel.mesh import MeshSpec, make_mesh
+
+
+def small_cifar_bundle():
+    return get_model("ConvNet_CIFAR10", widths=(8, 16), dense_width=32)
+
+
+def image_table(n=10, seed=0):
+    r = np.random.default_rng(seed)
+    imgs = [make_image(f"img{i}", r.integers(0, 255, (32, 32, 3)))
+            for i in range(n)]
+    return DataTable({"image": imgs})
+
+
+# ---- minibatch iterator ----
+
+def test_minibatches_pads_tail():
+    batch = np.arange(10, dtype=np.float32).reshape(10, 1)
+    chunks = list(minibatches(batch, 4))
+    assert [v for _, v in chunks] == [4, 4, 2]
+    assert all(c.shape == (4, 1) for c, _ in chunks)
+    assert chunks[-1][0][2:].sum() == 0  # zero padding
+
+
+def test_coerce_image_column():
+    t = image_table(3)
+    m = coerce_input_matrix(t, "image", (32, 32, 3))
+    assert m.shape == (3, 32, 32, 3) and m.dtype == np.float32
+
+
+def test_coerce_vector_column_reshape():
+    t = DataTable({"v": [np.arange(12.0) for _ in range(4)]})
+    m = coerce_input_matrix(t, "v", (3, 4))
+    assert m.shape == (4, 3, 4)
+
+
+def test_coerce_wrong_size_raises():
+    t = DataTable({"v": [np.arange(5.0)]})
+    with pytest.raises(ValueError):
+        coerce_input_matrix(t, "v", (3, 4))
+
+
+# ---- JaxModel ----
+
+def test_jax_model_logits_and_nodes():
+    bundle = small_cifar_bundle()
+    t = image_table(7)
+    jm = JaxModel(input_col="image", output_col="scores",
+                  minibatch_size=4)
+    jm.set(model=bundle)
+    out = jm.transform(t)
+    scores = np.stack(list(out["scores"]))
+    assert scores.shape == (7, 10)
+    # features node by name
+    jm2 = JaxModel(input_col="image", output_col="feat",
+                   output_node="features", minibatch_size=4)
+    jm2.set(model=bundle)
+    feats = np.stack(list(jm2.transform(t)["feat"]))
+    assert feats.shape == (7, 32)
+    # node by index
+    jm3 = jm2.copy()
+    jm3.set(output_node=None, output_node_index=0)
+    feats2 = np.stack(list(jm3.transform(t)["feat"]))
+    np.testing.assert_allclose(feats, feats2)
+
+
+def test_jax_model_batch_size_invariance():
+    """Output must not depend on minibatch slicing (padding correctness)."""
+    bundle = small_cifar_bundle()
+    t = image_table(5)
+    outs = []
+    for bs in (2, 5, 64):
+        jm = JaxModel(input_col="image", output_col="s", minibatch_size=bs)
+        jm.set(model=bundle)
+        outs.append(np.stack(list(jm.transform(t)["s"])))
+    np.testing.assert_allclose(outs[0], outs[1], rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(outs[0], outs[2], rtol=1e-4, atol=1e-4)
+
+
+def test_jax_model_empty_table():
+    bundle = small_cifar_bundle()
+    jm = JaxModel(input_col="image", output_col="s")
+    jm.set(model=bundle)
+    out = jm.transform(DataTable({"image": []}))
+    assert len(out) == 0 and "s" in out
+
+
+def test_jax_model_bad_node():
+    bundle = small_cifar_bundle()
+    jm = JaxModel(input_col="image", output_col="s", output_node="nope")
+    jm.set(model=bundle)
+    with pytest.raises(ValueError):
+        jm.transform(image_table(2))
+
+
+def test_jax_model_save_load(tmp_path):
+    bundle = small_cifar_bundle()
+    t = image_table(3)
+    jm = JaxModel(input_col="image", output_col="s", minibatch_size=4)
+    jm.set(model=bundle)
+    p = str(tmp_path / "jm")
+    jm.save(p)
+    loaded = PipelineStage.load(p)
+    a = np.stack(list(jm.transform(t)["s"]))
+    b = np.stack(list(loaded.transform(t)["s"]))
+    np.testing.assert_allclose(a, b, rtol=1e-5)
+
+
+# ---- zoo ----
+
+def test_zoo_registry():
+    assert "ConvNet_CIFAR10" in ZOO and "MLP" in ZOO
+    b = get_model("MLP", input_dim=4, num_outputs=3)
+    assert b.num_params() > 0
+    with pytest.raises(KeyError):
+        get_model("nonexistent")
+
+
+# ---- mesh ----
+
+def test_mesh_spec_resolution():
+    assert MeshSpec(dp=-1).resolve(8)["dp"] == 8
+    sizes = MeshSpec(dp=-1, tp=2).resolve(8)
+    assert sizes["dp"] == 4 and sizes["tp"] == 2
+    with pytest.raises(ValueError):
+        MeshSpec(dp=3).resolve(8)
+
+
+def test_make_mesh_8_devices():
+    mesh = make_mesh(MeshSpec(dp=-1, fsdp=2))
+    assert mesh.shape["dp"] == 4 and mesh.shape["fsdp"] == 2
+
+
+# ---- trainer ----
+
+def test_trainer_loss_decreases():
+    from mmlspark_tpu.models.zoo import MLP
+    from mmlspark_tpu.train.loop import TrainConfig, Trainer
+
+    r = np.random.default_rng(0)
+    x = r.normal(size=(256, 8)).astype(np.float32)
+    w = r.normal(size=(8,))
+    y = (x @ w > 0).astype(np.int64)
+    cfg = TrainConfig(batch_size=64, epochs=30, learning_rate=5e-3,
+                      log_every=1)
+    tr = Trainer(MLP(features=(32,), num_outputs=2), cfg)
+    tr.fit_arrays(x, y)
+    assert tr.history[-1] < tr.history[0] * 0.7
+
+
+def test_graft_entry_single():
+    import __graft_entry__ as ge
+    import jax
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (8, 10)
+
+
+def test_graft_entry_multichip():
+    import __graft_entry__ as ge
+    ge.dryrun_multichip(8)
