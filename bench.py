@@ -30,8 +30,9 @@ def conv_flops_per_example(module, input_spec) -> float:
     return flops
 
 
-def peak_flops_per_chip() -> float:
-    """bf16 peak for the local accelerator (v5e ≈ 197 TFLOP/s)."""
+def peak_flops_per_chip() -> float | None:
+    """bf16 peak for the local accelerator; None if the device is unknown
+    (CPU/GPU dev boxes), in which case MFU is not reported."""
     import jax
     kind = jax.devices()[0].device_kind.lower()
     table = {
@@ -41,7 +42,7 @@ def peak_flops_per_chip() -> float:
     for k, v in table.items():
         if k in kind:
             return v
-    return 197e12  # assume v5e-class if unknown
+    return None
 
 
 def main() -> None:
@@ -76,13 +77,20 @@ def main() -> None:
     images_per_s_per_chip = steps * batch / dt / n_dev
     # fwd + bwd ≈ 3x forward FLOPs
     step_flops = 3 * conv_flops_per_example(module, (32, 32, 3)) * batch
-    mfu = steps * step_flops / dt / (peak_flops_per_chip() * n_dev)
+    peak = peak_flops_per_chip()
+    device = jax.devices()[0].device_kind
+    if peak is None:
+        vs_baseline = None  # unknown hardware: MFU ratio would be garbage
+    else:
+        mfu = steps * step_flops / dt / (peak * n_dev)
+        vs_baseline = round(mfu / 0.60, 4)
 
     print(json.dumps({
         "metric": "images/sec/chip (CIFAR-10 CNN train)",
         "value": round(images_per_s_per_chip, 1),
         "unit": "images/s/chip",
-        "vs_baseline": round(mfu / 0.60, 4),
+        "vs_baseline": vs_baseline,
+        "device": device,
     }))
 
 

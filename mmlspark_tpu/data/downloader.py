@@ -1,0 +1,219 @@
+"""ModelDownloader — manifest-driven model zoo with sha256-verified cache.
+
+Analog of the reference's ``src/downloader/`` (reference:
+ModelDownloader.scala:23-252, Schema.scala:54-74): a remote/local
+repository of pretrained models described by a manifest, transferred into a
+local cache keyed by content hash, with integrity verification. Differences:
+models are ModelBundle checkpoint directories (msgpack pytrees) instead of
+CNTK graph files, and local/file repositories are first-class (the build
+environment has no egress; HTTP stays supported for real deployments).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import shutil
+from typing import Any, Iterable
+
+from mmlspark_tpu.core import config
+from mmlspark_tpu.core.logging_utils import get_logger
+
+_log = get_logger(__name__)
+
+MANIFEST_NAME = "MANIFEST.json"
+
+
+@dataclasses.dataclass
+class ModelSchema:
+    """Manifest entry (reference: downloader/Schema.scala:54-74)."""
+
+    name: str
+    dataset: str = ""
+    model_type: str = ""
+    uri: str = ""                 # location relative to the repo root
+    hash: str = ""                # sha256 of the archived model dir
+    size: int = 0
+    input_node: str = "input"
+    num_layers: int = 0
+    layer_names: tuple = ()
+
+    def to_json(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["layer_names"] = list(self.layer_names)
+        return d
+
+    @staticmethod
+    def from_json(d: dict) -> "ModelSchema":
+        d = dict(d)
+        d["layer_names"] = tuple(d.get("layer_names", ()))
+        return ModelSchema(**d)
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+class Repository:
+    """A model repository rooted at a local dir or URL."""
+
+    def __init__(self, root: str):
+        self.root = root
+
+    def _is_remote(self) -> bool:
+        return self.root.startswith(("http://", "https://"))
+
+    def read_manifest(self) -> list[ModelSchema]:
+        if self._is_remote():
+            import urllib.request
+            with urllib.request.urlopen(
+                    f"{self.root}/{MANIFEST_NAME}") as r:
+                entries = json.load(r)
+        else:
+            with open(os.path.join(self.root, MANIFEST_NAME)) as f:
+                entries = json.load(f)
+        return [ModelSchema.from_json(e) for e in entries]
+
+    def fetch(self, schema: ModelSchema, dest: str) -> str:
+        """Copy/download the model artifact to ``dest``; returns the path."""
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        if self._is_remote():
+            import urllib.request
+            with urllib.request.urlopen(f"{self.root}/{schema.uri}") as r, \
+                    open(dest, "wb") as f:
+                shutil.copyfileobj(r, f)
+        else:
+            shutil.copyfile(os.path.join(self.root, schema.uri), dest)
+        return dest
+
+
+class ModelDownloader:
+    """Transfers models from a repository into a hash-verified local cache.
+
+    Reference: ModelDownloader.scala:164-251 (``repoTransfer`` dedup by
+    hash, ``downloadByName``/``downloadModels``).
+    """
+
+    def __init__(self, repo: str | Repository | None = None,
+                 cache_dir: str | None = None):
+        if repo is None:
+            repo = config.get("model_repo_url") or ""
+        self.repo = repo if isinstance(repo, Repository) else Repository(repo)
+        self.cache_dir = cache_dir or os.path.join(
+            config.get("cache_dir"), "models")
+
+    def list_models(self) -> list[ModelSchema]:
+        return self.repo.read_manifest()
+
+    def _cache_path(self, schema: ModelSchema) -> str:
+        tag = schema.hash[:16] if schema.hash else "nohash"
+        return os.path.join(self.cache_dir, f"{schema.name}-{tag}.model")
+
+    def download_by_name(self, name: str) -> str:
+        for schema in self.list_models():
+            if schema.name == name:
+                return self.download(schema)
+        raise KeyError(f"model {name!r} not in repository manifest "
+                       f"({self.repo.root})")
+
+    def download(self, schema: ModelSchema) -> str:
+        dest = self._cache_path(schema)
+        if os.path.exists(dest):
+            if not schema.hash or _sha256_file(dest) == schema.hash:
+                return dest  # hash-dedup hit (repoTransfer analog)
+            _log.warning("cached model %s failed hash check; refetching",
+                         schema.name)
+            os.remove(dest)
+        self.repo.fetch(schema, dest)
+        if schema.hash:
+            actual = _sha256_file(dest)
+            if actual != schema.hash:
+                os.remove(dest)
+                raise IOError(
+                    f"model {schema.name!r}: sha256 mismatch "
+                    f"(manifest {schema.hash[:12]}…, got {actual[:12]}…)")
+        return dest
+
+    def download_models(self, names: Iterable[str] | None = None) -> list[str]:
+        schemas = self.list_models()
+        if names is not None:
+            wanted = set(names)
+            schemas = [s for s in schemas if s.name in wanted]
+        return [self.download(s) for s in schemas]
+
+
+# ---- publishing helpers (build a local repo; used by tests & tools) ----
+
+def save_bundle_file(bundle: Any, path: str) -> None:
+    """Serialize a ModelBundle to one file (pickle of module + msgpack'd
+    params)."""
+    import pickle
+
+    import jax
+    import numpy as np
+    from flax import serialization
+
+    host_params = jax.tree_util.tree_map(np.asarray, bundle.params)
+    payload = {
+        "module": bundle.module,
+        "params_bytes": serialization.to_bytes(host_params),
+        "params_skeleton": jax.tree_util.tree_map(
+            lambda a: 0, host_params),
+        "input_spec": bundle.input_spec,
+        "output_names": bundle.output_names,
+        "preprocess": bundle.preprocess,
+        "name": bundle.name,
+    }
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(payload, f)
+
+
+def load_bundle_file(path: str) -> Any:
+    import pickle
+
+    from flax import serialization
+
+    from mmlspark_tpu.models.bundle import ModelBundle
+
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    params = serialization.from_bytes(
+        payload["params_skeleton"], payload["params_bytes"])
+    return ModelBundle(
+        module=payload["module"],
+        params=params,
+        input_spec=tuple(payload["input_spec"]),
+        output_names=tuple(payload["output_names"]),
+        preprocess=payload["preprocess"],
+        name=payload["name"],
+    )
+
+
+def publish_model(bundle: Any, repo_root: str,
+                  schema: ModelSchema | None = None) -> ModelSchema:
+    """Write a bundle + manifest entry into a local repository dir."""
+    os.makedirs(repo_root, exist_ok=True)
+    uri = f"{bundle.name}.model"
+    path = os.path.join(repo_root, uri)
+    save_bundle_file(bundle, path)
+    entry = schema or ModelSchema(name=bundle.name)
+    entry.uri = uri
+    entry.hash = _sha256_file(path)
+    entry.size = os.path.getsize(path)
+    entry.layer_names = tuple(bundle.output_names)
+    manifest_path = os.path.join(repo_root, MANIFEST_NAME)
+    entries = []
+    if os.path.exists(manifest_path):
+        with open(manifest_path) as f:
+            entries = [e for e in json.load(f) if e["name"] != entry.name]
+    entries.append(entry.to_json())
+    with open(manifest_path, "w") as f:
+        json.dump(entries, f, indent=1)
+    return entry

@@ -1,0 +1,190 @@
+"""Binary/image file ingestion with recursive globs, zip traversal, and
+seeded subsampling.
+
+Analog of the reference's custom Spark datasources ``BinaryFileFormat`` /
+``ImageFileFormat`` and the ``spark.readImages`` / ``spark.readBinaryFiles``
+implicits (reference: readers/src/main/scala/BinaryFileFormat.scala:36-179,
+ImageFileFormat.scala:43-82, Readers.scala:14-46). Design differences,
+TPU-first:
+
+* No Spark executors: files are listed host-side and read by a thread pool
+  (IO-bound), the analog of per-host sharded ingest feeding HBM. For
+  multi-host training each process passes its ``shard_index``/``num_shards``
+  so hosts read disjoint file shards (no shuffle engine).
+* Zip archives are traversed entry-by-entry without full extraction
+  (``ZipIterator`` analog, reference: core/env/src/main/scala/
+  StreamUtilities.scala:43-81).
+* Subsampling is a deterministic per-record hash of the path against the
+  seed, so a sample is reproducible across runs and hosts (the reference
+  uses a seeded Random per split, BinaryFileFormat.scala:63-74).
+* Decode prefers the native C++ extension (libjpeg/libpng), falling back to
+  OpenCV — decode happens at read time like the reference's in-reader
+  ``Imgcodecs.imdecode`` (ImageReader.scala:45-63).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import zipfile
+from concurrent.futures import ThreadPoolExecutor
+from glob import glob as _glob
+from typing import Any, Iterable, Iterator
+
+import numpy as np
+
+from mmlspark_tpu.core.logging_utils import get_logger
+from mmlspark_tpu.core.schema import make_image, mark_image_column
+from mmlspark_tpu.data.table import DataTable
+
+_log = get_logger(__name__)
+
+IMAGE_EXTENSIONS = (".jpg", ".jpeg", ".png", ".bmp", ".ppm", ".pgm", ".gif",
+                    ".tif", ".tiff", ".webp")
+
+
+def _keep(path: str, sample_ratio: float, seed: int) -> bool:
+    """Deterministic per-path sampling decision."""
+    if sample_ratio >= 1.0:
+        return True
+    digest = hashlib.sha1(f"{seed}:{path}".encode()).digest()
+    frac = int.from_bytes(digest[:8], "big") / 2 ** 64
+    return frac < sample_ratio
+
+
+def list_files(path: str, recursive: bool = False,
+               extensions: tuple | None = None) -> list[str]:
+    """Expand a path/glob/dir into a sorted file list."""
+    if os.path.isdir(path):
+        pattern = os.path.join(path, "**" if recursive else "*")
+        files = _glob(pattern, recursive=recursive)
+    elif any(ch in path for ch in "*?["):
+        files = _glob(path, recursive=recursive)
+    elif os.path.isfile(path):
+        files = [path]
+    else:
+        raise FileNotFoundError(path)
+    files = [f for f in files if os.path.isfile(f)]
+    if extensions:
+        files = [f for f in files
+                 if f.lower().endswith(extensions)
+                 or f.lower().endswith(".zip")]
+    return sorted(files)
+
+
+def _iter_records(
+    files: list[str],
+    inspect_zip: bool,
+    sample_ratio: float,
+    seed: int,
+    extensions: tuple | None,
+) -> Iterator[tuple[str, bytes]]:
+    """Yield (virtual_path, bytes). Zip entries get path 'archive.zip/entry'."""
+    for f in files:
+        if inspect_zip and f.lower().endswith(".zip"):
+            with zipfile.ZipFile(f) as zf:
+                for info in zf.infolist():
+                    if info.is_dir():
+                        continue
+                    vpath = f"{f}/{info.filename}"
+                    if extensions and not info.filename.lower().endswith(
+                            extensions):
+                        continue
+                    if _keep(vpath, sample_ratio, seed):
+                        yield vpath, zf.read(info)
+        else:
+            if _keep(f, sample_ratio, seed):
+                with open(f, "rb") as fh:
+                    yield f, fh.read()
+
+
+def decode_image(data: bytes) -> np.ndarray | None:
+    """Decode encoded image bytes to an HWC uint8 BGR array (OpenCV
+    convention, matching the reference's Imgcodecs.imdecode output).
+
+    Tries the native C++ extension first, then OpenCV.
+    """
+    from mmlspark_tpu.native import imgops
+    arr = imgops.decode(data)
+    if arr is not None:
+        return arr
+    try:
+        import cv2
+        decoded = cv2.imdecode(np.frombuffer(data, np.uint8),
+                               cv2.IMREAD_COLOR)
+        return decoded
+    except Exception:
+        return None
+
+
+def read_binary_files(
+    path: str,
+    recursive: bool = False,
+    sample_ratio: float = 1.0,
+    inspect_zip: bool = True,
+    seed: int = 0,
+    shard_index: int = 0,
+    num_shards: int = 1,
+    extensions: tuple | None = None,
+) -> DataTable:
+    """Read whole files (or zip entries) as rows of {path, bytes}."""
+    if not 0.0 <= sample_ratio <= 1.0:
+        raise ValueError(f"sample_ratio must be in [0,1], got {sample_ratio}")
+    files = list_files(path, recursive, extensions)
+    files = files[shard_index::num_shards]
+    paths, blobs = [], []
+    for vpath, data in _iter_records(files, inspect_zip, sample_ratio, seed,
+                                     extensions):
+        paths.append(vpath)
+        blobs.append(data)
+    return DataTable({"path": paths, "bytes": blobs})
+
+
+def read_images(
+    path: str,
+    recursive: bool = False,
+    sample_ratio: float = 1.0,
+    inspect_zip: bool = True,
+    seed: int = 0,
+    shard_index: int = 0,
+    num_shards: int = 1,
+    drop_invalid: bool = True,
+    image_col: str = "image",
+    num_threads: int = 8,
+) -> DataTable:
+    """Read and decode images into an image-struct column.
+
+    Returns a DataTable with column ``image`` of
+    {path, height, width, channels, data} dicts (ImageSchema analog).
+    """
+    raw = read_binary_files(path, recursive, sample_ratio, inspect_zip, seed,
+                            shard_index, num_shards,
+                            extensions=IMAGE_EXTENSIONS)
+
+    def decode_one(args):
+        p, b = args
+        arr = decode_image(b)
+        return (p, arr)
+
+    records = list(zip(raw["path"], raw["bytes"]))
+    if len(records) > 1 and num_threads > 1:
+        with ThreadPoolExecutor(max_workers=num_threads) as pool:
+            decoded = list(pool.map(decode_one, records))
+    else:
+        decoded = [decode_one(r) for r in records]
+
+    images, n_bad = [], 0
+    for p, arr in decoded:
+        if arr is None:
+            n_bad += 1
+            if not drop_invalid:
+                images.append(None)
+            continue
+        images.append(make_image(p, arr))
+    if n_bad:
+        _log.warning("read_images: %d/%d files failed to decode%s",
+                     n_bad, len(decoded),
+                     " (dropped)" if drop_invalid else " (kept as None)")
+    table = DataTable({image_col: images})
+    return mark_image_column(table, image_col)

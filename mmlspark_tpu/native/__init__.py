@@ -1,0 +1,13 @@
+"""Native extension loader — builds and binds the C++ imgops library.
+
+Analog of the reference's ``NativeLoader`` which extracts platform .so files
+from jar resources and dlopens them (reference:
+core/env/src/main/scala/NativeLoader.java:28-127). Here the library is
+compiled from the in-repo C++ source on first use (cached next to the
+source), bound via ctypes, and every entry point degrades gracefully to a
+NumPy/OpenCV fallback when the toolchain or image libraries are missing.
+"""
+
+from mmlspark_tpu.native import imgops
+
+__all__ = ["imgops"]

@@ -1,0 +1,176 @@
+"""ctypes bindings for the native imgops library, with lazy build + fallback."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Any
+
+import numpy as np
+
+from mmlspark_tpu.core.logging_utils import get_logger
+
+_log = get_logger(__name__)
+
+_HERE = os.path.dirname(__file__)
+_SRC = os.path.join(_HERE, "src", "imgops.cpp")
+_LIB = os.path.join(_HERE, "libimgops.so")
+
+_lock = threading.Lock()
+_lib: Any = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-fPIC", "-shared", _SRC,
+           "-ljpeg", "-lpng", "-o", _LIB]
+    try:
+        res = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        _log.warning("imgops native build unavailable: %s", e)
+        return False
+    if res.returncode != 0:
+        _log.warning("imgops native build failed:\n%s", res.stderr[-2000:])
+        return False
+    return True
+
+
+def _load() -> Any:
+    """Build (if needed) and dlopen the library; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        src_mtime = os.path.getmtime(_SRC) if os.path.exists(_SRC) else 0
+        lib_fresh = (os.path.exists(_LIB)
+                     and os.path.getmtime(_LIB) >= src_mtime)
+        if not lib_fresh and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError as e:
+            _log.warning("imgops dlopen failed: %s", e)
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.img_decode.argtypes = [u8p, ctypes.c_int,
+                                   ctypes.POINTER(u8p),
+                                   ctypes.POINTER(ctypes.c_int),
+                                   ctypes.POINTER(ctypes.c_int),
+                                   ctypes.POINTER(ctypes.c_int)]
+        lib.img_decode.restype = ctypes.c_int
+        lib.img_free.argtypes = [u8p]
+        lib.img_unroll.argtypes = [u8p, ctypes.c_int, ctypes.c_int,
+                                   ctypes.c_int,
+                                   ctypes.POINTER(ctypes.c_float),
+                                   ctypes.c_int, ctypes.c_float,
+                                   ctypes.c_float]
+        lib.img_unroll.restype = ctypes.c_int
+        lib.img_unroll_batch.argtypes = [u8p, ctypes.c_int, ctypes.c_int,
+                                         ctypes.c_int, ctypes.c_int,
+                                         ctypes.POINTER(ctypes.c_float),
+                                         ctypes.c_int, ctypes.c_float,
+                                         ctypes.c_float]
+        lib.img_unroll_batch.restype = ctypes.c_int
+        lib.img_resize_bilinear.argtypes = [u8p, ctypes.c_int, ctypes.c_int,
+                                            ctypes.c_int, u8p, ctypes.c_int,
+                                            ctypes.c_int]
+        lib.img_resize_bilinear.restype = ctypes.c_int
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def decode(data: bytes) -> np.ndarray | None:
+    """Decode JPEG/PNG bytes to HWC uint8 BGR; None if the native path
+    can't handle it (caller falls back to OpenCV)."""
+    lib = _load()
+    if lib is None or len(data) < 4:
+        return None
+    buf = np.frombuffer(data, np.uint8)
+    out = ctypes.POINTER(ctypes.c_uint8)()
+    h = ctypes.c_int()
+    w = ctypes.c_int()
+    c = ctypes.c_int()
+    rc = lib.img_decode(buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                        len(data), ctypes.byref(out), ctypes.byref(h),
+                        ctypes.byref(w), ctypes.byref(c))
+    if rc != 0:
+        return None
+    try:
+        n = h.value * w.value * c.value
+        arr = np.ctypeslib.as_array(out, shape=(n,)).reshape(
+            h.value, w.value, c.value).copy()
+    finally:
+        lib.img_free(out)
+    return arr
+
+
+def unroll(hwc: np.ndarray, to_rgb: bool = False, scale: float = 1.0,
+           offset: float = 0.0) -> np.ndarray:
+    """HWC uint8 → CHW float32 with optional channel swap + affine.
+
+    The UnrollImage hot loop (reference: image-transformer/src/main/scala/
+    UnrollImage.scala:18-42 iterates pixel-by-pixel in Scala); here one C++
+    pass, or a vectorized NumPy fallback.
+    """
+    hwc = np.ascontiguousarray(hwc, dtype=np.uint8)
+    if hwc.ndim == 2:
+        hwc = hwc[:, :, None]
+    h, w, c = hwc.shape
+    lib = _load()
+    if lib is None:
+        x = hwc[:, :, ::-1] if (to_rgb and c == 3) else hwc
+        return (np.transpose(x, (2, 0, 1)).astype(np.float32) * scale
+                + offset)
+    out = np.empty((c, h, w), np.float32)
+    lib.img_unroll(hwc.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                   h, w, c, out.ctypes.data_as(
+                       ctypes.POINTER(ctypes.c_float)),
+                   int(to_rgb), float(scale), float(offset))
+    return out
+
+
+def unroll_batch(batch_hwc: np.ndarray, to_rgb: bool = False,
+                 scale: float = 1.0, offset: float = 0.0) -> np.ndarray:
+    """[N,H,W,C] uint8 → [N,C,H,W] float32 in one native call."""
+    batch_hwc = np.ascontiguousarray(batch_hwc, dtype=np.uint8)
+    n, h, w, c = batch_hwc.shape
+    lib = _load()
+    if lib is None:
+        x = batch_hwc[..., ::-1] if (to_rgb and c == 3) else batch_hwc
+        return (np.transpose(x, (0, 3, 1, 2)).astype(np.float32) * scale
+                + offset)
+    out = np.empty((n, c, h, w), np.float32)
+    lib.img_unroll_batch(
+        batch_hwc.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        n, h, w, c,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        int(to_rgb), float(scale), float(offset))
+    return out
+
+
+def resize(hwc: np.ndarray, height: int, width: int) -> np.ndarray:
+    """Bilinear uint8 resize; OpenCV fallback."""
+    hwc = np.ascontiguousarray(hwc, dtype=np.uint8)
+    if hwc.ndim == 2:
+        hwc = hwc[:, :, None]
+    h, w, c = hwc.shape
+    lib = _load()
+    if lib is None:
+        import cv2
+        out = cv2.resize(hwc, (width, height),
+                         interpolation=cv2.INTER_LINEAR)
+        return out if out.ndim == 3 else out[:, :, None]
+    out = np.empty((height, width, c), np.uint8)
+    rc = lib.img_resize_bilinear(
+        hwc.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), h, w, c,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), height, width)
+    if rc != 0:
+        raise ValueError(f"resize failed for shape {hwc.shape}")
+    return out

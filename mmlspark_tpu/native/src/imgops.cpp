@@ -1,0 +1,184 @@
+// imgops — native host-side image ops for mmlspark_tpu.
+//
+// The TPU-native equivalent of the reference's OpenCV-C++-via-JNI image
+// path (reference: readers/src/main/scala/ImageReader.scala:45-63 decode;
+// image-transformer/src/main/scala/UnrollImage.scala:18-42 per-pixel unroll
+// loop). Decode runs on TPU-VM hosts feeding HBM; unroll/pack is the hot
+// row→tensor marshalling step, vectorized in C++ instead of a per-pixel
+// Scala loop.
+//
+// C ABI (ctypes-friendly):
+//   img_decode(data, len, &out, &h, &w, &c)  -> 0 on success; out = malloc'd
+//       HWC BGR uint8 buffer (caller frees via img_free)
+//   img_free(ptr)
+//   img_unroll(hwc, h, w, c, out, to_rgb, scale, offset) -> CHW float32
+//   img_resize_bilinear(in, h, w, c, out, oh, ow)
+//
+// Build: g++ -O3 -fPIC -shared imgops.cpp -ljpeg -lpng -o libimgops.so
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <csetjmp>
+#include <cstdio>
+
+#include <jpeglib.h>
+#include <png.h>
+
+extern "C" {
+
+void img_free(uint8_t* p) { std::free(p); }
+
+// ---- JPEG ----
+
+struct JpegErr {
+    jpeg_error_mgr mgr;
+    jmp_buf jb;
+};
+
+static void jpeg_err_exit(j_common_ptr cinfo) {
+    JpegErr* err = reinterpret_cast<JpegErr*>(cinfo->err);
+    longjmp(err->jb, 1);
+}
+
+static int decode_jpeg(const uint8_t* data, int len, uint8_t** out,
+                       int* h, int* w, int* c) {
+    jpeg_decompress_struct cinfo;
+    JpegErr jerr;
+    cinfo.err = jpeg_std_error(&jerr.mgr);
+    jerr.mgr.error_exit = jpeg_err_exit;
+    uint8_t* buf = nullptr;
+    if (setjmp(jerr.jb)) {
+        jpeg_destroy_decompress(&cinfo);
+        std::free(buf);
+        return 1;
+    }
+    jpeg_create_decompress(&cinfo);
+    jpeg_mem_src(&cinfo, const_cast<uint8_t*>(data),
+                 static_cast<unsigned long>(len));
+    if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+        jpeg_destroy_decompress(&cinfo);
+        return 1;
+    }
+#ifdef JCS_EXTENSIONS
+    cinfo.out_color_space = JCS_EXT_BGR;  // libjpeg-turbo: decode straight to BGR
+#else
+    cinfo.out_color_space = JCS_RGB;
+#endif
+    jpeg_start_decompress(&cinfo);
+    const int H = cinfo.output_height, W = cinfo.output_width,
+              C = cinfo.output_components;
+    buf = static_cast<uint8_t*>(std::malloc(static_cast<size_t>(H) * W * C));
+    if (!buf) { jpeg_destroy_decompress(&cinfo); return 1; }
+    while (cinfo.output_scanline < cinfo.output_height) {
+        uint8_t* row = buf + static_cast<size_t>(cinfo.output_scanline) * W * C;
+        jpeg_read_scanlines(&cinfo, &row, 1);
+    }
+    jpeg_finish_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+#ifndef JCS_EXTENSIONS
+    if (C == 3) {  // RGB -> BGR swap
+        for (size_t i = 0; i < static_cast<size_t>(H) * W; i++) {
+            uint8_t t = buf[i * 3];
+            buf[i * 3] = buf[i * 3 + 2];
+            buf[i * 3 + 2] = t;
+        }
+    }
+#endif
+    *out = buf; *h = H; *w = W; *c = C;
+    return 0;
+}
+
+// ---- PNG (libpng >= 1.6 simplified API) ----
+
+static int decode_png(const uint8_t* data, int len, uint8_t** out,
+                      int* h, int* w, int* c) {
+    png_image image;
+    std::memset(&image, 0, sizeof(image));
+    image.version = PNG_IMAGE_VERSION;
+    if (!png_image_begin_read_from_memory(&image, data,
+                                          static_cast<size_t>(len)))
+        return 1;
+    image.format = PNG_FORMAT_BGR;
+    const int H = image.height, W = image.width, C = 3;
+    uint8_t* buf = static_cast<uint8_t*>(
+        std::malloc(PNG_IMAGE_SIZE(image)));
+    if (!buf) { png_image_free(&image); return 1; }
+    if (!png_image_finish_read(&image, nullptr, buf, 0, nullptr)) {
+        png_image_free(&image);
+        std::free(buf);
+        return 1;
+    }
+    *out = buf; *h = H; *w = W; *c = C;
+    return 0;
+}
+
+int img_decode(const uint8_t* data, int len, uint8_t** out,
+               int* h, int* w, int* c) {
+    if (len < 4) return 1;
+    if (data[0] == 0xFF && data[1] == 0xD8)
+        return decode_jpeg(data, len, out, h, w, c);
+    if (data[0] == 0x89 && data[1] == 'P' && data[2] == 'N' && data[3] == 'G')
+        return decode_png(data, len, out, h, w, c);
+    return 2;  // unsupported container: caller falls back to OpenCV
+}
+
+// ---- unroll: HWC uint8 -> CHW float32 (+ optional BGR->RGB, affine) ----
+
+int img_unroll(const uint8_t* hwc, int h, int w, int c, float* out,
+               int to_rgb, float scale, float offset) {
+    const size_t plane = static_cast<size_t>(h) * w;
+    for (int ch = 0; ch < c; ch++) {
+        const int src_ch = (to_rgb && c == 3) ? (c - 1 - ch) : ch;
+        float* dst = out + static_cast<size_t>(ch) * plane;
+        const uint8_t* src = hwc + src_ch;
+        for (size_t i = 0; i < plane; i++)
+            dst[i] = static_cast<float>(src[i * c]) * scale + offset;
+    }
+    return 0;
+}
+
+// batched variant: N images, contiguous in and out
+int img_unroll_batch(const uint8_t* hwc, int n, int h, int w, int c,
+                     float* out, int to_rgb, float scale, float offset) {
+    const size_t in_stride = static_cast<size_t>(h) * w * c;
+    const size_t out_stride = in_stride;  // same element count
+    for (int i = 0; i < n; i++)
+        img_unroll(hwc + i * in_stride, h, w, c, out + i * out_stride,
+                   to_rgb, scale, offset);
+    return 0;
+}
+
+// ---- bilinear resize (uint8 HWC) ----
+
+int img_resize_bilinear(const uint8_t* in, int h, int w, int c,
+                        uint8_t* out, int oh, int ow) {
+    if (h <= 0 || w <= 0 || oh <= 0 || ow <= 0) return 1;
+    const float sy = oh > 1 ? static_cast<float>(h - 1) / (oh - 1) : 0.f;
+    const float sx = ow > 1 ? static_cast<float>(w - 1) / (ow - 1) : 0.f;
+    for (int y = 0; y < oh; y++) {
+        const float fy = y * sy;
+        const int y0 = static_cast<int>(fy);
+        const int y1 = y0 + 1 < h ? y0 + 1 : y0;
+        const float wy = fy - y0;
+        for (int x = 0; x < ow; x++) {
+            const float fx = x * sx;
+            const int x0 = static_cast<int>(fx);
+            const int x1 = x0 + 1 < w ? x0 + 1 : x0;
+            const float wx = fx - x0;
+            for (int ch = 0; ch < c; ch++) {
+                const float v00 = in[(static_cast<size_t>(y0) * w + x0) * c + ch];
+                const float v01 = in[(static_cast<size_t>(y0) * w + x1) * c + ch];
+                const float v10 = in[(static_cast<size_t>(y1) * w + x0) * c + ch];
+                const float v11 = in[(static_cast<size_t>(y1) * w + x1) * c + ch];
+                const float v = v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                                v10 * wy * (1 - wx) + v11 * wy * wx;
+                out[(static_cast<size_t>(y) * ow + x) * c + ch] =
+                    static_cast<uint8_t>(v + 0.5f);
+            }
+        }
+    }
+    return 0;
+}
+
+}  // extern "C"

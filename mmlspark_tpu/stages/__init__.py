@@ -1,0 +1,7 @@
+"""Data-prep pipeline stages.
+
+Analog of the reference's L4 layer: ``src/image-transformer/``,
+``src/featurize/``, ``src/text-featurizer/``, ``src/clean-missing-data/``,
+``src/data-conversion/``, ``src/value-indexer/``, ``src/pipeline-stages/``,
+etc.
+"""

@@ -139,8 +139,8 @@ class Trainer:
     """Minimal array-in training driver used by the learners and bench.
 
     Handles mesh creation, state init, epoch loops, and loss tracking. The
-    estimator-level API (featurize → train → scored model) lives in
-    :mod:`mmlspark_tpu.train.classifier`.
+    estimator-level one-call API (featurize → train → scored model) builds
+    on this in the train package's classifier/regressor stages.
     """
 
     def __init__(self, module: Any, cfg: TrainConfig | None = None,
