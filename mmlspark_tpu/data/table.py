@@ -26,6 +26,19 @@ from typing import Any, Callable, Iterable, Iterator, Mapping, Sequence
 import numpy as np
 
 
+def is_missing(v: Any) -> bool:
+    """True for None and float NaN of any width (Python float or np.floating).
+
+    The single missing-value predicate shared by all stages (imputation,
+    indexing, profiling, conversion) so semantics cannot drift.
+    """
+    if v is None:
+        return True
+    if isinstance(v, (float, np.floating)):
+        return bool(np.isnan(v))
+    return False
+
+
 def _object_column(values: Any) -> np.ndarray:
     out = np.empty(len(values), dtype=object)
     for i, v in enumerate(values):

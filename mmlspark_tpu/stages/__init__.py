@@ -5,3 +5,31 @@ Analog of the reference's L4 layer: ``src/image-transformer/``,
 ``src/data-conversion/``, ``src/value-indexer/``, ``src/pipeline-stages/``,
 etc.
 """
+
+from mmlspark_tpu.stages.conversion import DataConversion
+from mmlspark_tpu.stages.ensemble import EnsembleByKey
+from mmlspark_tpu.stages.image import (
+    ImageSetAugmenter, ImageTransformer, UnrollImage,
+)
+from mmlspark_tpu.stages.indexers import (
+    IndexToValue, ValueIndexer, ValueIndexerModel,
+)
+from mmlspark_tpu.stages.missing import (
+    CleanMissingData, CleanMissingDataModel,
+)
+from mmlspark_tpu.stages.sampling import PartitionSample
+from mmlspark_tpu.stages.summarize import SummarizeData
+from mmlspark_tpu.stages.utility import (
+    Cacher, CheckpointData, ClassBalancer, ClassBalancerModel, DropColumns,
+    MultiColumnAdapter, RenameColumns, Repartition, SelectColumns, Timer,
+    TimerModel,
+)
+
+__all__ = [
+    "Cacher", "CheckpointData", "ClassBalancer", "ClassBalancerModel",
+    "CleanMissingData", "CleanMissingDataModel", "DataConversion",
+    "DropColumns", "EnsembleByKey", "ImageSetAugmenter", "ImageTransformer",
+    "IndexToValue", "MultiColumnAdapter", "PartitionSample", "RenameColumns",
+    "Repartition", "SelectColumns", "SummarizeData", "Timer", "TimerModel",
+    "UnrollImage", "ValueIndexer", "ValueIndexerModel",
+]

@@ -1,0 +1,95 @@
+"""DataConversion — column type conversion transformer.
+
+Analog of the reference's ``src/data-conversion/`` (reference:
+DataConversion.scala:17-130): converts a set of columns to a target type —
+boolean/int/long/float/double/string/date — or to/from categorical codes
+(``toCategorical`` delegates to :class:`ValueIndexer`, ``clearCategorical``
+to :class:`IndexToValue`).
+"""
+
+from __future__ import annotations
+
+from datetime import datetime
+from typing import Any
+
+import numpy as np
+
+from mmlspark_tpu.core.params import Param
+from mmlspark_tpu.core.stage import Transformer
+from mmlspark_tpu.data.table import DataTable, is_missing
+
+CONVERSIONS = ("boolean", "byte", "short", "integer", "long", "float",
+               "double", "string", "date", "toCategorical",
+               "clearCategorical")
+
+_NUMPY_TARGETS = {
+    "boolean": np.bool_, "byte": np.int8, "short": np.int16,
+    "integer": np.int32, "long": np.int64, "float": np.float32,
+    "double": np.float64,
+}
+
+
+def _to_date(v: Any, fmt: str) -> Any:
+    if is_missing(v):
+        return None
+    if isinstance(v, datetime):
+        return v
+    if isinstance(v, (int, float, np.number)):
+        return datetime.fromtimestamp(float(v))
+    return datetime.strptime(str(v), fmt)
+
+
+class DataConversion(Transformer):
+    cols = Param(default=None, doc="columns to convert",
+                 type_=(list, tuple))
+    convert_to = Param(default="double", doc="target type",
+                       type_=str, validator=Param.one_of(*CONVERSIONS))
+    date_time_format = Param(default="%Y-%m-%d %H:%M:%S",
+                             doc="strptime format for date conversion",
+                             type_=str)
+
+    def transform(self, table: DataTable) -> DataTable:
+        target = self.convert_to
+        out = table
+        for col in (self.cols or []):
+            if target == "toCategorical":
+                from mmlspark_tpu.stages.indexers import ValueIndexer
+                model = ValueIndexer(input_col=col, output_col=col).fit(out)
+                out = model.transform(out)
+            elif target == "clearCategorical":
+                from mmlspark_tpu.core.schema import SchemaConstants
+                from mmlspark_tpu.stages.indexers import IndexToValue
+                out = IndexToValue(input_col=col, output_col=col).transform(out)
+                stale = {SchemaConstants.K_CATEGORICAL_LEVELS,
+                         SchemaConstants.K_IS_CATEGORICAL}
+                out.meta[col] = {k: v for k, v in out.column_meta(col).items()
+                                 if k not in stale}
+            elif target == "date":
+                fmt = self.date_time_format
+                out = out.with_column(
+                    col, [_to_date(v, fmt) for v in out[col]])
+            elif target == "string":
+                out = out.with_column(
+                    col, ["" if v is None else str(v) for v in out[col]])
+            else:
+                dtype = _NUMPY_TARGETS[target]
+                src = out[col]
+                if src.dtype == object:
+                    first = next((v for v in src if not is_missing(v)), None)
+                    if isinstance(first, datetime):
+                        vals = [np.nan if is_missing(v) else v.timestamp()
+                                for v in src]
+                    else:
+                        vals = [np.nan if is_missing(v) else float(v)
+                                for v in src]
+                    src = np.asarray(vals, dtype=np.float64)
+                # numpy int/bool cannot represent missing — casting NaN would
+                # silently write INT_MIN garbage, so fail loudly instead
+                if (not np.issubdtype(dtype, np.floating)
+                        and np.issubdtype(src.dtype, np.floating)
+                        and np.isnan(src).any()):
+                    raise ValueError(
+                        f"column {col!r} has missing values; impute "
+                        f"(CleanMissingData) before converting to {target}")
+                out = out.with_column(col, src.astype(dtype))
+        return out

@@ -1,0 +1,88 @@
+"""EnsembleByKey — group-by-key score averaging.
+
+Analog of the reference's ``src/ensemble/`` (reference:
+EnsembleByKey.scala:20-140): groups rows by key column(s) and replaces the
+chosen score columns by their per-group mean (vector or scalar). With
+``collapse_group`` the output has one row per group; otherwise the group
+mean is broadcast back onto every row.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from mmlspark_tpu.core.params import Param
+from mmlspark_tpu.core.stage import Transformer
+from mmlspark_tpu.data.table import DataTable
+
+
+class EnsembleByKey(Transformer):
+    keys = Param(default=None, doc="key columns to group by",
+                 type_=(list, tuple))
+    cols = Param(default=None, doc="score columns to ensemble",
+                 type_=(list, tuple))
+    col_names = Param(default=None, doc="output names per score column",
+                      type_=(list, tuple))
+    strategy = Param(default="mean", doc="ensembling strategy", type_=str,
+                     validator=Param.one_of("mean"))
+    collapse_group = Param(default=True,
+                           doc="one output row per group", type_=bool)
+
+    def transform(self, table: DataTable) -> DataTable:
+        keys = list(self.keys or [])
+        cols = list(self.cols or [])
+        if not keys or not cols:
+            raise ValueError("keys and cols must be set")
+        names = list(self.col_names or
+                     [f"{self.strategy}({c})" for c in cols])
+        if len(names) != len(cols):
+            raise ValueError("col_names and cols length mismatch")
+
+        key_arrays = [table[k] for k in keys]
+        group_ids: dict[tuple, int] = {}
+        row_group = np.empty(len(table), dtype=np.int64)
+        group_rows: list[list[int]] = []  # one grouping pass, reused below
+        for i in range(len(table)):
+            key = tuple(a[i].item() if isinstance(a[i], np.generic) else a[i]
+                        for a in key_arrays)
+            g = group_ids.setdefault(key, len(group_ids))
+            if g == len(group_rows):
+                group_rows.append([])
+            group_rows[g].append(i)
+            row_group[i] = g
+        n_groups = len(group_ids)
+        group_idx = [np.asarray(rows, dtype=np.intp) for rows in group_rows]
+
+        # per-group means; vector cells stack into a matrix mean
+        means: dict[str, list[Any]] = {}
+        for col in cols:
+            data = table[col]
+            is_vec = data.dtype == object
+            acc: list[Any] = []
+            for idx in group_idx:
+                if is_vec:
+                    acc.append(np.mean(
+                        np.stack([np.asarray(data[i], dtype=np.float64)
+                                  for i in idx]), axis=0))
+                else:
+                    acc.append(float(np.mean(data[idx].astype(np.float64))))
+            means[col] = acc
+
+        if self.collapse_group:
+            out_cols: dict[str, Any] = {}
+            first_row = np.asarray([idx[0] for idx in group_idx],
+                                   dtype=np.intp)
+            for k, arr in zip(keys, key_arrays):
+                out_cols[k] = arr[first_row]
+            for col, name in zip(cols, names):
+                out_cols[name] = means[col]
+            return DataTable(out_cols, {k: table.column_meta(k)
+                                        for k in keys if table.column_meta(k)})
+
+        out = table
+        for col, name in zip(cols, names):
+            vals = [means[col][g] for g in row_group]
+            out = out.with_column(name, vals)
+        return out

@@ -1,0 +1,102 @@
+"""ValueIndexer / IndexToValue — categorical indexing for any value type.
+
+Analog of the reference's ``src/value-indexer/`` (reference:
+ValueIndexer.scala:63-120, IndexToValue.scala:26-46): a StringIndexer
+generalized to int/long/double/string/bool columns, whose fitted levels are
+stored in the column's sidecar metadata (the Spark column-metadata analog,
+see :mod:`mmlspark_tpu.core.schema`), with an inverse transform reading the
+levels back from metadata.
+
+TPU-first notes: indexing is a vectorized ``np.searchsorted`` over sorted
+levels (O(n log k) with no per-row Python), and the produced int32 codes are
+directly usable as embedding/one-hot indices in device batches.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from mmlspark_tpu.core.params import Param
+from mmlspark_tpu.core.schema import SchemaConstants, set_categorical_levels
+from mmlspark_tpu.core.stage import (
+    Estimator, HasInputCol, HasOutputCol, Transformer,
+)
+from mmlspark_tpu.data.table import DataTable, is_missing
+
+
+def sorted_levels(values: np.ndarray) -> list:
+    """Distinct values sorted ascending, None/NaN first (NullOrdering analog,
+    reference: ValueIndexer.scala:37-48)."""
+    has_null = False
+    distinct: set = set()
+    for v in values:
+        if is_missing(v):
+            has_null = True
+        else:
+            distinct.add(v.item() if isinstance(v, np.generic) else v)
+    out = sorted(distinct)
+    return ([None] + out) if has_null else out
+
+
+def index_values(values: np.ndarray, levels: list) -> np.ndarray:
+    """Vectorized value→code lookup; unseen values map to -1."""
+    null_offset = 1 if (levels and levels[0] is None) else 0
+    core = levels[null_offset:]
+    n = len(values)
+    codes = np.full(n, -1, dtype=np.int32)
+    null_mask = np.fromiter(
+        (is_missing(v) for v in values), dtype=bool, count=n)
+    if null_offset:
+        codes[null_mask] = 0
+    if core:
+        arr = np.asarray([v for v, m in zip(values, null_mask) if not m])
+        if len(arr):
+            key = np.asarray(core)
+            pos = np.searchsorted(key, arr)
+            pos = np.clip(pos, 0, len(core) - 1)
+            found = key[pos] == arr
+            filled = np.where(found, pos + null_offset, -1).astype(np.int32)
+            codes[~null_mask] = filled
+    return codes
+
+
+class ValueIndexer(Estimator, HasInputCol, HasOutputCol):
+    """Fits the sorted dictionary of distinct values of the input column.
+
+    The model converts the column to int32 categorical codes and stamps the
+    levels into the output column's metadata.
+    """
+
+    def fit(self, table: DataTable) -> "ValueIndexerModel":
+        levels = sorted_levels(table[self.input_col])
+        return ValueIndexerModel(
+            input_col=self.input_col, output_col=self.output_col,
+            levels=levels)
+
+
+class ValueIndexerModel(Transformer, HasInputCol, HasOutputCol):
+    levels = Param(default=None, doc="sorted categorical levels",
+                   type_=(list, tuple))
+
+    def transform(self, table: DataTable) -> DataTable:
+        codes = index_values(table[self.input_col], list(self.levels))
+        out = table.with_column(self.output_col, codes)
+        return set_categorical_levels(out, self.output_col, list(self.levels))
+
+
+class IndexToValue(Transformer, HasInputCol, HasOutputCol):
+    """Inverse of ValueIndexerModel: codes → original values, levels read
+    from the input column's metadata (reference: IndexToValue.scala:26-46)."""
+
+    def transform(self, table: DataTable) -> DataTable:
+        meta = table.column_meta(self.input_col)
+        levels = meta.get(SchemaConstants.K_CATEGORICAL_LEVELS)
+        if levels is None:
+            raise ValueError(
+                f"column {self.input_col!r} carries no categorical levels; "
+                "run ValueIndexer first")
+        codes = np.asarray(table[self.input_col], dtype=np.int64)
+        values = [levels[c] if 0 <= c < len(levels) else None for c in codes]
+        return table.with_column(self.output_col, values)

