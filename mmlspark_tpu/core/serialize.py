@@ -70,9 +70,14 @@ def _is_pytree_of_arrays(v: Any) -> bool:
         return False
     if not leaves:
         return isinstance(v, (dict, list, tuple))
-    return all(isinstance(l, (np.ndarray, np.generic, int, float, bool))
-               or type(l).__module__.startswith("jax")
-               for l in leaves)
+    def is_array(l: Any) -> bool:
+        return (isinstance(l, (np.ndarray, np.generic))
+                or type(l).__module__.startswith("jax"))
+    # require at least one real array leaf: containers of plain Python
+    # scalars round-trip exactly via pickle, whereas msgpack restore would
+    # turn every scalar leaf into an ndarray
+    return any(is_array(l) for l in leaves) and all(
+        is_array(l) or isinstance(l, (int, float, bool)) for l in leaves)
 
 
 def save_value(value: Any, directory: str) -> None:

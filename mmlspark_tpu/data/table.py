@@ -39,6 +39,13 @@ def is_missing(v: Any) -> bool:
     return False
 
 
+def to_py_scalar(v: Any) -> Any:
+    """Unwrap a NumPy scalar to the equivalent Python scalar (pass-through
+    otherwise) — the shared idiom for building dict keys / JSON values from
+    column cells."""
+    return v.item() if isinstance(v, np.generic) else v
+
+
 def _object_column(values: Any) -> np.ndarray:
     out = np.empty(len(values), dtype=object)
     for i, v in enumerate(values):

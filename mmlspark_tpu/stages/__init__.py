@@ -8,6 +8,9 @@ etc.
 
 from mmlspark_tpu.stages.conversion import DataConversion
 from mmlspark_tpu.stages.ensemble import EnsembleByKey
+from mmlspark_tpu.stages.featurize import (
+    AssembleFeatures, AssembleFeaturesModel, Featurize,
+)
 from mmlspark_tpu.stages.image import (
     ImageSetAugmenter, ImageTransformer, UnrollImage,
 )
@@ -19,6 +22,10 @@ from mmlspark_tpu.stages.missing import (
 )
 from mmlspark_tpu.stages.sampling import PartitionSample
 from mmlspark_tpu.stages.summarize import SummarizeData
+from mmlspark_tpu.stages.text import (
+    IDF, IDFModel, HashingTF, NGram, StopWordsRemover, TextFeaturizer,
+    Tokenizer,
+)
 from mmlspark_tpu.stages.utility import (
     Cacher, CheckpointData, ClassBalancer, ClassBalancerModel, DropColumns,
     MultiColumnAdapter, RenameColumns, Repartition, SelectColumns, Timer,
@@ -26,10 +33,13 @@ from mmlspark_tpu.stages.utility import (
 )
 
 __all__ = [
-    "Cacher", "CheckpointData", "ClassBalancer", "ClassBalancerModel",
-    "CleanMissingData", "CleanMissingDataModel", "DataConversion",
-    "DropColumns", "EnsembleByKey", "ImageSetAugmenter", "ImageTransformer",
-    "IndexToValue", "MultiColumnAdapter", "PartitionSample", "RenameColumns",
-    "Repartition", "SelectColumns", "SummarizeData", "Timer", "TimerModel",
-    "UnrollImage", "ValueIndexer", "ValueIndexerModel",
+    "AssembleFeatures", "AssembleFeaturesModel", "Cacher", "CheckpointData",
+    "ClassBalancer", "ClassBalancerModel", "CleanMissingData",
+    "CleanMissingDataModel", "DataConversion", "DropColumns", "EnsembleByKey",
+    "Featurize", "HashingTF", "IDF", "IDFModel", "ImageSetAugmenter",
+    "ImageTransformer", "IndexToValue", "MultiColumnAdapter", "NGram",
+    "PartitionSample", "RenameColumns", "Repartition", "SelectColumns",
+    "StopWordsRemover", "SummarizeData", "TextFeaturizer", "Timer",
+    "TimerModel", "Tokenizer", "UnrollImage", "ValueIndexer",
+    "ValueIndexerModel",
 ]

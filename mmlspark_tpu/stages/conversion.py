@@ -70,7 +70,8 @@ class DataConversion(Transformer):
                     col, [_to_date(v, fmt) for v in out[col]])
             elif target == "string":
                 out = out.with_column(
-                    col, ["" if v is None else str(v) for v in out[col]])
+                    col, [None if is_missing(v) else str(v)
+                          for v in out[col]])
             else:
                 dtype = _NUMPY_TARGETS[target]
                 src = out[col]

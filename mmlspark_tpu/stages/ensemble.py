@@ -15,7 +15,7 @@ import numpy as np
 
 from mmlspark_tpu.core.params import Param
 from mmlspark_tpu.core.stage import Transformer
-from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.data.table import DataTable, is_missing, to_py_scalar
 
 
 class EnsembleByKey(Transformer):
@@ -45,7 +45,10 @@ class EnsembleByKey(Transformer):
         row_group = np.empty(len(table), dtype=np.int64)
         group_rows: list[list[int]] = []  # one grouping pass, reused below
         for i in range(len(table)):
-            key = tuple(a[i].item() if isinstance(a[i], np.generic) else a[i]
+            # missing keys normalize to None so all NaN/null rows form ONE
+            # group (Spark groupBy null semantics); NaN != NaN would
+            # otherwise give every missing-key row its own group
+            key = tuple(None if is_missing(a[i]) else to_py_scalar(a[i])
                         for a in key_arrays)
             g = group_ids.setdefault(key, len(group_ids))
             if g == len(group_rows):

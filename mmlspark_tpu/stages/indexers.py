@@ -23,7 +23,7 @@ from mmlspark_tpu.core.schema import SchemaConstants, set_categorical_levels
 from mmlspark_tpu.core.stage import (
     Estimator, HasInputCol, HasOutputCol, Transformer,
 )
-from mmlspark_tpu.data.table import DataTable, is_missing
+from mmlspark_tpu.data.table import DataTable, is_missing, to_py_scalar
 
 
 def sorted_levels(values: np.ndarray) -> list:
@@ -35,7 +35,7 @@ def sorted_levels(values: np.ndarray) -> list:
         if is_missing(v):
             has_null = True
         else:
-            distinct.add(v.item() if isinstance(v, np.generic) else v)
+            distinct.add(to_py_scalar(v))
     out = sorted(distinct)
     return ([None] + out) if has_null else out
 

@@ -1,0 +1,226 @@
+"""Text featurization: Tokenizer → StopWordsRemover → NGram → HashingTF → IDF.
+
+Analog of the reference's ``src/text-featurizer/`` (reference:
+TextFeaturizer.scala:18-280), which composes SparkML feature stages into a
+param-gated pipeline. Here each sub-stage is its own vectorized transformer
+(so they are also usable standalone, as the reference's core/ml tests use
+Spark's) and :class:`TextFeaturizer` is the estimator that wires them by
+flags.
+
+TPU-first notes: hashing uses a stable CRC32 (process-independent, so fitted
+models round-trip), term frequencies land in a **dense float32 matrix** of
+``num_features`` slots — dense rows feed the MXU directly; use
+AssembleFeatures' non-zero slot selection to keep dims small rather than
+sparse vectors.
+"""
+
+from __future__ import annotations
+
+import re
+import zlib
+from typing import Any, Iterable, Sequence
+
+import numpy as np
+
+from mmlspark_tpu.core.params import Param
+from mmlspark_tpu.core.pipeline import Pipeline, PipelineModel
+from mmlspark_tpu.core.schema import SchemaConstants
+from mmlspark_tpu.core.stage import (
+    Estimator, HasInputCol, HasOutputCol, Transformer, UnaryTransformer,
+)
+from mmlspark_tpu.data.table import DataTable
+
+# A compact English stop-word list (SparkML ships per-language lists; the
+# "english" default is what the reference's defaultStopWordLanguage uses).
+ENGLISH_STOP_WORDS = frozenset("""
+a about above after again against all am an and any are as at be because been
+before being below between both but by could did do does doing down during
+each few for from further had has have having he her here hers herself him
+himself his how i if in into is it its itself just me more most my myself no
+nor not now of off on once only or other our ours ourselves out over own same
+she should so some such than that the their theirs them themselves then there
+these they this those through to too under until up very was we were what when
+where which while who whom why will with you your yours yourself yourselves
+""".split())
+
+
+def hash_term(term: str, num_features: int) -> int:
+    """Stable term → slot index (HashingTF analog; CRC32 instead of murmur3
+    — both are uniform enough and CRC32 is C-speed in the stdlib)."""
+    return zlib.crc32(term.encode("utf-8")) % num_features
+
+
+class Tokenizer(UnaryTransformer):
+    """Regex tokenizer: splits on gaps or matches tokens
+    (RegexTokenizer analog)."""
+
+    gaps = Param(default=True, doc="regex splits on gaps (true) or matches "
+                 "tokens (false)", type_=bool)
+    pattern = Param(default=r"\s+", doc="delimiter (gaps) or token pattern",
+                    type_=str)
+    to_lowercase = Param(default=True, doc="lowercase before tokenizing",
+                         type_=bool)
+    min_token_length = Param(default=1, doc="minimum token length",
+                             type_=int, validator=Param.ge(0))
+
+    def _tokenize_one(self, text: Any, rx: re.Pattern) -> list[str]:
+        s = "" if text is None else str(text)
+        if self.to_lowercase:
+            s = s.lower()
+        toks = rx.split(s) if self.gaps else rx.findall(s)
+        return [t for t in toks if len(t) >= self.min_token_length]
+
+    def _transform_column(self, values: np.ndarray, table: DataTable) -> Any:
+        rx = re.compile(self.pattern)
+        return [self._tokenize_one(v, rx) for v in values]
+
+
+class StopWordsRemover(UnaryTransformer):
+    stop_words = Param(default=None, doc="words to filter out (None = "
+                       "built-in English list)", type_=(list, tuple))
+    case_sensitive = Param(default=False, doc="case-sensitive comparison",
+                           type_=bool)
+
+    def _transform_column(self, values: np.ndarray, table: DataTable) -> Any:
+        words = (set(self.stop_words) if self.stop_words is not None
+                 else set(ENGLISH_STOP_WORDS))
+        if not self.case_sensitive:
+            words = {w.lower() for w in words}
+            return [[t for t in toks if t.lower() not in words]
+                    for toks in values]
+        return [[t for t in toks if t not in words] for toks in values]
+
+
+class NGram(UnaryTransformer):
+    n = Param(default=2, doc="n-gram length", type_=int,
+              validator=Param.gt(0))
+
+    def _transform_column(self, values: np.ndarray, table: DataTable) -> Any:
+        n = self.n
+        return [[" ".join(toks[i:i + n]) for i in range(len(toks) - n + 1)]
+                for toks in values]
+
+
+class HashingTF(UnaryTransformer):
+    """Token list → dense term-frequency row of ``num_features`` slots."""
+
+    num_features = Param(default=1 << 12, doc="number of hash buckets",
+                         type_=int, validator=Param.gt(0))
+    binary = Param(default=False, doc="clip all counts to 1", type_=bool)
+
+    def _transform_column(self, values: np.ndarray, table: DataTable) -> Any:
+        n = self.num_features
+        out = np.zeros((len(values), n), dtype=np.float32)
+        for i, toks in enumerate(values):
+            for t in toks:
+                out[i, hash_term(t, n)] += 1.0
+        if self.binary:
+            np.minimum(out, 1.0, out=out)
+        return out
+
+    def transform(self, table: DataTable) -> DataTable:
+        mat = self._transform_column(table[self.input_col], table)
+        out = table.with_column(self.output_col, mat)
+        return out.with_meta(
+            self.output_col,
+            **{SchemaConstants.K_VECTOR_SIZE: self.num_features})
+
+
+class IDF(Estimator, HasInputCol, HasOutputCol):
+    """Inverse-document-frequency scaling over a TF vector column.
+
+    Uses Spark's formula idf = log((m + 1) / (df + 1)).
+    """
+
+    min_doc_freq = Param(default=0, doc="minimum number of documents a term "
+                         "must appear in", type_=int, validator=Param.ge(0))
+
+    def fit(self, table: DataTable) -> "IDFModel":
+        tf = table.column_matrix(self.input_col, dtype=np.float64)
+        m = tf.shape[0]
+        df = (tf > 0).sum(axis=0)
+        idf = np.log((m + 1.0) / (df + 1.0))
+        if self.min_doc_freq > 0:
+            idf = np.where(df >= self.min_doc_freq, idf, 0.0)
+        return IDFModel(input_col=self.input_col, output_col=self.output_col,
+                        idf=idf.astype(np.float32))
+
+
+class IDFModel(Transformer, HasInputCol, HasOutputCol):
+    idf = Param(default=None, doc="per-slot idf weights", is_complex=True)
+
+    def transform(self, table: DataTable) -> DataTable:
+        tf = table.column_matrix(self.input_col, dtype=np.float32)
+        out = table.with_column(self.output_col, tf * self.idf[None, :])
+        return out.with_meta(
+            self.output_col,
+            **{SchemaConstants.K_VECTOR_SIZE: int(len(self.idf))})
+
+
+class TextFeaturizer(Estimator, HasInputCol, HasOutputCol):
+    """One-call text → feature-vector pipeline, param-gated like the
+    reference (reference: TextFeaturizer.scala:183-280)."""
+
+    use_tokenizer = Param(default=True, doc="tokenize the input", type_=bool)
+    tokenizer_gaps = Param(default=True, doc="regex splits on gaps", type_=bool)
+    tokenizer_pattern = Param(default=r"\s+", doc="tokenizer regex", type_=str)
+    to_lowercase = Param(default=True, doc="lowercase first", type_=bool)
+    min_token_length = Param(default=1, doc="min token length", type_=int)
+    use_stop_words_remover = Param(default=False, doc="remove stop words",
+                                   type_=bool)
+    case_sensitive_stop_words = Param(default=False,
+                                      doc="case-sensitive stop words",
+                                      type_=bool)
+    stop_words = Param(default=None, doc="custom stop words",
+                       type_=(list, tuple))
+    use_ngram = Param(default=False, doc="enumerate n-grams", type_=bool)
+    ngram_length = Param(default=2, doc="n-gram length", type_=int)
+    binary = Param(default=False, doc="clip term counts to 1", type_=bool)
+    num_features = Param(default=1 << 12, doc="hash buckets", type_=int)
+    use_idf = Param(default=True, doc="scale TF by IDF", type_=bool)
+    min_doc_freq = Param(default=1, doc="min document frequency", type_=int)
+
+    def fit(self, table: DataTable) -> PipelineModel:
+        col, out = self.input_col, self.output_col
+        stages: list = []
+        cur = col
+        if self.use_tokenizer:
+            stages.append(Tokenizer(
+                input_col=cur, output_col="__tokens",
+                gaps=self.tokenizer_gaps, pattern=self.tokenizer_pattern,
+                to_lowercase=self.to_lowercase,
+                min_token_length=self.min_token_length))
+            cur = "__tokens"
+        if self.use_stop_words_remover:
+            stages.append(StopWordsRemover(
+                input_col=cur, output_col="__nostop",
+                stop_words=list(self.stop_words) if self.stop_words else None,
+                case_sensitive=self.case_sensitive_stop_words))
+            cur = "__nostop"
+        if self.use_ngram:
+            stages.append(NGram(input_col=cur, output_col="__ngrams",
+                                n=self.ngram_length))
+            cur = "__ngrams"
+        tf_out = "__tf" if self.use_idf else out
+        stages.append(HashingTF(input_col=cur, output_col=tf_out,
+                                num_features=self.num_features,
+                                binary=self.binary))
+        if self.use_idf:
+            stages.append(IDF(input_col=tf_out, output_col=out,
+                              min_doc_freq=self.min_doc_freq))
+        model = Pipeline(stages).fit(table)
+        # hide intermediate columns from the final output
+        intermediates = [c for c in
+                         ("__tokens", "__nostop", "__ngrams", "__tf")
+                         if c != out]
+        return PipelineModel(stages=list(model.stages) +
+                             [_DropIfPresent(cols=intermediates)])
+
+
+class _DropIfPresent(Transformer):
+    cols = Param(default=None, doc="columns to drop when present",
+                 type_=(list, tuple))
+
+    def transform(self, table: DataTable) -> DataTable:
+        present = [c for c in (self.cols or []) if c in table]
+        return table.drop(*present) if present else table

@@ -26,7 +26,7 @@ from mmlspark_tpu.core.stage import (
     Estimator, HasInputCol, HasLabelCol, HasOutputCol, PipelineStage,
     Transformer,
 )
-from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.data.table import DataTable, to_py_scalar
 
 _log = get_logger("stages.utility")
 
@@ -80,7 +80,9 @@ class Cacher(Transformer):
 class CheckpointData(Transformer):
     """Persist the table to disk (parquet via Arrow) and reload — the analog
     of persist/unpersist with a Hive writer. ``remove_checkpoint`` deletes
-    the file after reload."""
+    the file after reload. Note: vector cells stored as ndarrays come back
+    as Python lists (the Arrow round-trip loses the NumPy wrapper; numeric
+    consumers go through ``column_matrix`` which accepts both)."""
 
     path = Param(default=None, doc="checkpoint file path (.parquet)",
                  type_=str)
@@ -93,6 +95,7 @@ class CheckpointData(Transformer):
         import pyarrow.parquet as pq
         pq.write_table(table.to_arrow(), self.path)
         out = DataTable.from_arrow(pq.read_table(self.path), table.meta)
+        out.num_partitions = table.num_partitions
         if self.remove_checkpoint:
             os.unlink(self.path)
         return out
@@ -106,10 +109,8 @@ class ClassBalancer(Estimator, HasInputCol, HasOutputCol):
         col = table[self.input_col]
         values, counts = np.unique(col, return_counts=True)
         top = counts.max() if len(counts) else 1
-        weights = {
-            (v.item() if isinstance(v, np.generic) else v):
-                float(top) / float(c)
-            for v, c in zip(values, counts)}
+        weights = {to_py_scalar(v): float(top) / float(c)
+                   for v, c in zip(values, counts)}
         return ClassBalancerModel(
             input_col=self.input_col, output_col=self.output_col,
             weights=weights)
@@ -122,16 +123,20 @@ class ClassBalancerModel(Transformer, HasInputCol, HasOutputCol):
 
     def transform(self, table: DataTable) -> DataTable:
         col = table[self.input_col]
-        w = np.asarray([
-            self.weights[v.item() if isinstance(v, np.generic) else v]
-            for v in col], dtype=np.float64)
+        try:
+            w = np.asarray([self.weights[to_py_scalar(v)] for v in col],
+                           dtype=np.float64)
+        except KeyError as e:
+            raise ValueError(
+                f"column {self.input_col!r} contains class value {e.args[0]!r}"
+                " not seen when ClassBalancer was fit; known classes: "
+                f"{sorted(map(str, self.weights))}") from None
         return table.with_column(self.output_col, w)
 
 
 class Timer(Estimator):
     """Wraps a stage and logs wall-time of its fit/transform
-    (reference: Timer.scala:54-123). ``log_to_table`` additionally records
-    the timing as a column on the output for test capture."""
+    (reference: Timer.scala:54-123)."""
 
     stage = Param(default=None, doc="the wrapped stage", is_complex=True)
     log_to_console = Param(default=True, doc="print timing lines", type_=bool)

@@ -236,6 +236,12 @@ class TestUtilityStages:
         out = model.transform(t)
         np.testing.assert_allclose(out["w"], [1.0, 1.0, 1.0, 3.0])
 
+    def test_class_balancer_unseen_value_message(self):
+        t = DataTable({"y": np.array([0, 1])})
+        model = ClassBalancer(input_col="y", output_col="w").fit(t)
+        with pytest.raises(ValueError, match="not seen"):
+            model.transform(DataTable({"y": np.array([2])}))
+
     def test_class_balancer_int_keys_roundtrip(self, tmp_path):
         t = DataTable({"y": np.array([0, 0, 1])})
         model = ClassBalancer(input_col="y", output_col="w").fit(t)
@@ -296,6 +302,15 @@ class TestEnsembleByKey:
         out = EnsembleByKey(keys=["k"], cols=["score"]).transform(t)
         rows = {r["k"]: r["mean(score)"] for r in out.to_rows()}
         assert rows == {"a": 2.0, "b": 5.0}
+
+    def test_nan_keys_form_one_group(self):
+        t = DataTable({"k": np.array([np.nan, np.nan, 1.0]),
+                       "s": np.array([1.0, 3.0, 5.0])})
+        out = EnsembleByKey(keys=["k"], cols=["s"]).transform(t)
+        assert len(out) == 2
+        by_key = {r["k"] if r["k"] == r["k"] else None: r["mean(s)"]
+                  for r in out.to_rows()}
+        assert by_key[None] == 2.0 and by_key[1.0] == 5.0
 
     def test_vector_no_collapse(self):
         t = DataTable({

@@ -1,0 +1,209 @@
+"""Tests for text featurization and automatic mixed-type featurization
+(SURVEY §2.3 featurize / text-featurizer parity; mirrors the reference's
+TextFeaturizerSpec and featurize benchmark fixtures)."""
+
+from datetime import datetime
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.schema import SchemaConstants
+from mmlspark_tpu.core.stage import PipelineStage
+from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.stages import (
+    AssembleFeatures, Featurize, HashingTF, IDF, NGram, StopWordsRemover,
+    TextFeaturizer, Tokenizer, ValueIndexer,
+)
+
+from conftest import make_tabular
+
+
+class TestTokenizer:
+    def test_gaps(self):
+        t = DataTable({"s": ["Hello World", "ONE  two  three"]})
+        out = Tokenizer(input_col="s", output_col="t").transform(t)
+        assert out["t"][0] == ["hello", "world"]
+        assert out["t"][1] == ["one", "two", "three"]
+
+    def test_token_match_mode(self):
+        t = DataTable({"s": ["a1 b2 c3"]})
+        out = Tokenizer(input_col="s", output_col="t", gaps=False,
+                        pattern=r"[a-z]+\d").transform(t)
+        assert out["t"][0] == ["a1", "b2", "c3"]
+
+    def test_min_token_length_and_none(self):
+        t = DataTable({"s": ["a bb ccc", None]})
+        out = Tokenizer(input_col="s", output_col="t",
+                        min_token_length=2).transform(t)
+        assert out["t"][0] == ["bb", "ccc"]
+        assert out["t"][1] == []
+
+
+class TestStopWordsAndNGram:
+    def test_stop_words_default(self):
+        t = DataTable({"toks": [["the", "cat", "and", "dog"]]})
+        out = StopWordsRemover(input_col="toks",
+                               output_col="o").transform(t)
+        assert out["o"][0] == ["cat", "dog"]
+
+    def test_stop_words_custom_case(self):
+        t = DataTable({"toks": [["Foo", "bar"]]})
+        out = StopWordsRemover(input_col="toks", output_col="o",
+                               stop_words=["foo"],
+                               case_sensitive=True).transform(t)
+        assert out["o"][0] == ["Foo", "bar"]
+
+    def test_ngram(self):
+        t = DataTable({"toks": [["a", "b", "c"]]})
+        out = NGram(input_col="toks", output_col="o", n=2).transform(t)
+        assert out["o"][0] == ["a b", "b c"]
+
+
+class TestHashingTFIDF:
+    def test_tf_counts(self):
+        t = DataTable({"toks": [["x", "x", "y"], ["z"]]})
+        out = HashingTF(input_col="toks", output_col="tf",
+                        num_features=64).transform(t)
+        mat = out.column_matrix("tf")
+        assert mat.shape == (2, 64)
+        assert mat[0].sum() == 3.0 and mat[0].max() == 2.0
+        assert mat[1].sum() == 1.0
+        assert out.column_meta("tf")[SchemaConstants.K_VECTOR_SIZE] == 64
+
+    def test_binary(self):
+        t = DataTable({"toks": [["x", "x"]]})
+        out = HashingTF(input_col="toks", output_col="tf", num_features=8,
+                        binary=True).transform(t)
+        assert out.column_matrix("tf").max() == 1.0
+
+    def test_idf_downweights_common_terms(self):
+        t = DataTable({"toks": [["common", "rare"], ["common"],
+                                ["common", "other"]]})
+        tf = HashingTF(input_col="toks", output_col="tf",
+                       num_features=128).transform(t)
+        model = IDF(input_col="tf", output_col="tfidf").fit(tf)
+        out = model.transform(tf)
+        mat = out.column_matrix("tfidf")
+        slot_common = np.argmax(tf.column_matrix("tf").sum(axis=0))
+        # the common term (df=3) gets the lowest idf weight
+        nz = model.idf[np.unique(np.nonzero(tf.column_matrix("tf"))[1])]
+        assert model.idf[slot_common] == nz.min()
+        assert mat.shape == (3, 128)
+
+
+class TestTextFeaturizer:
+    def test_end_to_end_and_roundtrip(self, tmp_path):
+        t = DataTable({"text": ["the quick brown fox", "lazy dogs lie",
+                                "quick quick slow"],
+                       "label": np.array([0, 1, 0])})
+        model = TextFeaturizer(input_col="text", output_col="feats",
+                               num_features=256,
+                               use_stop_words_remover=True).fit(t)
+        out = model.transform(t)
+        assert "__tokens" not in out.columns and "__tf" not in out.columns
+        mat = out.column_matrix("feats")
+        assert mat.shape == (3, 256)
+        assert (mat != 0).any()
+        p = str(tmp_path / "textfeat")
+        model.save(p)
+        out2 = PipelineStage.load(p).transform(t)
+        np.testing.assert_allclose(out2.column_matrix("feats"), mat)
+
+    def test_ngram_path(self):
+        t = DataTable({"text": ["a b c d"]})
+        model = TextFeaturizer(input_col="text", output_col="f",
+                               use_ngram=True, ngram_length=2,
+                               use_idf=False, num_features=64).fit(t)
+        mat = model.transform(t).column_matrix("f")
+        assert mat.sum() == 3.0  # "a b", "b c", "c d"
+
+
+class TestAssembleFeatures:
+    def test_numeric_and_missing_drop(self):
+        t = DataTable({"a": np.array([1.0, np.nan, 3.0]),
+                       "b": np.array([2, 4, 6])})
+        model = AssembleFeatures(columns_to_featurize=["a", "b"]).fit(t)
+        out = model.transform(t)
+        mat = out.column_matrix("features")
+        assert mat.shape == (2, 2)  # NaN row dropped (na.drop analog)
+        np.testing.assert_allclose(mat, [[1, 2], [3, 6]])
+
+    def test_categoricals_first_one_hot(self):
+        t = DataTable({"num": np.array([0.5, 1.5, 2.5]),
+                       "c": ["a", "b", "c"]})
+        t = ValueIndexer(input_col="c", output_col="c").fit(t).transform(t)
+        model = AssembleFeatures(columns_to_featurize=["num", "c"]).fit(t)
+        mat = model.transform(t).column_matrix("features")
+        # 3 levels one-hot drop-last = 2 slots, placed BEFORE the numeric
+        assert mat.shape == (3, 3)
+        np.testing.assert_allclose(mat[:, :2], [[1, 0], [0, 1], [0, 0]])
+        np.testing.assert_allclose(mat[:, 2], [0.5, 1.5, 2.5])
+
+    def test_string_hash_slot_selection(self):
+        t = DataTable({"s": ["apple banana", "banana cherry", "apple"]})
+        model = AssembleFeatures(columns_to_featurize=["s"],
+                                 number_of_features=1 << 18).fit(t)
+        out = model.transform(t)
+        mat = out.column_matrix("features")
+        # 2^18 hash space collapses to the 3 observed vocabulary slots
+        assert mat.shape == (3, 3)
+        assert mat.sum() == 5.0
+        # unseen words at transform time fall outside selected slots
+        out2 = model.transform(DataTable({"s": ["durian"]}))
+        assert out2.column_matrix("features").sum() == 0.0
+
+    def test_dates(self):
+        t = DataTable({"d": [datetime(2017, 9, 1, 12, 30, 5),
+                             datetime(2018, 1, 2)]})
+        model = AssembleFeatures(columns_to_featurize=["d"]).fit(t)
+        mat = model.transform(t).column_matrix("features")
+        assert mat.shape == (2, 8)
+        assert mat[0, 1] == 2017 and mat[1, 1] == 2018
+        assert mat[0, 5] == 12 and mat[0, 6] == 30 and mat[0, 7] == 5
+
+    def test_vector_column(self):
+        t = DataTable({"v": [np.array([1.0, 2.0]), np.array([3.0, 4.0])],
+                       "x": np.array([9.0, 10.0])})
+        model = AssembleFeatures(columns_to_featurize=["v", "x"]).fit(t)
+        mat = model.transform(t).column_matrix("features")
+        np.testing.assert_allclose(mat, [[1, 2, 9], [3, 4, 10]])
+
+    def test_image_gate(self):
+        img = {"path": "p", "height": 1, "width": 2, "type": 0,
+               "bytes": np.zeros(6, dtype=np.uint8)}
+        t = DataTable({"im": [img]})
+        t = t.with_meta("im", **{SchemaConstants.K_IMAGE: True})
+        with pytest.raises(ValueError, match="allow_images"):
+            AssembleFeatures(columns_to_featurize=["im"]).fit(t)
+        model = AssembleFeatures(columns_to_featurize=["im"],
+                                 allow_images=True).fit(t)
+        mat = model.transform(t).column_matrix("features")
+        assert mat.shape == (1, 8)  # h, w, 6 pixels
+        assert mat[0, 0] == 1 and mat[0, 1] == 2
+
+
+class TestFeaturize:
+    def test_mixed_table(self, tmp_path):
+        t = make_tabular(60)
+        t = ValueIndexer(input_col="cat", output_col="cat").fit(t).transform(t)
+        model = Featurize(
+            feature_columns={"features": ["num", "int", "cat", "text"]},
+            number_of_features=1 << 18).fit(t)
+        out = model.transform(t)
+        mat = out.column_matrix("features")
+        assert mat.shape[0] == 60
+        assert out.column_meta("features")[SchemaConstants.K_VECTOR_SIZE] \
+            == mat.shape[1]
+        # round-trip
+        p = str(tmp_path / "featurize")
+        model.save(p)
+        mat2 = PipelineStage.load(p).transform(t).column_matrix("features")
+        np.testing.assert_allclose(mat2, mat)
+
+    def test_multiple_outputs(self):
+        t = DataTable({"a": np.arange(4).astype(float),
+                       "b": np.arange(4).astype(float) * 2})
+        model = Featurize(feature_columns={"fa": ["a"], "fb": ["b"]}).fit(t)
+        out = model.transform(t)
+        assert out.column_matrix("fa").shape == (4, 1)
+        assert out.column_matrix("fb").shape == (4, 1)
