@@ -1,0 +1,34 @@
+"""Classical train/evaluate layer.
+
+Analog of the reference's L5: ``src/train-classifier/``,
+``src/train-regressor/``, ``src/compute-model-statistics/``,
+``src/compute-per-instance-statistics/``, ``src/find-best-model/``.
+The reference delegates learning to SparkML learners; here the learner
+family is JAX-native (jit-compiled full-batch/minibatch training on the
+accelerator) with host-side tree learners gated behind scikit-learn.
+"""
+
+from mmlspark_tpu.ml.learners import (
+    DecisionTreeClassifier, DecisionTreeRegressor, GBTClassifier,
+    GBTRegressor, LinearRegression, LogisticRegression, MLPClassifier,
+    MLPRegressor, NaiveBayes, RandomForestClassifier, RandomForestRegressor,
+)
+from mmlspark_tpu.ml.metrics import (
+    ComputeModelStatistics, ComputePerInstanceStatistics,
+)
+from mmlspark_tpu.ml.find_best import BestModel, FindBestModel
+from mmlspark_tpu.ml.train_classifier import (
+    TrainClassifier, TrainedClassifierModel,
+)
+from mmlspark_tpu.ml.train_regressor import (
+    TrainRegressor, TrainedRegressorModel,
+)
+
+__all__ = [
+    "BestModel", "ComputeModelStatistics", "ComputePerInstanceStatistics",
+    "DecisionTreeClassifier", "DecisionTreeRegressor", "FindBestModel",
+    "GBTClassifier", "GBTRegressor", "LinearRegression",
+    "LogisticRegression", "MLPClassifier", "MLPRegressor", "NaiveBayes",
+    "RandomForestClassifier", "RandomForestRegressor", "TrainClassifier",
+    "TrainedClassifierModel", "TrainRegressor", "TrainedRegressorModel",
+]

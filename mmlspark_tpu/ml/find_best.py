@@ -1,0 +1,81 @@
+"""FindBestModel — model selection across trained models by metric.
+
+Analog of the reference's ``src/find-best-model/`` (reference:
+FindBestModel.scala:80-150): evaluates each candidate model on the given
+table with ComputeModelStatistics, picks the best by the chosen metric,
+and exposes the full metrics table (``all_model_metrics_``) and the best
+model's ROC the way the reference records ``rocCurve``/``bestModelMetrics``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from mmlspark_tpu.core.params import Param
+from mmlspark_tpu.core.stage import Estimator, Transformer
+from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.ml.metrics import ComputeModelStatistics
+
+# metric → (column in the metrics row, higher is better)
+_METRIC_INFO = {
+    "accuracy": ("accuracy", True),
+    "AUC": ("AUC", True),
+    "precision": ("precision", True),
+    "recall": ("recall", True),
+    "mse": ("mean_squared_error", False),
+    "rmse": ("root_mean_squared_error", False),
+    "r2": ("R^2", True),
+    "mae": ("mean_absolute_error", False),
+}
+
+
+class FindBestModel(Estimator):
+    models = Param(default=None, doc="candidate fitted models",
+                   is_complex=True)
+    evaluation_metric = Param(default="accuracy", doc="selection metric",
+                              type_=str,
+                              validator=Param.one_of(*_METRIC_INFO))
+
+    def fit(self, table: DataTable) -> "BestModel":
+        models = list(self.models or [])
+        if not models:
+            raise ValueError("no candidate models")
+        col, higher_better = _METRIC_INFO[self.evaluation_metric]
+        rows: list[dict[str, Any]] = []
+        best_i, best_v, best_roc = -1, None, None
+        for i, model in enumerate(models):
+            scored = model.transform(table)
+            evaluator = ComputeModelStatistics()
+            metrics = evaluator.transform(scored)
+            row = dict(metrics.to_rows()[0])
+            row["model"] = f"{type(model).__name__}[{model.uid}]"
+            rows.append(row)
+            v = row.get(col)
+            if v is None:
+                raise ValueError(
+                    f"metric {self.evaluation_metric!r} not produced for "
+                    f"model {row['model']} (got {sorted(row)})")
+            better = (best_v is None or
+                      (v > best_v if higher_better else v < best_v))
+            if better:
+                best_i, best_v = i, v
+                best_roc = getattr(evaluator, "roc_", None)
+        best = BestModel(
+            best_model=models[best_i],
+            best_metric=float(best_v),
+            evaluation_metric=self.evaluation_metric)
+        best.all_model_metrics_ = DataTable.from_rows(rows)
+        best.roc_ = best_roc
+        return best
+
+
+class BestModel(Transformer):
+    best_model = Param(default=None, doc="the winning fitted model",
+                       is_complex=True)
+    best_metric = Param(default=None, doc="winning metric value",
+                        type_=float)
+    evaluation_metric = Param(default="accuracy", doc="selection metric",
+                              type_=str)
+
+    def transform(self, table: DataTable) -> DataTable:
+        return self.best_model.transform(table)

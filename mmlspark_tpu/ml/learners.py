@@ -1,0 +1,389 @@
+"""The learner family behind TrainClassifier / TrainRegressor.
+
+The reference passes SparkML learners (LogisticRegression, DecisionTree,
+GBT, RandomForest, NaiveBayes, MultilayerPerceptron, LinearRegression, …)
+into ``TrainClassifier``/``TrainRegressor`` (reference:
+train-classifier/src/main/scala/TrainClassifier.scala:97-201,
+VerifyTrainClassifier.scala benchmark matrix). Here the same roles are
+filled TPU-first:
+
+* **JAX learners** (LogisticRegression, LinearRegression, MLP*) — the
+  featurized matrix is one dense device array; training is a jit-compiled
+  optax loop whose per-step cost is a batched matmul on the MXU. bfloat16 is
+  not used at these tiny widths; float32 keeps parity with CI tolerances.
+* **NaiveBayes** — closed-form count statistics (one pass, vectorized).
+* **Tree learners** (DecisionTree/RandomForest/GBT ×{Classifier,Regressor})
+  — host-side, delegated to scikit-learn when available (the featurize
+  hash-size heuristic treats them as the reference treats tree learners);
+  they raise a clear error if sklearn is absent.
+
+Every learner implements ``fit_arrays(X, y) -> FittedLearner`` with
+``predict_arrays(X) -> (labels_or_values, probabilities_or_None)``;
+DataTable plumbing lives in TrainClassifier/TrainRegressor, keeping the
+learner layer a pure array API (easy to jit, easy to fuzz).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+from mmlspark_tpu.core.params import Param, Params
+
+# learner families for the featurize hash-size heuristic
+# (reference: TrainClassifier.scala:186-201)
+FAMILY_LINEAR = "linear"
+FAMILY_TREE = "tree"
+FAMILY_NN = "nn"
+
+
+class Learner(Params):
+    """A learner is param'd config + fit_arrays; not itself a pipeline
+    stage (TrainClassifier wraps it)."""
+
+    family: str = FAMILY_LINEAR
+    is_classifier: bool = True
+
+    def fit_arrays(self, x: np.ndarray, y: np.ndarray,
+                   num_classes: int | None = None) -> "FittedLearner":
+        raise NotImplementedError
+
+
+class FittedLearner:
+    def predict_arrays(self, x: np.ndarray
+                       ) -> tuple[np.ndarray, np.ndarray | None]:
+        """Return (predictions, probabilities-or-None)."""
+        raise NotImplementedError
+
+
+# ---- JAX linear / MLP learners ----
+
+def _train_jax(loss_fn: Callable, params0: Any, x: np.ndarray, y: np.ndarray,
+               learning_rate: float, epochs: int, batch_size: int,
+               seed: int, weight_decay: float = 0.0) -> Any:
+    """Shared jit-compiled optax Adam loop over padded minibatches."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    n = x.shape[0]
+    batch_size = int(min(batch_size, n))
+    steps_per_epoch = -(-n // batch_size)  # ceil: tail rows get visited
+    opt = optax.adamw(learning_rate, weight_decay=weight_decay) \
+        if weight_decay else optax.adam(learning_rate)
+    opt_state = opt.init(params0)
+
+    @jax.jit
+    def step(params, opt_state, xb, yb):
+        loss, grads = jax.value_and_grad(loss_fn)(params, xb, yb)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    params = params0
+    rng = np.random.default_rng(seed)
+    for _ in range(epochs):
+        order = rng.permutation(n)
+        for s in range(steps_per_epoch):
+            idx = order[s * batch_size:(s + 1) * batch_size]
+            if len(idx) < batch_size:  # keep shapes static for the jit cache
+                idx = np.concatenate([idx, order[:batch_size - len(idx)]])
+            params, opt_state, _ = step(params, opt_state, x[idx], y[idx])
+    return params
+
+
+class LogisticRegression(Learner):
+    """Multinomial logistic regression; binary is the 2-class case.
+
+    The reference wraps multiclass LR in OneVsRest
+    (TrainClassifier.scala:109-134); a multinomial softmax head is the
+    equivalent single-matmul form and maps better onto the MXU.
+    """
+
+    family = FAMILY_LINEAR
+    is_classifier = True
+
+    learning_rate = Param(default=0.05, doc="Adam learning rate", type_=float)
+    epochs = Param(default=100, doc="training epochs", type_=int)
+    batch_size = Param(default=8192, doc="minibatch size", type_=int)
+    reg_param = Param(default=0.0, doc="L2 regularization", type_=float)
+    seed = Param(default=0, doc="shuffle seed", type_=int)
+
+    def fit_arrays(self, x, y, num_classes=None):
+        import jax.numpy as jnp
+        import optax
+
+        k = int(num_classes or (int(y.max()) + 1 if len(y) else 2))
+        k = max(k, 2)
+        d = x.shape[1]
+        params0 = {"w": jnp.zeros((d, k), jnp.float32),
+                   "b": jnp.zeros((k,), jnp.float32)}
+
+        def loss_fn(params, xb, yb):
+            logits = xb @ params["w"] + params["b"]
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, yb)
+            return ce.mean() + self.reg_param * (params["w"] ** 2).sum()
+
+        params = _train_jax(loss_fn, params0,
+                            x.astype(np.float32), y.astype(np.int32),
+                            self.learning_rate, self.epochs, self.batch_size,
+                            self.seed)
+        return _LinearFitted(np.asarray(params["w"]), np.asarray(params["b"]),
+                             classifier=True)
+
+
+class LinearRegression(Learner):
+    family = FAMILY_LINEAR
+    is_classifier = False
+
+    reg_param = Param(default=1e-6, doc="ridge regularization", type_=float)
+
+    def fit_arrays(self, x, y, num_classes=None):
+        # closed-form ridge: (X'X + λI)^-1 X'y — one MXU matmul pair; no
+        # iterative loop needed at featurized dims
+        x64 = np.column_stack([x.astype(np.float64),
+                               np.ones(len(x))])
+        a = x64.T @ x64 + self.reg_param * np.eye(x64.shape[1])
+        b = x64.T @ y.astype(np.float64)
+        wb = np.linalg.solve(a, b)
+        return _LinearFitted(wb[:-1][:, None], wb[-1:], classifier=False)
+
+
+class _LinearFitted(FittedLearner):
+    def __init__(self, w: np.ndarray, b: np.ndarray, classifier: bool):
+        self.w, self.b, self.classifier = w, b, classifier
+
+    def predict_arrays(self, x):
+        z = x.astype(np.float64) @ self.w + self.b
+        if not self.classifier:
+            return z[:, 0], None
+        z = z - z.max(axis=1, keepdims=True)
+        p = np.exp(z)
+        p /= p.sum(axis=1, keepdims=True)
+        return p.argmax(axis=1), p
+
+
+class _MLPBase(Learner):
+    layers = Param(default=None, doc="hidden layer widths",
+                   type_=(list, tuple))
+    learning_rate = Param(default=1e-3, doc="Adam learning rate", type_=float)
+    epochs = Param(default=100, doc="training epochs", type_=int)
+    batch_size = Param(default=4096, doc="minibatch size", type_=int)
+    seed = Param(default=0, doc="init/shuffle seed", type_=int)
+
+    def _init_params(self, dims: list[int]) -> dict:
+        import jax.numpy as jnp
+        rng = np.random.default_rng(self.seed)
+        params = {}
+        for i, (din, dout) in enumerate(zip(dims[:-1], dims[1:])):
+            scale = np.sqrt(2.0 / din)
+            params[f"w{i}"] = jnp.asarray(
+                rng.normal(scale=scale, size=(din, dout)), jnp.float32)
+            params[f"b{i}"] = jnp.zeros((dout,), jnp.float32)
+        return params
+
+    @staticmethod
+    def _forward(params: dict, xb, n_layers: int):
+        import jax.numpy as jnp
+        h = xb
+        for i in range(n_layers):
+            h = h @ params[f"w{i}"] + params[f"b{i}"]
+            if i < n_layers - 1:
+                h = jnp.maximum(h, 0.0)
+        return h
+
+
+class MLPClassifier(_MLPBase):
+    family = FAMILY_NN
+    is_classifier = True
+
+    def fit_arrays(self, x, y, num_classes=None):
+        import optax
+
+        k = max(int(num_classes or int(y.max()) + 1), 2)
+        hidden = list(self.layers or [64])
+        dims = [x.shape[1]] + hidden + [k]
+        n_layers = len(dims) - 1
+        params0 = self._init_params(dims)
+
+        def loss_fn(params, xb, yb):
+            logits = self._forward(params, xb, n_layers)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, yb).mean()
+
+        params = _train_jax(loss_fn, params0, x.astype(np.float32),
+                            y.astype(np.int32), self.learning_rate,
+                            self.epochs, self.batch_size, self.seed)
+        return _MLPFitted({k2: np.asarray(v) for k2, v in params.items()},
+                          n_layers, classifier=True)
+
+
+class MLPRegressor(_MLPBase):
+    family = FAMILY_NN
+    is_classifier = False
+
+    def fit_arrays(self, x, y, num_classes=None):
+        hidden = list(self.layers or [64])
+        dims = [x.shape[1]] + hidden + [1]
+        n_layers = len(dims) - 1
+        params0 = self._init_params(dims)
+
+        def loss_fn(params, xb, yb):
+            pred = self._forward(params, xb, n_layers)[:, 0]
+            return ((pred - yb) ** 2).mean()
+
+        params = _train_jax(loss_fn, params0, x.astype(np.float32),
+                            y.astype(np.float32), self.learning_rate,
+                            self.epochs, self.batch_size, self.seed)
+        return _MLPFitted({k: np.asarray(v) for k, v in params.items()},
+                          n_layers, classifier=False)
+
+
+class _MLPFitted(FittedLearner):
+    def __init__(self, params: dict, n_layers: int, classifier: bool):
+        self.params, self.n_layers, self.classifier = params, n_layers, classifier
+
+    def predict_arrays(self, x):
+        h = x.astype(np.float32)
+        for i in range(self.n_layers):
+            h = h @ self.params[f"w{i}"] + self.params[f"b{i}"]
+            if i < self.n_layers - 1:
+                h = np.maximum(h, 0.0)
+        if not self.classifier:
+            return h[:, 0].astype(np.float64), None
+        z = h - h.max(axis=1, keepdims=True)
+        p = np.exp(z)
+        p /= p.sum(axis=1, keepdims=True)
+        return p.argmax(axis=1), p
+
+
+class NaiveBayes(Learner):
+    """Multinomial naive Bayes over non-negative features (closed form)."""
+
+    family = FAMILY_LINEAR
+    is_classifier = True
+
+    smoothing = Param(default=1.0, doc="Laplace smoothing", type_=float)
+
+    def fit_arrays(self, x, y, num_classes=None):
+        k = max(int(num_classes or int(y.max()) + 1), 2)
+        x = np.maximum(x.astype(np.float64), 0.0)
+        d = x.shape[1]
+        counts = np.zeros((k, d))
+        prior = np.zeros(k)
+        for c in range(k):
+            mask = y == c
+            prior[c] = mask.sum()
+            counts[c] = x[mask].sum(axis=0)
+        prior = np.log((prior + 1.0) / (prior.sum() + k))
+        theta = np.log((counts + self.smoothing) /
+                       (counts.sum(axis=1, keepdims=True)
+                        + self.smoothing * d))
+        return _NBFitted(prior, theta)
+
+
+class _NBFitted(FittedLearner):
+    def __init__(self, log_prior: np.ndarray, log_theta: np.ndarray):
+        self.log_prior, self.log_theta = log_prior, log_theta
+
+    def predict_arrays(self, x):
+        joint = np.maximum(x.astype(np.float64), 0.0) @ self.log_theta.T \
+            + self.log_prior
+        z = joint - joint.max(axis=1, keepdims=True)
+        p = np.exp(z)
+        p /= p.sum(axis=1, keepdims=True)
+        return joint.argmax(axis=1), p
+
+
+# ---- host-side tree learners (scikit-learn delegation) ----
+
+def _require_sklearn():
+    try:
+        import sklearn  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "tree learners delegate to scikit-learn, which is not "
+            "installed; use LogisticRegression/MLPClassifier or install "
+            "scikit-learn") from e
+
+
+class _SklearnLearner(Learner):
+    family = FAMILY_TREE
+
+    max_depth = Param(default=5, doc="maximum tree depth", type_=int)
+    n_estimators = Param(default=20, doc="number of trees (forest/GBT)",
+                         type_=int)
+    seed = Param(default=0, doc="random seed", type_=int)
+
+    def _make(self) -> Any:
+        raise NotImplementedError
+
+    def fit_arrays(self, x, y, num_classes=None):
+        _require_sklearn()
+        est = self._make()
+        est.fit(x, y)
+        return _SklearnFitted(est, self.is_classifier)
+
+
+class _SklearnFitted(FittedLearner):
+    def __init__(self, est: Any, classifier: bool):
+        self.est, self.classifier = est, classifier
+
+    def predict_arrays(self, x):
+        pred = self.est.predict(x)
+        proba = (self.est.predict_proba(x)
+                 if self.classifier and hasattr(self.est, "predict_proba")
+                 else None)
+        return pred, proba
+
+
+class DecisionTreeClassifier(_SklearnLearner):
+    is_classifier = True
+
+    def _make(self):
+        from sklearn.tree import DecisionTreeClassifier as Impl
+        return Impl(max_depth=self.max_depth, random_state=self.seed)
+
+
+class DecisionTreeRegressor(_SklearnLearner):
+    is_classifier = False
+
+    def _make(self):
+        from sklearn.tree import DecisionTreeRegressor as Impl
+        return Impl(max_depth=self.max_depth, random_state=self.seed)
+
+
+class RandomForestClassifier(_SklearnLearner):
+    is_classifier = True
+
+    def _make(self):
+        from sklearn.ensemble import RandomForestClassifier as Impl
+        return Impl(n_estimators=self.n_estimators, max_depth=self.max_depth,
+                    random_state=self.seed)
+
+
+class RandomForestRegressor(_SklearnLearner):
+    is_classifier = False
+
+    def _make(self):
+        from sklearn.ensemble import RandomForestRegressor as Impl
+        return Impl(n_estimators=self.n_estimators, max_depth=self.max_depth,
+                    random_state=self.seed)
+
+
+class GBTClassifier(_SklearnLearner):
+    is_classifier = True
+
+    def _make(self):
+        from sklearn.ensemble import GradientBoostingClassifier as Impl
+        return Impl(n_estimators=self.n_estimators, max_depth=self.max_depth,
+                    random_state=self.seed)
+
+
+class GBTRegressor(_SklearnLearner):
+    is_classifier = False
+
+    def _make(self):
+        from sklearn.ensemble import GradientBoostingRegressor as Impl
+        return Impl(n_estimators=self.n_estimators, max_depth=self.max_depth,
+                    random_state=self.seed)

@@ -1,0 +1,239 @@
+"""ComputeModelStatistics / ComputePerInstanceStatistics — metadata-driven
+evaluation.
+
+Analog of the reference's ``src/compute-model-statistics/`` and
+``src/compute-per-instance-statistics/`` (reference:
+ComputeModelStatistics.scala:22-339, ComputePerInstanceStatistics.scala:16-50).
+Like the reference, the evaluators locate the label / scores / scored-labels
+columns and the score kind from the column metadata stamped by Train*
+models (the ``mml`` metadata protocol) rather than taking mandatory column
+params — explicit params are overrides.
+
+Classification: accuracy, precision, recall, AUC (binary), confusion
+matrix, ROC curve; micro/macro averaged precision/recall for multiclass.
+Regression: mse, rmse, r2, mae. All exact vectorized NumPy (the reference
+runs Spark reduce jobs).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from mmlspark_tpu.core.params import Param
+from mmlspark_tpu.core.schema import (
+    SchemaConstants, find_score_column, get_categorical_levels,
+    get_score_value_kind,
+)
+from mmlspark_tpu.core.stage import Transformer
+from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.stages.indexers import index_values
+
+# evaluation metric selectors (reference: ComputeModelStatistics.scala:22-41)
+CLASSIFICATION_METRICS = "classification"
+REGRESSION_METRICS = "regression"
+ALL_METRICS = "all"
+
+
+def confusion_matrix(y: np.ndarray, pred: np.ndarray, k: int) -> np.ndarray:
+    """Counts over rows whose codes are in [0, k); out-of-range codes (the
+    index_values -1 'unseen' sentinel) are excluded rather than silently
+    wrapping into the last class via negative indexing."""
+    cm = np.zeros((k, k), dtype=np.int64)
+    valid = (y >= 0) & (y < k) & (pred >= 0) & (pred < k)
+    np.add.at(cm, (y[valid], pred[valid]), 1)
+    return cm
+
+
+def roc_curve(y: np.ndarray, score: np.ndarray
+              ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Exact ROC: (fpr, tpr, thresholds), scores descending."""
+    if len(score) == 0:
+        return (np.array([0.0, 1.0]), np.array([0.0, 1.0]),
+                np.array([np.inf, -np.inf]))
+    order = np.argsort(-score, kind="stable")
+    y_sorted = y[order]
+    tps = np.cumsum(y_sorted)
+    fps = np.cumsum(1 - y_sorted)
+    p = max(int(tps[-1]) if len(tps) else 0, 1)
+    n = max(int(fps[-1]) if len(fps) else 0, 1)
+    # keep the last point of each threshold run
+    thr = score[order]
+    keep = np.r_[np.diff(thr) != 0, True]
+    tpr = np.r_[0.0, tps[keep] / p]
+    fpr = np.r_[0.0, fps[keep] / n]
+    return fpr, tpr, np.r_[np.inf, thr[keep]]
+
+
+def auc(fpr: np.ndarray, tpr: np.ndarray) -> float:
+    return float(np.trapezoid(tpr, fpr))
+
+
+def _locate(table: DataTable, label_col: str | None, scores_col: str | None,
+            scored_labels_col: str | None) -> tuple[str | None, str | None,
+                                                    str | None, str | None]:
+    """Resolve (kind, label, scores, scored_labels) from metadata with
+    param overrides (getSchemaInfo analog,
+    reference: ComputeModelStatistics.scala:213-226)."""
+    scores = scores_col or find_score_column(
+        table, SchemaConstants.SCORES_COLUMN)
+    scored_labels = scored_labels_col or find_score_column(
+        table, SchemaConstants.SCORED_LABELS_COLUMN)
+    label = label_col or find_score_column(
+        table, SchemaConstants.LABEL_COLUMN)
+    kind = None
+    for c in (scores, scored_labels):
+        if c is not None:
+            kind = get_score_value_kind(table, c)
+            if kind:
+                break
+    return kind, label, scores, scored_labels
+
+
+class ComputeModelStatistics(Transformer):
+    """Aggregate metrics; returns a one-row metrics table. The confusion
+    matrix and ROC are exposed on ``self.confusion_matrix_`` /
+    ``self.roc_`` after transform (the reference returns them through
+    separate transformer outputs)."""
+
+    evaluation_metric = Param(
+        default=ALL_METRICS, doc="which metric family to compute", type_=str,
+        validator=Param.one_of(CLASSIFICATION_METRICS, REGRESSION_METRICS,
+                               ALL_METRICS))
+    label_col = Param(default=None, doc="label column override", type_=str)
+    scores_col = Param(default=None, doc="scores column override", type_=str)
+    scored_labels_col = Param(default=None,
+                              doc="scored-labels column override", type_=str)
+
+    def transform(self, table: DataTable) -> DataTable:
+        kind, label, scores, scored_labels = _locate(
+            table, self.label_col, self.scores_col, self.scored_labels_col)
+        metric = self.evaluation_metric
+        if metric == ALL_METRICS:
+            if kind == SchemaConstants.CLASSIFICATION_KIND:
+                metric = CLASSIFICATION_METRICS
+            elif kind == SchemaConstants.REGRESSION_KIND:
+                metric = REGRESSION_METRICS
+            else:
+                raise ValueError(
+                    "no score metadata found on the table; set "
+                    "evaluation_metric and column params explicitly")
+        if metric == CLASSIFICATION_METRICS:
+            return self._classification(table, label, scores, scored_labels)
+        return self._regression(table, label, scores)
+
+    # -- classification --
+
+    def _classification(self, table: DataTable, label: str | None,
+                        scores: str | None, scored_labels: str | None
+                        ) -> DataTable:
+        if label is None or scored_labels is None:
+            raise ValueError("need label and scored-labels columns "
+                             "(metadata or params)")
+        levels = get_categorical_levels(table, scored_labels)
+        if levels is None:
+            vals = list(table[label]) + list(table[scored_labels])
+            from mmlspark_tpu.stages.indexers import sorted_levels
+            levels = sorted_levels(np.asarray(vals, dtype=object))
+        y = index_values(table[label], levels).astype(np.int64)
+        pred = index_values(table[scored_labels], levels).astype(np.int64)
+        k = max(len(levels), 2)
+        cm = confusion_matrix(y, pred, k)
+        self.confusion_matrix_ = cm
+
+        # rows whose TRUE label is unseen (-1) cannot be scored and are
+        # excluded; an unseen PREDICTED label counts as an error
+        scorable = (y >= 0) & (y < k)
+        y, pred = y[scorable], pred[scorable]
+        n = len(y)
+        accuracy = float((y == pred).sum()) / n if n else 0.0
+        tp = np.diag(cm).astype(np.float64)
+        pred_pos = cm.sum(axis=0).astype(np.float64)
+        actual_pos = cm.sum(axis=1).astype(np.float64)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            prec_per = np.where(pred_pos > 0, tp / pred_pos, 0.0)
+            rec_per = np.where(actual_pos > 0, tp / actual_pos, 0.0)
+
+        row: dict[str, Any] = {"evaluation_type": "Classification",
+                               "accuracy": accuracy}
+        if k == 2:
+            # positive class = level 1 (the reference evaluates the indexed
+            # positive label of BinaryClassificationMetrics)
+            row["precision"] = float(prec_per[1])
+            row["recall"] = float(rec_per[1])
+            auc_val = None
+            if scores is not None:
+                proba = table.column_matrix(scores, dtype=np.float64)
+                pos_score = (proba[:, 1] if proba.ndim == 2
+                             and proba.shape[1] >= 2 else proba.reshape(-1))
+                fpr, tpr, _ = roc_curve(y, pos_score[scorable])
+                self.roc_ = np.stack([fpr, tpr], axis=1)
+                auc_val = auc(fpr, tpr)
+            row["AUC"] = auc_val
+        else:
+            micro = float(tp.sum() / cm.sum()) if cm.sum() else 0.0
+            row["micro_precision"] = micro
+            row["micro_recall"] = micro
+            row["macro_precision"] = float(prec_per.mean())
+            row["macro_recall"] = float(rec_per.mean())
+        return DataTable.from_rows([row])
+
+    # -- regression --
+
+    def _regression(self, table: DataTable, label: str | None,
+                    scores: str | None) -> DataTable:
+        if label is None or scores is None:
+            raise ValueError("need label and scores columns "
+                             "(metadata or params)")
+        y = np.asarray(table[label], dtype=np.float64)
+        pred = np.asarray(table[scores], dtype=np.float64)
+        err = y - pred
+        mse = float(np.mean(err ** 2)) if len(y) else 0.0
+        var = float(np.var(y)) if len(y) else 0.0
+        r2 = 1.0 - mse / var if var > 0 else 0.0
+        return DataTable.from_rows([{
+            "evaluation_type": "Regression",
+            "mean_squared_error": mse,
+            "root_mean_squared_error": float(np.sqrt(mse)),
+            "R^2": r2,
+            "mean_absolute_error": float(np.mean(np.abs(err)))
+            if len(y) else 0.0,
+        }])
+
+
+class ComputePerInstanceStatistics(Transformer):
+    """Per-row metrics appended as columns: L1/L2 loss for regression,
+    log_loss for classification (reference:
+    ComputePerInstanceStatistics.scala:16-50)."""
+
+    label_col = Param(default=None, doc="label column override", type_=str)
+    scores_col = Param(default=None, doc="scores column override", type_=str)
+    scored_labels_col = Param(default=None,
+                              doc="scored-labels column override", type_=str)
+    epsilon = Param(default=1e-15, doc="log-loss clamp", type_=float)
+
+    def transform(self, table: DataTable) -> DataTable:
+        kind, label, scores, scored_labels = _locate(
+            table, self.label_col, self.scores_col, self.scored_labels_col)
+        if kind == SchemaConstants.REGRESSION_KIND or (
+                kind is None and scored_labels is None):
+            y = np.asarray(table[label], dtype=np.float64)
+            pred = np.asarray(table[scores], dtype=np.float64)
+            out = table.with_column("L1_loss", np.abs(y - pred))
+            return out.with_column("L2_loss", (y - pred) ** 2)
+        # classification log-loss from the probability vectors
+        levels = get_categorical_levels(table, scored_labels)
+        if levels is None:
+            raise ValueError("scored-labels column carries no levels")
+        y = index_values(table[label], levels).astype(np.int64)
+        proba = table.column_matrix(scores, dtype=np.float64)
+        eps = self.epsilon
+        # unseen labels (code -1 or >= #classes) get NaN loss rather than a
+        # silently wrong number computed against an arbitrary class
+        valid = (y >= 0) & (y < proba.shape[1])
+        loss = np.full(len(y), np.nan)
+        rows = np.flatnonzero(valid)
+        p_true = np.clip(proba[rows, y[rows]], eps, 1.0)
+        loss[rows] = -np.log(p_true)
+        return table.with_column("log_loss", loss)

@@ -1,0 +1,141 @@
+"""TrainClassifier — one-call classification over a mixed-type table.
+
+Analog of the reference's ``src/train-classifier/`` (reference:
+TrainClassifier.scala:97-348): label reindexing via ValueIndexer
+(``convertLabel``, :203-249), automatic featurization with a hash-size /
+one-hot heuristic per learner family (``getFeaturizeParams``, :186-201),
+learner fit, and a fitted model whose transform stamps the score-column
+metadata protocol (scores / scored_labels / scored_probabilities,
+:297-348) that ComputeModelStatistics consumes.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from mmlspark_tpu.core.params import Param
+from mmlspark_tpu.core.schema import (
+    SchemaConstants, find_unused_column_name, set_categorical_levels,
+    set_label_column, set_score_column,
+)
+from mmlspark_tpu.core.stage import Estimator, HasLabelCol, Transformer
+from mmlspark_tpu.data.table import DataTable, is_missing
+from mmlspark_tpu.ml.learners import (
+    FAMILY_LINEAR, FAMILY_NN, FAMILY_TREE, Learner, LogisticRegression,
+)
+from mmlspark_tpu.stages.featurize import (
+    Featurize, NUM_FEATURES_DEFAULT, NUM_FEATURES_TREE_OR_NN,
+)
+from mmlspark_tpu.stages.indexers import index_values, sorted_levels
+
+
+def featurize_params_for(learner: Learner) -> tuple[int, bool]:
+    """(hash slots, one-hot?) per learner family
+    (reference: TrainClassifier.scala:186-201)."""
+    if learner.family in (FAMILY_TREE, FAMILY_NN):
+        return NUM_FEATURES_TREE_OR_NN, learner.family != FAMILY_TREE
+    return NUM_FEATURES_DEFAULT, True
+
+
+def drop_missing_labels(table: DataTable, label_col: str) -> DataTable:
+    col = table[label_col]
+    if col.dtype == object:
+        mask = np.fromiter((not is_missing(v) for v in col), dtype=bool,
+                           count=len(col))
+    elif np.issubdtype(col.dtype, np.floating):
+        mask = ~np.isnan(col)
+    else:
+        return table
+    return table if mask.all() else table.take(mask)
+
+
+class TrainClassifier(Estimator, HasLabelCol):
+    model = Param(default=None, doc="the learner to fit (default "
+                  "LogisticRegression)", is_complex=True)
+    feature_columns = Param(default=None, doc="input columns to featurize "
+                            "(default: all but the label)",
+                            type_=(list, tuple))
+    number_of_features = Param(default=None, doc="hash-slot override",
+                               type_=int)
+
+    def fit(self, table: DataTable) -> "TrainedClassifierModel":
+        learner: Learner = self.model or LogisticRegression()
+        if not learner.is_classifier:
+            raise ValueError(f"{type(learner).__name__} is not a classifier")
+        table = drop_missing_labels(table, self.label_col)
+
+        # label → contiguous codes, levels kept for inverse mapping
+        levels = sorted_levels(table[self.label_col])
+        codes = index_values(table[self.label_col], levels)
+
+        n_feats, one_hot = featurize_params_for(learner)
+        if self.number_of_features:
+            n_feats = self.number_of_features
+        feat_cols = list(self.feature_columns or
+                         [c for c in table.columns if c != self.label_col])
+        features_col = find_unused_column_name(table, "features")
+        featurizer = Featurize(
+            feature_columns={features_col: feat_cols},
+            number_of_features=n_feats,
+            one_hot_encode_categoricals=one_hot,
+            allow_images=True)
+        feat_model = featurizer.fit(table)
+        # temp label-code column must not collide with a real feature column
+        label_tmp = find_unused_column_name(table, "__label")
+        feat_table = feat_model.transform(table.with_column(label_tmp, codes))
+        x = feat_table.column_matrix(features_col)
+        y = np.asarray(feat_table[label_tmp], dtype=np.int64)
+
+        fitted = learner.fit_arrays(x, y, num_classes=len(levels))
+        return TrainedClassifierModel(
+            label_col=self.label_col, features_col=features_col,
+            featurize_model=feat_model, fitted_learner=fitted,
+            label_levels=list(levels))
+
+
+class TrainedClassifierModel(Transformer, HasLabelCol):
+    features_col = Param(default="features", doc="assembled features column",
+                         type_=str)
+    featurize_model = Param(default=None, doc="fitted featurization pipeline",
+                            is_complex=True)
+    fitted_learner = Param(default=None, doc="fitted learner",
+                           is_complex=True)
+    label_levels = Param(default=None, doc="label level values (code order)",
+                         is_complex=True)
+
+    def transform(self, table: DataTable) -> DataTable:
+        out = self.featurize_model.transform(table)
+        x = out.column_matrix(self.features_col)
+        pred_codes, proba = self.fitted_learner.predict_arrays(x)
+        levels = list(self.label_levels)
+        pred_codes = np.asarray(pred_codes, dtype=np.int64)
+        scored_labels = [levels[c] if 0 <= c < len(levels) else None
+                         for c in pred_codes]
+
+        scores_col = SchemaConstants.SCORES_COLUMN
+        labels_col = SchemaConstants.SCORED_LABELS_COLUMN
+        probs_col = SchemaConstants.SCORED_PROBABILITIES_COLUMN
+        if proba is None:  # learners without probabilities score one-hot
+            k = max(len(levels), int(pred_codes.max(initial=0)) + 1)
+            proba = np.zeros((len(pred_codes), k))
+            proba[np.arange(len(pred_codes)), pred_codes] = 1.0
+
+        out = out.drop(self.features_col)
+        out = out.with_column(scores_col, proba.astype(np.float64))
+        out = out.with_column(labels_col, scored_labels)
+        out = out.with_column(probs_col, proba.astype(np.float64))
+
+        kind = SchemaConstants.CLASSIFICATION_KIND
+        out = set_score_column(out, self.uid, scores_col,
+                               SchemaConstants.SCORES_COLUMN, kind)
+        out = set_score_column(out, self.uid, labels_col,
+                               SchemaConstants.SCORED_LABELS_COLUMN, kind)
+        out = set_score_column(out, self.uid, probs_col,
+                               SchemaConstants.SCORED_PROBABILITIES_COLUMN,
+                               kind)
+        out = set_categorical_levels(out, labels_col, levels)
+        if self.label_col in out:
+            out = set_label_column(out, self.uid, self.label_col, kind)
+        return out

@@ -1,0 +1,90 @@
+"""TrainRegressor — one-call regression over a mixed-type table.
+
+Analog of the reference's ``src/train-regressor/`` (reference:
+TrainRegressor.scala:52-160): label cast to double (:84-104), automatic
+featurization per learner family, learner fit; the fitted model stamps
+Regression score metadata.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mmlspark_tpu.core.params import Param
+from mmlspark_tpu.core.schema import (
+    SchemaConstants, find_unused_column_name, set_label_column,
+    set_score_column,
+)
+from mmlspark_tpu.core.stage import Estimator, HasLabelCol, Transformer
+from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.ml.learners import Learner, LinearRegression
+from mmlspark_tpu.ml.train_classifier import (
+    drop_missing_labels, featurize_params_for,
+)
+from mmlspark_tpu.stages.featurize import Featurize
+
+
+class TrainRegressor(Estimator, HasLabelCol):
+    model = Param(default=None, doc="the learner to fit (default "
+                  "LinearRegression)", is_complex=True)
+    feature_columns = Param(default=None, doc="input columns to featurize "
+                            "(default: all but the label)",
+                            type_=(list, tuple))
+    number_of_features = Param(default=None, doc="hash-slot override",
+                               type_=int)
+
+    def fit(self, table: DataTable) -> "TrainedRegressorModel":
+        learner: Learner = self.model or LinearRegression()
+        if learner.is_classifier:
+            raise ValueError(f"{type(learner).__name__} is not a regressor")
+        table = drop_missing_labels(table, self.label_col)
+        labels = table[self.label_col]
+        if labels.dtype == object:
+            y = np.asarray([float(v) for v in labels], dtype=np.float64)
+        else:
+            y = labels.astype(np.float64)
+
+        n_feats, one_hot = featurize_params_for(learner)
+        if self.number_of_features:
+            n_feats = self.number_of_features
+        feat_cols = list(self.feature_columns or
+                         [c for c in table.columns if c != self.label_col])
+        features_col = find_unused_column_name(table, "features")
+        feat_model = Featurize(
+            feature_columns={features_col: feat_cols},
+            number_of_features=n_feats,
+            one_hot_encode_categoricals=one_hot,
+            allow_images=True).fit(table)
+        label_tmp = find_unused_column_name(table, "__label")
+        feat_table = feat_model.transform(table.with_column(label_tmp, y))
+        x = feat_table.column_matrix(features_col)
+        y = np.asarray(feat_table[label_tmp], dtype=np.float64)
+
+        fitted = learner.fit_arrays(x, y)
+        return TrainedRegressorModel(
+            label_col=self.label_col, features_col=features_col,
+            featurize_model=feat_model, fitted_learner=fitted)
+
+
+class TrainedRegressorModel(Transformer, HasLabelCol):
+    features_col = Param(default="features", doc="assembled features column",
+                         type_=str)
+    featurize_model = Param(default=None, doc="fitted featurization pipeline",
+                            is_complex=True)
+    fitted_learner = Param(default=None, doc="fitted learner",
+                           is_complex=True)
+
+    def transform(self, table: DataTable) -> DataTable:
+        out = self.featurize_model.transform(table)
+        x = out.column_matrix(self.features_col)
+        pred, _ = self.fitted_learner.predict_arrays(x)
+
+        scores_col = SchemaConstants.SCORES_COLUMN
+        kind = SchemaConstants.REGRESSION_KIND
+        out = out.drop(self.features_col)
+        out = out.with_column(scores_col, np.asarray(pred, dtype=np.float64))
+        out = set_score_column(out, self.uid, scores_col,
+                               SchemaConstants.SCORES_COLUMN, kind)
+        if self.label_col in out:
+            out = set_label_column(out, self.uid, self.label_col, kind)
+        return out

@@ -184,8 +184,9 @@ class AssembleFeaturesModel(Transformer, HasFeaturesCol):
                 levels = entry["levels"]
                 k = len(levels)
                 if entry.get("one_hot", True):
-                    # Spark OneHotEncoder drops the last category
-                    width = max(k - 1, 1)
+                    # Spark OneHotEncoder drops the last category; a
+                    # single-level column contributes zero slots
+                    width = k - 1
                     block = np.zeros((n, width), dtype=np.float64)
                     valid = (codes >= 0) & (codes < width)
                     block[np.arange(n)[valid], codes[valid]] = 1.0
@@ -215,12 +216,30 @@ class AssembleFeaturesModel(Transformer, HasFeaturesCol):
                 clean_mask &= ~np.isnan(mat).any(axis=1)
                 blocks.append(mat)
             elif kind == _KIND_IMAGE:
-                rows = []
-                for v in table[c]:
-                    img = np.asarray(v["bytes"], dtype=np.float64)
-                    h, w = float(v["height"]), float(v["width"])
-                    rows.append(np.concatenate([[h, w], img.reshape(-1)]))
-                blocks.append(np.stack(rows))
+                rows: list[np.ndarray | None] = []
+                width = None
+                for i, v in enumerate(table[c]):
+                    if is_missing(v):
+                        clean_mask[i] = False
+                        rows.append(None)
+                        continue
+                    img = np.asarray(v["data"], dtype=np.float64)
+                    row = np.concatenate([[float(v["height"]),
+                                           float(v["width"])],
+                                          img.reshape(-1)])
+                    if width is None:
+                        width = len(row)
+                    elif len(row) != width:
+                        raise ValueError(
+                            f"image column {c!r} row {i} unrolls to "
+                            f"{len(row)} values, expected {width}; resize "
+                            "images to a common shape first")
+                    rows.append(row)
+                mat = np.zeros((n, width or 0), dtype=np.float64)
+                for i, row in enumerate(rows):
+                    if row is not None:
+                        mat[i] = row
+                blocks.append(mat)
             elif kind == _KIND_STRING:
                 string_cols.append(c)
             else:

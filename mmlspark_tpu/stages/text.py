@@ -28,7 +28,7 @@ from mmlspark_tpu.core.schema import SchemaConstants
 from mmlspark_tpu.core.stage import (
     Estimator, HasInputCol, HasOutputCol, Transformer, UnaryTransformer,
 )
-from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.data.table import DataTable, is_missing
 
 # A compact English stop-word list (SparkML ships per-language lists; the
 # "english" default is what the reference's defaultStopWordLanguage uses).
@@ -64,7 +64,7 @@ class Tokenizer(UnaryTransformer):
                              type_=int, validator=Param.ge(0))
 
     def _tokenize_one(self, text: Any, rx: re.Pattern) -> list[str]:
-        s = "" if text is None else str(text)
+        s = "" if is_missing(text) else str(text)
         if self.to_lowercase:
             s = s.lower()
         toks = rx.split(s) if self.gaps else rx.findall(s)
@@ -182,26 +182,42 @@ class TextFeaturizer(Estimator, HasInputCol, HasOutputCol):
 
     def fit(self, table: DataTable) -> PipelineModel:
         col, out = self.input_col, self.output_col
+        # intermediate names must not collide with existing user columns
+        # (they would be overwritten and then dropped)
+        intermediates: list[str] = []
+
+        def fresh(base: str) -> str:
+            name = base
+            i = 1
+            while name in table or name in intermediates or name == out:
+                name = f"{base}_{i}"
+                i += 1
+            intermediates.append(name)
+            return name
+
         stages: list = []
         cur = col
         if self.use_tokenizer:
+            nxt = fresh("__tokens")
             stages.append(Tokenizer(
-                input_col=cur, output_col="__tokens",
+                input_col=cur, output_col=nxt,
                 gaps=self.tokenizer_gaps, pattern=self.tokenizer_pattern,
                 to_lowercase=self.to_lowercase,
                 min_token_length=self.min_token_length))
-            cur = "__tokens"
+            cur = nxt
         if self.use_stop_words_remover:
+            nxt = fresh("__nostop")
             stages.append(StopWordsRemover(
-                input_col=cur, output_col="__nostop",
+                input_col=cur, output_col=nxt,
                 stop_words=list(self.stop_words) if self.stop_words else None,
                 case_sensitive=self.case_sensitive_stop_words))
-            cur = "__nostop"
+            cur = nxt
         if self.use_ngram:
-            stages.append(NGram(input_col=cur, output_col="__ngrams",
+            nxt = fresh("__ngrams")
+            stages.append(NGram(input_col=cur, output_col=nxt,
                                 n=self.ngram_length))
-            cur = "__ngrams"
-        tf_out = "__tf" if self.use_idf else out
+            cur = nxt
+        tf_out = fresh("__tf") if self.use_idf else out
         stages.append(HashingTF(input_col=cur, output_col=tf_out,
                                 num_features=self.num_features,
                                 binary=self.binary))
@@ -209,10 +225,6 @@ class TextFeaturizer(Estimator, HasInputCol, HasOutputCol):
             stages.append(IDF(input_col=tf_out, output_col=out,
                               min_doc_freq=self.min_doc_freq))
         model = Pipeline(stages).fit(table)
-        # hide intermediate columns from the final output
-        intermediates = [c for c in
-                         ("__tokens", "__nostop", "__ngrams", "__tf")
-                         if c != out]
         return PipelineModel(stages=list(model.stages) +
                              [_DropIfPresent(cols=intermediates)])
 

@@ -38,6 +38,11 @@ class TestTokenizer:
         assert out["t"][0] == ["bb", "ccc"]
         assert out["t"][1] == []
 
+    def test_nan_is_missing_not_token(self):
+        t = DataTable({"s": ["apple", np.nan, None]})
+        out = Tokenizer(input_col="s", output_col="t").transform(t)
+        assert out["t"][1] == [] and out["t"][2] == []
+
 
 class TestStopWordsAndNGram:
     def test_stop_words_default(self):
@@ -109,6 +114,13 @@ class TestTextFeaturizer:
         out2 = PipelineStage.load(p).transform(t)
         np.testing.assert_allclose(out2.column_matrix("feats"), mat)
 
+    def test_user_columns_with_intermediate_names_survive(self):
+        t = DataTable({"text": ["a b", "c d"], "__tokens": ["keep", "me"]})
+        model = TextFeaturizer(input_col="text", output_col="f",
+                               num_features=32).fit(t)
+        out = model.transform(t)
+        assert list(out["__tokens"]) == ["keep", "me"]
+
     def test_ngram_path(self):
         t = DataTable({"text": ["a b c d"]})
         model = TextFeaturizer(input_col="text", output_col="f",
@@ -152,6 +164,25 @@ class TestAssembleFeatures:
         out2 = model.transform(DataTable({"s": ["durian"]}))
         assert out2.column_matrix("features").sum() == 0.0
 
+    def test_single_level_categorical_contributes_nothing(self):
+        t = DataTable({"c": ["a", "a", "a"],
+                       "x": np.array([1.0, 2.0, 3.0])})
+        t = ValueIndexer(input_col="c", output_col="c").fit(t).transform(t)
+        model = AssembleFeatures(columns_to_featurize=["c", "x"]).fit(t)
+        mat = model.transform(t).column_matrix("features")
+        assert mat.shape == (3, 1)  # drop-last on k=1 gives zero slots
+        np.testing.assert_allclose(mat[:, 0], [1.0, 2.0, 3.0])
+
+    def test_missing_image_row_dropped(self):
+        from mmlspark_tpu.core.schema import make_image
+        img = make_image("p", np.ones((1, 2, 3), dtype=np.uint8))
+        t = DataTable({"im": [img, None]})
+        t = t.with_meta("im", **{SchemaConstants.K_IMAGE: True})
+        model = AssembleFeatures(columns_to_featurize=["im"],
+                                 allow_images=True).fit(t)
+        mat = model.transform(t).column_matrix("features")
+        assert mat.shape == (1, 8)
+
     def test_dates(self):
         t = DataTable({"d": [datetime(2017, 9, 1, 12, 30, 5),
                              datetime(2018, 1, 2)]})
@@ -169,8 +200,8 @@ class TestAssembleFeatures:
         np.testing.assert_allclose(mat, [[1, 2, 9], [3, 4, 10]])
 
     def test_image_gate(self):
-        img = {"path": "p", "height": 1, "width": 2, "type": 0,
-               "bytes": np.zeros(6, dtype=np.uint8)}
+        from mmlspark_tpu.core.schema import make_image
+        img = make_image("p", np.zeros((1, 2, 3), dtype=np.uint8))
         t = DataTable({"im": [img]})
         t = t.with_meta("im", **{SchemaConstants.K_IMAGE: True})
         with pytest.raises(ValueError, match="allow_images"):
