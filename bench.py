@@ -62,6 +62,13 @@ def main() -> None:
     y = rng.integers(0, 10, size=batch)
 
     trainer.state = trainer.init_state(x.shape[1:])
+    # batches must be committed to the dp sharding: the jit infers shardings
+    # from its args, so an uncommitted numpy batch would replicate (each chip
+    # redundantly computing the full batch) and skew per-chip throughput
+    from mmlspark_tpu.parallel.mesh import batch_sharding
+    data = batch_sharding(trainer.mesh)
+    x = jax.device_put(x, data)
+    y = jax.device_put(y, data)
     # warmup/compile
     state, _ = trainer.step(trainer.state, x, y)
     jax.block_until_ready(state["params"])

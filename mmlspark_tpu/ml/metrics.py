@@ -143,14 +143,17 @@ class ComputeModelStatistics(Transformer):
         self.confusion_matrix_ = cm
 
         # rows whose TRUE label is unseen (-1) cannot be scored and are
-        # excluded; an unseen PREDICTED label counts as an error
+        # excluded; an unseen PREDICTED label counts as an error. Recall
+        # denominators therefore count every scorable row (not just cm rows,
+        # which exclude invalid predictions); precision is per predicted
+        # class, so invalid predictions contribute to no class.
         scorable = (y >= 0) & (y < k)
         y, pred = y[scorable], pred[scorable]
         n = len(y)
         accuracy = float((y == pred).sum()) / n if n else 0.0
         tp = np.diag(cm).astype(np.float64)
         pred_pos = cm.sum(axis=0).astype(np.float64)
-        actual_pos = cm.sum(axis=1).astype(np.float64)
+        actual_pos = np.bincount(y, minlength=k).astype(np.float64)
         with np.errstate(divide="ignore", invalid="ignore"):
             prec_per = np.where(pred_pos > 0, tp / pred_pos, 0.0)
             rec_per = np.where(actual_pos > 0, tp / actual_pos, 0.0)
@@ -172,7 +175,7 @@ class ComputeModelStatistics(Transformer):
                 auc_val = auc(fpr, tpr)
             row["AUC"] = auc_val
         else:
-            micro = float(tp.sum() / cm.sum()) if cm.sum() else 0.0
+            micro = float(tp.sum() / n) if n else 0.0
             row["micro_precision"] = micro
             row["micro_recall"] = micro
             row["macro_precision"] = float(prec_per.mean())

@@ -39,6 +39,27 @@ def featurize_params_for(learner: Learner) -> tuple[int, bool]:
     return NUM_FEATURES_DEFAULT, True
 
 
+def featurize_and_extract(table: DataTable, label_col: str, y: np.ndarray,
+                          feature_columns: Any, n_feats: int, one_hot: bool
+                          ) -> tuple[Any, str, np.ndarray, np.ndarray]:
+    """Shared Train* wiring: fit Featurize on the non-label columns, thread
+    the label through the row-dropping transform, return
+    (featurize_model, features_col, x, y)."""
+    feat_cols = list(feature_columns or
+                     [c for c in table.columns if c != label_col])
+    features_col = find_unused_column_name(table, "features")
+    feat_model = Featurize(
+        feature_columns={features_col: feat_cols},
+        number_of_features=n_feats,
+        one_hot_encode_categoricals=one_hot,
+        allow_images=True).fit(table)
+    # temp label column must not collide with a real feature column
+    label_tmp = find_unused_column_name(table, "__label")
+    feat = feat_model.transform(table.with_column(label_tmp, y))
+    x = feat.column_matrix(features_col)
+    return feat_model, features_col, x, np.asarray(feat[label_tmp])
+
+
 def drop_missing_labels(table: DataTable, label_col: str) -> DataTable:
     col = table[label_col]
     if col.dtype == object:
@@ -73,20 +94,10 @@ class TrainClassifier(Estimator, HasLabelCol):
         n_feats, one_hot = featurize_params_for(learner)
         if self.number_of_features:
             n_feats = self.number_of_features
-        feat_cols = list(self.feature_columns or
-                         [c for c in table.columns if c != self.label_col])
-        features_col = find_unused_column_name(table, "features")
-        featurizer = Featurize(
-            feature_columns={features_col: feat_cols},
-            number_of_features=n_feats,
-            one_hot_encode_categoricals=one_hot,
-            allow_images=True)
-        feat_model = featurizer.fit(table)
-        # temp label-code column must not collide with a real feature column
-        label_tmp = find_unused_column_name(table, "__label")
-        feat_table = feat_model.transform(table.with_column(label_tmp, codes))
-        x = feat_table.column_matrix(features_col)
-        y = np.asarray(feat_table[label_tmp], dtype=np.int64)
+        feat_model, features_col, x, y = featurize_and_extract(
+            table, self.label_col, codes, self.feature_columns, n_feats,
+            one_hot)
+        y = y.astype(np.int64)
 
         fitted = learner.fit_arrays(x, y, num_classes=len(levels))
         return TrainedClassifierModel(

@@ -12,16 +12,14 @@ import numpy as np
 
 from mmlspark_tpu.core.params import Param
 from mmlspark_tpu.core.schema import (
-    SchemaConstants, find_unused_column_name, set_label_column,
-    set_score_column,
+    SchemaConstants, set_label_column, set_score_column,
 )
 from mmlspark_tpu.core.stage import Estimator, HasLabelCol, Transformer
 from mmlspark_tpu.data.table import DataTable
 from mmlspark_tpu.ml.learners import Learner, LinearRegression
 from mmlspark_tpu.ml.train_classifier import (
-    drop_missing_labels, featurize_params_for,
+    drop_missing_labels, featurize_and_extract, featurize_params_for,
 )
-from mmlspark_tpu.stages.featurize import Featurize
 
 
 class TrainRegressor(Estimator, HasLabelCol):
@@ -47,18 +45,9 @@ class TrainRegressor(Estimator, HasLabelCol):
         n_feats, one_hot = featurize_params_for(learner)
         if self.number_of_features:
             n_feats = self.number_of_features
-        feat_cols = list(self.feature_columns or
-                         [c for c in table.columns if c != self.label_col])
-        features_col = find_unused_column_name(table, "features")
-        feat_model = Featurize(
-            feature_columns={features_col: feat_cols},
-            number_of_features=n_feats,
-            one_hot_encode_categoricals=one_hot,
-            allow_images=True).fit(table)
-        label_tmp = find_unused_column_name(table, "__label")
-        feat_table = feat_model.transform(table.with_column(label_tmp, y))
-        x = feat_table.column_matrix(features_col)
-        y = np.asarray(feat_table[label_tmp], dtype=np.float64)
+        feat_model, features_col, x, y = featurize_and_extract(
+            table, self.label_col, y, self.feature_columns, n_feats, one_hot)
+        y = y.astype(np.float64)
 
         fitted = learner.fit_arrays(x, y)
         return TrainedRegressorModel(

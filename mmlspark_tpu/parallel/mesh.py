@@ -92,3 +92,29 @@ def batch_sharding(mesh) -> Any:
 def replicated(mesh) -> Any:
     from jax.sharding import NamedSharding, PartitionSpec as P
     return NamedSharding(mesh, P())
+
+
+def param_shardings(mesh, params) -> Any:
+    """Pytree of shardings for the params: with ``fsdp > 1`` each leaf's
+    largest fsdp-divisible dim is sharded over the fsdp axis (zero-style
+    parameter sharding; XLA all-gathers for the forward and reduce-scatters
+    the grads); leaves with no divisible dim — and everything when
+    ``fsdp == 1`` — replicate."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    fsdp = mesh.shape["fsdp"]
+
+    def one(leaf):
+        shape = getattr(leaf, "shape", ())
+        if fsdp == 1 or len(shape) == 0:
+            return NamedSharding(mesh, P())
+        divisible = [(d, s) for d, s in enumerate(shape) if s % fsdp == 0]
+        if not divisible:
+            return NamedSharding(mesh, P())
+        d = max(divisible, key=lambda t: t[1])[0]
+        spec: list = [None] * len(shape)
+        spec[d] = "fsdp"
+        return NamedSharding(mesh, P(*spec))
+
+    return jax.tree_util.tree_map(one, params)

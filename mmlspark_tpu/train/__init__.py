@@ -1,11 +1,14 @@
 """Training layer: in-process distributed train loops, one-call trainers,
-and evaluation.
+checkpoint/resume, and the JaxLearner estimator.
 
 Replaces the reference's out-of-process ``mpiexec cntk`` training
 (reference: cntk-train/src/main/scala/CNTKLearner.scala:52-162) with
 jit-compiled steps sharded over a device mesh.
 """
 
+from mmlspark_tpu.train.checkpoint import TrainCheckpointer
+from mmlspark_tpu.train.learner import JaxLearner, JaxLearnerModel
 from mmlspark_tpu.train.loop import TrainConfig, Trainer, make_train_step
 
-__all__ = ["TrainConfig", "Trainer", "make_train_step"]
+__all__ = ["JaxLearner", "JaxLearnerModel", "TrainCheckpointer",
+           "TrainConfig", "Trainer", "make_train_step"]

@@ -1,0 +1,181 @@
+"""JaxLearner — the distributed DNN-training estimator.
+
+The CNTKLearner analog (reference: cntk-train/src/main/scala/
+CNTKLearner.scala:52-162). The reference featurizes + assembles, writes the
+dataset as CNTK text format to shared storage, generates BrainScript, and
+shells out to ``mpiexec -n <gpuCount> cntk ... parallelTrain=true``
+(CommandBuilders.scala:79-93), then wraps the resulting model file in
+CNTKModel. The TPU-native redesign trains **in-process**:
+
+* featurize/assemble = the same ``Featurize`` path (``reduceAndAssemble``
+  analog, reference: cntk-train DataConversion.scala:69-84) — or a direct
+  vector/image column,
+* no text-file hand-off, no external process: the featurized matrix is
+  device-sharded directly (host RAM → HBM, one copy),
+* the MPI ring = a ``dp`` mesh axis; 1-bit-SGD all-reduce = XLA ``psum``
+  over ICI inserted by the compiler; multi-host spans slices over DCN after
+  ``distributed_init`` (no hostfile stubs),
+* the result wraps into a :class:`JaxModel` transformer exactly as
+  CNTKLearner returns a CNTKModel (CNTKLearner.scala:158-161), and
+* mid-training checkpoint/resume comes free from the Trainer (beyond
+  reference parity — CNTK epoch checkpoints were not resumable through the
+  estimator).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from mmlspark_tpu.core.params import Param
+from mmlspark_tpu.core.schema import (
+    find_unused_column_name, is_image_column,
+)
+from mmlspark_tpu.core.stage import Estimator, HasLabelCol, Transformer
+from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.models.bundle import ModelBundle
+from mmlspark_tpu.models.jax_model import JaxModel, coerce_input_matrix
+from mmlspark_tpu.parallel import mesh as mesh_lib
+from mmlspark_tpu.stages.featurize import Featurize, NUM_FEATURES_TREE_OR_NN
+from mmlspark_tpu.stages.indexers import index_values, sorted_levels
+from mmlspark_tpu.train.loop import TrainConfig, Trainer
+
+
+class JaxLearnerModel(Transformer):
+    """The fitted result of JaxLearner: (optional featurization) → batched
+    JaxModel forward. All three pieces are complex params so the whole
+    scoring pipeline round-trips save/load (the reference's CNTKLearner
+    result is likewise a persistable CNTKModel, CNTKLearner.scala:158-161)."""
+
+    jax_model = Param(default=None, doc="the fitted JaxModel stage",
+                      is_complex=True)
+    featurize_model = Param(default=None, doc="fitted featurization "
+                            "pipeline (None when input_col was direct)",
+                            is_complex=True)
+    label_levels = Param(default=None, doc="label values in code order "
+                         "(classification only)", is_complex=True)
+    final_loss = Param(default=None, doc="last recorded training loss",
+                       type_=float)
+
+    def transform(self, table: DataTable) -> DataTable:
+        t = (self.featurize_model.transform(table)
+             if self.featurize_model is not None else table)
+        return self.jax_model.transform(t)
+
+
+class JaxLearner(Estimator, HasLabelCol):
+    """Fits a flax module on a table; returns a JaxLearnerModel."""
+
+    module = Param(default=None, doc="flax module to train (None = MLP "
+                   "autosized like the reference's input-dim probe, "
+                   "CNTKLearner.scala:72-84)", is_complex=True)
+    input_col = Param(default=None, doc="vector/image input column "
+                      "(None = auto-featurize all non-label columns)",
+                      type_=str)
+    feature_columns = Param(default=None, doc="columns to auto-featurize",
+                            type_=(list, tuple))
+    input_shape = Param(default=None, doc="per-example shape to reshape "
+                        "features to (e.g. [32, 32, 3] for conv models)",
+                        type_=(list, tuple))
+    loss = Param(default="softmax_xent", doc="loss kind", type_=str,
+                 validator=Param.one_of("softmax_xent", "sigmoid_xent",
+                                        "mse"))
+    epochs = Param(default=5, doc="training epochs", type_=int)
+    batch_size = Param(default=128, doc="global batch size", type_=int)
+    learning_rate = Param(default=1e-3, doc="learning rate", type_=float)
+    optimizer = Param(default="adam", doc="optimizer name", type_=str)
+    momentum = Param(default=0.9, doc="momentum (momentum optimizer)",
+                     type_=float)
+    weight_decay = Param(default=0.0, doc="weight decay (adamw)",
+                         type_=float)
+    seed = Param(default=0, doc="seed", type_=int)
+    mesh_spec = Param(default=None, doc="parallelism layout, e.g. "
+                      "{'dp': -1, 'fsdp': 2}", type_=dict)
+    checkpoint_dir = Param(default=None, doc="mid-training checkpoint dir",
+                           type_=str)
+    checkpoint_every = Param(default=0, doc="steps between checkpoints",
+                             type_=int)
+    resume = Param(default=True, doc="resume from latest checkpoint",
+                   type_=bool)
+    hidden_layers = Param(default=(64,), doc="hidden widths for the default "
+                          "MLP", type_=(list, tuple))
+
+    def fit(self, table: DataTable) -> JaxLearnerModel:
+        label_col = self.label_col
+        is_classification = self.loss in ("softmax_xent", "sigmoid_xent")
+
+        # ---- label handling ----
+        labels = table[label_col]
+        label_levels: list | None = None
+        if is_classification:
+            label_levels = sorted_levels(labels)
+            y = index_values(labels, label_levels).astype(np.int64)
+            num_outputs = max(len(label_levels), 2)
+        else:
+            y = np.asarray(labels, dtype=np.float64)
+            num_outputs = 1
+        if self.loss == "sigmoid_xent":
+            num_outputs = 1
+
+        # ---- input handling: direct column or auto-featurize ----
+        featurize_model = None
+        input_col = self.input_col
+        if input_col is not None:
+            if is_image_column(table, input_col):
+                first = table[input_col][0]
+                spec = tuple(np.asarray(first["data"]).shape)
+            else:
+                spec = (table.column_matrix(input_col).shape[1],)
+            x = coerce_input_matrix(table, input_col, spec)
+        else:
+            feat_cols = list(self.feature_columns or
+                             [c for c in table.columns if c != label_col])
+            features_col = find_unused_column_name(table, "features")
+            featurize_model = Featurize(
+                feature_columns={features_col: feat_cols},
+                number_of_features=NUM_FEATURES_TREE_OR_NN,
+                allow_images=True).fit(table)
+            label_tmp = find_unused_column_name(table, "__label")
+            feat = featurize_model.transform(
+                table.with_column(label_tmp, y))
+            x = feat.column_matrix(features_col)
+            y = np.asarray(feat[label_tmp])
+            input_col = features_col
+
+        if self.input_shape:
+            x = x.reshape((len(x),) + tuple(int(d) for d in self.input_shape))
+
+        # ---- module: user-provided or autosized MLP ----
+        module = self.module
+        if module is None:
+            from mmlspark_tpu.models.zoo import MLP
+            module = MLP(features=tuple(int(w) for w in self.hidden_layers),
+                         num_outputs=num_outputs)
+
+        cfg = TrainConfig(
+            batch_size=self.batch_size, epochs=self.epochs,
+            learning_rate=self.learning_rate, optimizer=self.optimizer,
+            momentum=self.momentum, weight_decay=self.weight_decay,
+            loss=self.loss, seed=self.seed, mesh_spec=self.mesh_spec,
+            checkpoint_dir=self.checkpoint_dir,
+            checkpoint_every=self.checkpoint_every, resume=self.resume)
+        trainer = Trainer(module, cfg)
+        trainer.fit_arrays(x, y.astype(np.float64
+                                       if not is_classification
+                                       else np.int64))
+
+        import jax
+        host_params = jax.tree_util.tree_map(np.asarray, trainer.params)
+        bundle = ModelBundle(
+            module=module, params=host_params,
+            input_spec=tuple(x.shape[1:]),
+            output_names=getattr(type(module), "OUTPUT_NAMES", ("logits",)),
+            name=f"JaxLearner[{type(module).__name__}]")
+        jax_model = JaxModel(model=bundle, input_col=input_col,
+                             output_col="scores")
+        return JaxLearnerModel(
+            jax_model=jax_model, featurize_model=featurize_model,
+            label_levels=label_levels,
+            final_loss=(float(trainer.history[-1])
+                        if trainer.history else None))

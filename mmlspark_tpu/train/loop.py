@@ -41,6 +41,11 @@ class TrainConfig:
     mesh_spec: Any = None            # MeshSpec | dict | None (dp over all)
     donate_state: bool = True
     log_every: int = 50
+    # mid-training checkpoint/resume (beyond-reference capability; SURVEY §5)
+    checkpoint_dir: str | None = None
+    checkpoint_every: int = 0        # global steps between saves; 0 = end only
+    max_to_keep: int = 3
+    resume: bool = True              # restore latest checkpoint if present
 
 
 def make_optimizer(cfg: TrainConfig):
@@ -91,14 +96,15 @@ def make_train_step(module: Any, cfg: TrainConfig, mesh: Any):
     tx = make_optimizer(cfg)
     loss_fn = make_loss(cfg.loss)
     repl = mesh_lib.replicated(mesh)
-    data = mesh_lib.batch_sharding(mesh)
 
     def init_state(input_spec: tuple) -> dict:
         rng = jax.random.PRNGKey(cfg.seed)
         dummy = jnp.zeros((1,) + tuple(input_spec), jnp.float32)
         params = module.init(rng, dummy)["params"]
-        params = jax.device_put(params, repl)
-        opt_state = jax.device_put(tx.init(params), repl)
+        # fsdp > 1 → zero-style parameter sharding; optimizer moments
+        # inherit the leaf shardings through eager zeros_like propagation
+        params = jax.device_put(params, mesh_lib.param_shardings(mesh, params))
+        opt_state = tx.init(params)
         return {"params": params, "opt_state": opt_state,
                 "step": jax.device_put(jnp.zeros((), jnp.int32), repl)}
 
@@ -115,13 +121,11 @@ def make_train_step(module: Any, cfg: TrainConfig, mesh: Any):
                      "step": state["step"] + 1}
         return new_state, {"loss": loss}
 
+    # shardings are inferred from the committed argument shardings (params
+    # per param_shardings, batches device_put by the caller), so fsdp-sharded
+    # and replicated layouts share one code path
     donate = (0,) if cfg.donate_state else ()
-    step = jax.jit(
-        _step,
-        in_shardings=(repl, data, data),
-        out_shardings=(repl, repl),
-        donate_argnums=donate,
-    )
+    step = jax.jit(_step, donate_argnums=donate)
     return init_state, step
 
 
@@ -154,10 +158,44 @@ class Trainer:
         self.state = None
         self.history: list[float] = []
 
+    def _checkpointer(self):
+        if not self.cfg.checkpoint_dir:
+            return None
+        if getattr(self, "_ckpt", None) is None:
+            from mmlspark_tpu.train.checkpoint import TrainCheckpointer
+            self._ckpt = TrainCheckpointer(self.cfg.checkpoint_dir,
+                                           self.cfg.max_to_keep)
+        return self._ckpt
+
+    def maybe_restore(self) -> int | None:
+        """Resume from the latest checkpoint if configured; returns the
+        restored global step or None."""
+        ckpt = self._checkpointer()
+        if ckpt is None or not self.cfg.resume:
+            return None
+        latest = ckpt.latest_step()
+        if latest is None:
+            return None
+        # restores directly to each target leaf's sharding
+        self.state = ckpt.restore(latest, target=self.state)
+        _log.info(f"resumed from checkpoint step {latest} "
+                  f"({self.cfg.checkpoint_dir})")
+        return latest
+
+    def save_checkpoint(self) -> int | None:
+        ckpt = self._checkpointer()
+        if ckpt is None:
+            return None
+        return ckpt.save(self.state)
+
     def fit_arrays(self, x: np.ndarray, y: np.ndarray) -> "Trainer":
+        import jax
+
         cfg = self.cfg
+        resumed = 0
         if self.state is None:
             self.state = self.init_state(x.shape[1:])
+            resumed = self.maybe_restore() or 0
         # batch must divide over the data axes; round down to a multiple
         dp = self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
         bs = (min(cfg.batch_size, len(x)) // dp) * dp
@@ -165,13 +203,29 @@ class Trainer:
             raise ValueError(
                 f"dataset of {len(x)} rows is smaller than the data-parallel "
                 f"extent {dp}; provide >= {dp} rows or shrink the mesh")
+        data = mesh_lib.batch_sharding(self.mesh)
+        ckpt = self._checkpointer()
+        # resume completes the REMAINDER of the configured schedule: the
+        # first `resumed` (already-trained) steps of the epoch/batch walk are
+        # replayed as no-ops so batch order stays deterministic
+        global_step = 0
         with timed(f"Trainer[{type(self.module).__name__}]", _log, len(x)):
             for epoch in range(cfg.epochs):
                 for i, (bx, by) in enumerate(
                         _batches(x, y, bs, cfg.seed + epoch)):
+                    global_step += 1
+                    if global_step <= resumed:
+                        continue
+                    bx = jax.device_put(bx, data)
+                    by = jax.device_put(by, data)
                     self.state, metrics = self.step(self.state, bx, by)
                     if i % cfg.log_every == 0:
                         self.history.append(float(metrics["loss"]))
+                    if (ckpt is not None and cfg.checkpoint_every > 0
+                            and global_step % cfg.checkpoint_every == 0):
+                        self.save_checkpoint()
+        if ckpt is not None and global_step > resumed:
+            self.save_checkpoint()
         return self
 
     @property

@@ -51,3 +51,41 @@ def default_matmul_dtype():
     """bfloat16 on TPU (MXU-native), float32 elsewhere."""
     import jax.numpy as jnp
     return jnp.bfloat16 if on_tpu() else jnp.float32
+
+
+def distributed_init(coordinator_address: str | None = None,
+                     num_processes: int | None = None,
+                     process_id: int | None = None) -> None:
+    """Join the multi-host training world.
+
+    The multi-node analog of the reference's hostfile-based MPI launcher
+    stub (reference: cntk-train/src/main/scala/CommandBuilders.scala:95-117,
+    never wired in): after this call ``jax.devices()`` is global across all
+    hosts, so the same Mesh/pjit code spans slices (ICI within a slice, DCN
+    between). On TPU pods all arguments are auto-discovered from the
+    environment; pass them explicitly for CPU/GPU clusters.
+    """
+    import jax
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+
+
+def topology_summary() -> dict[str, Any]:
+    """One-call environment report (the GPUCount/nvidia-smi analog)."""
+    import jax
+    devs = jax.devices()
+    return {
+        "backend": jax.default_backend(),
+        "device_count": len(devs),
+        "local_device_count": jax.local_device_count(),
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "device_kind": devs[0].device_kind if devs else "none",
+        "platform": devs[0].platform if devs else "none",
+    }
