@@ -1,0 +1,156 @@
+"""Sequence model family: BiLSTM tagger and Transformer encoder.
+
+The reference's sequence workload is notebook 304 (Medical Entity
+Extraction): a pretrained CNTK BiLSTM run token-tagged sentences padded
+host-side to a fixed 613 tokens, minibatch 1 (reference:
+notebooks/samples/304 - Medical Entity Extraction.ipynb). The TPU-native
+family:
+
+* :class:`BiLSTMTagger` — embeddings → forward+backward LSTM (``nn.RNN``
+  over ``lax.scan``, compiler-friendly recurrence) → per-token logits.
+  Padded/bucketed *batches* replace minibatch-1 (see
+  :func:`bucket_batches`).
+* :class:`TransformerTagger` — encoder blocks whose attention is pluggable:
+  local (single device) or sequence-parallel ring/Ulysses over the ``sp``
+  mesh axis (:mod:`mmlspark_tpu.parallel.ring_attention`) for long
+  sequences.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import numpy as np
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BiLSTMTagger(nn.Module):
+    """Per-token classification over embedded sequences."""
+
+    vocab_size: int = 1024
+    embed_dim: int = 64
+    hidden: int = 128
+    num_tags: int = 8
+    dtype: Any = jnp.float32
+
+    OUTPUT_NAMES = ("features", "logits")
+
+    @nn.compact
+    def __call__(self, tokens, output: str = "logits", train: bool = False,
+                 mask=None):
+        # tokens: [B, L] int32; mask: [B, L] bool (True = real token) — the
+        # backward LSTM must start at each row's true end, not at the pad
+        x = nn.Embed(self.vocab_size, self.embed_dim, name="embed")(
+            tokens.astype(jnp.int32))
+        seq_lengths = (jnp.sum(mask.astype(jnp.int32), axis=1)
+                       if mask is not None else None)
+        fwd = nn.RNN(nn.LSTMCell(self.hidden), name="lstm_fwd")(
+            x, seq_lengths=seq_lengths)
+        bwd = nn.RNN(nn.LSTMCell(self.hidden), reverse=True,
+                     keep_order=True, name="lstm_bwd")(
+            x, seq_lengths=seq_lengths)
+        h = jnp.concatenate([fwd, bwd], axis=-1)
+        if output == "features":
+            return h
+        return nn.Dense(self.num_tags, name="head")(h)
+
+
+class TransformerTagger(nn.Module):
+    """Small encoder for per-token or pooled outputs; attention impl is
+    selected by name so the same params run single-device or
+    sequence-parallel."""
+
+    vocab_size: int = 1024
+    embed_dim: int = 64
+    num_heads: int = 4
+    num_layers: int = 2
+    mlp_dim: int = 128
+    num_tags: int = 8
+    max_len: int = 2048
+    causal: bool = False
+    dtype: Any = jnp.float32
+
+    OUTPUT_NAMES = ("features", "logits")
+
+    @nn.compact
+    def __call__(self, tokens, output: str = "logits", train: bool = False,
+                 attention_fn: Callable | None = None, mask=None):
+        # mask: [B, L] bool (True = real token); pad keys are excluded from
+        # attention so logits don't depend on the bucket's padding amount.
+        # attention_fn receives (q, k, v, kv_mask) — ring_attention /
+        # ulysses_attention accept the same signature via functools.partial.
+        B, L = tokens.shape
+        x = nn.Embed(self.vocab_size, self.embed_dim, name="embed")(
+            tokens.astype(jnp.int32))
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (self.max_len, self.embed_dim))
+        x = x + pos[None, :L]
+        head_dim = self.embed_dim // self.num_heads
+        for i in range(self.num_layers):
+            h = nn.LayerNorm(name=f"ln_a{i}")(x)
+            qkv = nn.Dense(3 * self.embed_dim, name=f"qkv{i}")(h)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(B, L, self.num_heads, head_dim)
+            k = k.reshape(B, L, self.num_heads, head_dim)
+            v = v.reshape(B, L, self.num_heads, head_dim)
+            if attention_fn is None:
+                from mmlspark_tpu.parallel.ring_attention import (
+                    attention_reference,
+                )
+                attn = attention_reference(q, k, v, causal=self.causal,
+                                           kv_mask=mask)
+            else:
+                attn = attention_fn(q, k, v, mask)
+            attn = attn.reshape(B, L, self.embed_dim)
+            x = x + nn.Dense(self.embed_dim, name=f"proj{i}")(attn)
+            h = nn.LayerNorm(name=f"ln_b{i}")(x)
+            h = nn.Dense(self.mlp_dim, name=f"mlp_in{i}")(h)
+            h = nn.gelu(h)
+            x = x + nn.Dense(self.embed_dim, name=f"mlp_out{i}")(h)
+        x = nn.LayerNorm(name="ln_f")(x)
+        if output == "features":
+            return x
+        return nn.Dense(self.num_tags, name="head")(x)
+
+
+# ---- padded/bucketed batching (the 613-token fixed pad, generalized) ----
+
+def pad_sequences(seqs: Sequence[Sequence[int]], length: int,
+                  pad_value: int = 0) -> tuple[np.ndarray, np.ndarray]:
+    """Pad/truncate token sequences to ``length``; returns (tokens, mask)."""
+    out = np.full((len(seqs), length), pad_value, dtype=np.int32)
+    mask = np.zeros((len(seqs), length), dtype=bool)
+    for i, s in enumerate(seqs):
+        n = min(len(s), length)
+        out[i, :n] = np.asarray(s[:n], dtype=np.int32)
+        mask[i, :n] = True
+    return out, mask
+
+
+def bucket_batches(seqs: Sequence[Sequence[int]], batch_size: int,
+                   bucket_sizes: Sequence[int] = (64, 128, 256, 512, 1024),
+                   pad_value: int = 0):
+    """Group sequences into fixed-shape padded batches.
+
+    Sequences are bucketed by length to the smallest covering bucket, so XLA
+    compiles at most ``len(bucket_sizes)`` programs instead of one per
+    unique length — the compilation-model-aware version of the reference's
+    single fixed 613-token pad. Yields (tokens [b, bucket], mask, indices)
+    with original row indices for order restoration.
+    """
+    buckets: dict[int, list[int]] = {b: [] for b in bucket_sizes}
+    overflow = max(bucket_sizes)
+    for i, s in enumerate(seqs):
+        for b in bucket_sizes:
+            if len(s) <= b:
+                buckets[b].append(i)
+                break
+        else:
+            buckets[overflow].append(i)
+    for b, idxs in buckets.items():
+        for start in range(0, len(idxs), batch_size):
+            chunk = idxs[start:start + batch_size]
+            toks, mask = pad_sequences([seqs[i] for i in chunk], b,
+                                       pad_value)
+            yield toks, mask, np.asarray(chunk)

@@ -1,0 +1,182 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+**Beyond reference parity by design.** The reference's only sequence
+workload pads sentences host-side to a fixed 613 tokens and feeds them one
+at a time (minibatch 1) through a pretrained BiLSTM — no sequence
+parallelism of any kind exists there (SURVEY §2.6/§5; reference:
+notebooks/samples/304 - Medical Entity Extraction.ipynb). A TPU-native
+framework must instead treat long context as a first-class axis: sequences
+shard over the ``sp`` mesh axis and attention runs distributed.
+
+Two standard strategies, both expressed as ``shard_map`` collectives so XLA
+schedules them on the ICI rings:
+
+* :func:`ring_attention` — K/V blocks rotate around the ``sp`` ring via
+  ``ppermute`` while each device keeps its Q shard resident; softmax is
+  accumulated online (flash-attention style running max/denominator), so
+  memory stays O(L/sp) per device and compute overlaps the ring transfers.
+* :func:`ulysses_attention` — ``all_to_all`` re-shards [B, L/sp, H, D] to
+  [B, L, H/sp, D] (sequence → head sharding), runs ordinary local attention
+  per head group, and all-to-alls back. Cheaper for moderate L when heads
+  divide the axis; ring wins at very long L.
+
+Both match single-device attention numerics (tests assert this on the
+8-virtual-device CPU mesh).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _local_attention(q, k, v, scale, mask=None):
+    """Plain softmax attention on local blocks: [B, Lq, H, D] x [B, Lk, H, D]."""
+    import jax
+    import jax.numpy as jnp
+
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if mask is not None:
+        scores = jnp.where(mask, scores, -jnp.inf)
+    w = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+
+
+def attention_reference(q, k, v, causal: bool = False, kv_mask=None):
+    """Single-device reference attention (the numerics oracle).
+
+    ``kv_mask``: [B, Lk] bool, True for real (non-pad) keys.
+    """
+    import jax.numpy as jnp
+
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    mask = None
+    if causal:
+        L = q.shape[1]
+        mask = jnp.tril(jnp.ones((L, L), bool))[None, None, :, :]
+    if kv_mask is not None:
+        key_mask = kv_mask[:, None, None, :]
+        mask = key_mask if mask is None else (mask & key_mask)
+    return _local_attention(q, k, v, scale, mask)
+
+
+def ring_attention(q, k, v, mesh, axis: str = "sp", causal: bool = False,
+                   kv_mask=None):
+    """Distributed attention over sequence shards.
+
+    Args are *global* [B, L, H, D] arrays (or already sp-sharded); output is
+    sharded like q. L must divide by the ``axis`` size. ``kv_mask``
+    ([B, L] bool, True = real key) rotates around the ring with its K/V
+    block so pad keys never receive attention weight.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sp = mesh.shape[axis]
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    spec = P(None, axis, None, None)
+    mask_spec = P(None, axis)
+
+    def body(ql, kl, vl, maskl):
+        # ql/kl/vl: [B, l, H, D] local shards; online-softmax accumulation
+        # while K/V blocks rotate around the ring (one hop per step)
+        me = jax.lax.axis_index(axis)
+        B, l, H, D = ql.shape
+        acc = jnp.zeros((B, H, l, D), jnp.float32)
+        denom = jnp.zeros((B, H, l, 1), jnp.float32)
+        m = jnp.full((B, H, l, 1), -jnp.inf, jnp.float32)
+        qf = ql.astype(jnp.float32)
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+        kv = (kl.astype(jnp.float32), vl.astype(jnp.float32), maskl)
+        for step in range(sp):
+            kc, vc, mc = kv
+            # K block index currently resident on this device
+            kv_idx = (me - step) % sp
+            scores = jnp.einsum("bqhd,bkhd->bhqk", qf, kc) * scale
+            keep = mc[:, None, None, :]
+            if causal:
+                q_pos = me * l + jnp.arange(l)[:, None]
+                k_pos = kv_idx * l + jnp.arange(l)[None, :]
+                keep = keep & (k_pos <= q_pos)[None, None]
+            scores = jnp.where(keep, scores, -jnp.inf)
+            blk_max = jnp.max(scores, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m, blk_max)
+            # guard -inf - -inf (fully masked rows so far)
+            corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_new), 0.0)
+            p = jnp.exp(jnp.where(jnp.isfinite(scores), scores - m_new,
+                                  -jnp.inf))
+            acc = acc * corr + jnp.einsum("bhqk,bkhd->bhqd", p, vc)
+            denom = denom * corr + jnp.sum(p, axis=-1, keepdims=True)
+            m = m_new
+            if step + 1 < sp:
+                kv = jax.lax.ppermute(kv, axis, perm)
+        out = acc / jnp.maximum(denom, 1e-30)
+        return jnp.einsum("bhqd->bqhd", out).astype(ql.dtype)
+
+    fn = jax.shard_map(body, mesh=mesh,
+                       in_specs=(spec, spec, spec, mask_spec),
+                       out_specs=spec, check_vma=False)
+    sharding = NamedSharding(mesh, spec)
+    if kv_mask is None:
+        kv_mask = jnp.ones(q.shape[:2], bool)
+    q = jax.device_put(q, sharding)
+    k = jax.device_put(k, sharding)
+    v = jax.device_put(v, sharding)
+    kv_mask = jax.device_put(jnp.asarray(kv_mask, bool),
+                             NamedSharding(mesh, mask_spec))
+    return fn(q, k, v, kv_mask)
+
+
+def ulysses_attention(q, k, v, mesh, axis: str = "sp",
+                      causal: bool = False, kv_mask=None):
+    """All-to-all (DeepSpeed-Ulysses style) sequence parallelism.
+
+    Re-shards sequence → heads with one ``all_to_all``, runs full-sequence
+    local attention on each head group, and re-shards back. H must divide by
+    the ``axis`` size. ``kv_mask``: [B, L] bool, True = real key.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sp = mesh.shape[axis]
+    if q.shape[2] % sp:
+        raise ValueError(
+            f"heads ({q.shape[2]}) must divide the {axis!r} axis ({sp})")
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    spec = P(None, axis, None, None)
+    mask_spec = P(None, axis)
+
+    def body(ql, kl, vl, maskl):
+        # [B, l, H, D] → all_to_all → [B, L, H/sp, D]
+        def a2a(x, split, concat):
+            return jax.lax.all_to_all(x, axis, split_axis=split,
+                                      concat_axis=concat, tiled=True)
+
+        qg = a2a(ql, 2, 1)
+        kg = a2a(kl, 2, 1)
+        vg = a2a(vl, 2, 1)
+        # the mask has no head axis: gather the full [B, L] key mask
+        mask_g = jax.lax.all_gather(maskl, axis, axis=1, tiled=True)
+        mask = mask_g[:, None, None, :]
+        if causal:
+            L = qg.shape[1]
+            mask = mask & jnp.tril(jnp.ones((L, L), bool))[None, None]
+        out = _local_attention(qg.astype(jnp.float32),
+                               kg.astype(jnp.float32),
+                               vg.astype(jnp.float32), scale, mask)
+        return a2a(out.astype(ql.dtype), 1, 2)
+
+    fn = jax.shard_map(body, mesh=mesh,
+                       in_specs=(spec, spec, spec, mask_spec),
+                       out_specs=spec, check_vma=False)
+    sharding = NamedSharding(mesh, spec)
+    if kv_mask is None:
+        kv_mask = jnp.ones(q.shape[:2], bool)
+    q = jax.device_put(q, sharding)
+    k = jax.device_put(k, sharding)
+    v = jax.device_put(v, sharding)
+    kv_mask = jax.device_put(jnp.asarray(kv_mask, bool),
+                             NamedSharding(mesh, mask_spec))
+    return fn(q, k, v, kv_mask)

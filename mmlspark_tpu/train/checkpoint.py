@@ -27,6 +27,10 @@ class TrainCheckpointer:
     """Save/restore train-state pytrees under ``directory/step_<n>/``."""
 
     def __init__(self, directory: str, max_to_keep: int = 3):
+        if max_to_keep < 1:
+            raise ValueError(
+                f"max_to_keep must be >= 1, got {max_to_keep} (the pruning "
+                "loop would delete the checkpoint just written)")
         self.directory = os.path.abspath(directory)
         self.max_to_keep = max_to_keep
         os.makedirs(self.directory, exist_ok=True)

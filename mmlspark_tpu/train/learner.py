@@ -29,15 +29,12 @@ from typing import Any
 import numpy as np
 
 from mmlspark_tpu.core.params import Param
-from mmlspark_tpu.core.schema import (
-    find_unused_column_name, is_image_column,
-)
+from mmlspark_tpu.core.schema import is_image_column
 from mmlspark_tpu.core.stage import Estimator, HasLabelCol, Transformer
 from mmlspark_tpu.data.table import DataTable
 from mmlspark_tpu.models.bundle import ModelBundle
 from mmlspark_tpu.models.jax_model import JaxModel, coerce_input_matrix
-from mmlspark_tpu.parallel import mesh as mesh_lib
-from mmlspark_tpu.stages.featurize import Featurize, NUM_FEATURES_TREE_OR_NN
+from mmlspark_tpu.stages.featurize import NUM_FEATURES_TREE_OR_NN
 from mmlspark_tpu.stages.indexers import index_values, sorted_levels
 from mmlspark_tpu.train.loop import TrainConfig, Trainer
 
@@ -129,19 +126,10 @@ class JaxLearner(Estimator, HasLabelCol):
                 spec = (table.column_matrix(input_col).shape[1],)
             x = coerce_input_matrix(table, input_col, spec)
         else:
-            feat_cols = list(self.feature_columns or
-                             [c for c in table.columns if c != label_col])
-            features_col = find_unused_column_name(table, "features")
-            featurize_model = Featurize(
-                feature_columns={features_col: feat_cols},
-                number_of_features=NUM_FEATURES_TREE_OR_NN,
-                allow_images=True).fit(table)
-            label_tmp = find_unused_column_name(table, "__label")
-            feat = featurize_model.transform(
-                table.with_column(label_tmp, y))
-            x = feat.column_matrix(features_col)
-            y = np.asarray(feat[label_tmp])
-            input_col = features_col
+            from mmlspark_tpu.ml.train_classifier import featurize_and_extract
+            featurize_model, input_col, x, y = featurize_and_extract(
+                table, label_col, y, self.feature_columns,
+                NUM_FEATURES_TREE_OR_NN, one_hot=True)
 
         if self.input_shape:
             x = x.reshape((len(x),) + tuple(int(d) for d in self.input_shape))

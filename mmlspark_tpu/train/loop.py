@@ -121,11 +121,14 @@ def make_train_step(module: Any, cfg: TrainConfig, mesh: Any):
                      "step": state["step"] + 1}
         return new_state, {"loss": loss}
 
-    # shardings are inferred from the committed argument shardings (params
-    # per param_shardings, batches device_put by the caller), so fsdp-sharded
-    # and replicated layouts share one code path
+    # state shardings are inferred from the committed arrays built by
+    # init_state (replicated or fsdp-sharded per param_shardings); batch
+    # shardings stay EXPLICIT so direct callers passing host numpy batches
+    # still get dp-sharded data rather than silent replication
+    data = mesh_lib.batch_sharding(mesh)
     donate = (0,) if cfg.donate_state else ()
-    step = jax.jit(_step, donate_argnums=donate)
+    step = jax.jit(_step, in_shardings=(None, data, data),
+                   donate_argnums=donate)
     return init_state, step
 
 
