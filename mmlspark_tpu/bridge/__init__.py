@@ -1,0 +1,15 @@
+"""Spark → TPU offload bridge.
+
+Analog of the reference's executor-side inference path: Spark broadcasts the
+model and each executor partition is minibatched through JNI into CNTK
+(reference: cntk-model/src/main/scala/CNTKModel.scala:51-114, 248-256).
+Here Spark executors stream **Arrow record batches** (``mapInArrow``) to a
+host-side bridge that pads them into fixed device shapes, runs the
+jit-compiled function, and merges results back row-wise in order.
+"""
+
+from mmlspark_tpu.bridge.offload import (
+    ArrowBatchBridge, make_map_in_arrow_fn,
+)
+
+__all__ = ["ArrowBatchBridge", "make_map_in_arrow_fn"]

@@ -78,8 +78,9 @@ class TransformerTagger(nn.Module):
                  attention_fn: Callable | None = None, mask=None):
         # mask: [B, L] bool (True = real token); pad keys are excluded from
         # attention so logits don't depend on the bucket's padding amount.
-        # attention_fn receives (q, k, v, kv_mask) — ring_attention /
-        # ulysses_attention accept the same signature via functools.partial.
+        # attention_fn receives (q, k, v, kv_mask, causal) so a
+        # causal-configured model stays causal on the sequence-parallel
+        # path — ring_attention/ulysses_attention take the same kwargs.
         B, L = tokens.shape
         x = nn.Embed(self.vocab_size, self.embed_dim, name="embed")(
             tokens.astype(jnp.int32))

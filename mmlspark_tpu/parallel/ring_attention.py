@@ -20,8 +20,10 @@ schedules them on the ICI rings:
   per head group, and all-to-alls back. Cheaper for moderate L when heads
   divide the axis; ring wins at very long L.
 
-Both match single-device attention numerics (tests assert this on the
-8-virtual-device CPU mesh).
+Both shard the batch dim over ``dp`` as well (each dp group computes only
+its batch slice on a dp×sp mesh), and both match single-device attention
+numerics — including all-zero outputs for fully-masked query rows (tests
+assert this on the 8-virtual-device CPU mesh).
 """
 
 from __future__ import annotations
@@ -29,15 +31,24 @@ from __future__ import annotations
 import numpy as np
 
 
+def _masked_softmax(scores, jnp):
+    """Softmax over the last axis where -inf marks masked entries; rows with
+    ALL entries masked yield zero weights (not NaN), matching the ring
+    path's guarded accumulator."""
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    m = jnp.where(jnp.isfinite(m), m, 0.0)
+    e = jnp.exp(scores - m)  # exp(-inf) == 0 for masked entries
+    return e / jnp.maximum(jnp.sum(e, axis=-1, keepdims=True), 1e-30)
+
+
 def _local_attention(q, k, v, scale, mask=None):
     """Plain softmax attention on local blocks: [B, Lq, H, D] x [B, Lk, H, D]."""
-    import jax
     import jax.numpy as jnp
 
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if mask is not None:
         scores = jnp.where(mask, scores, -jnp.inf)
-    w = jax.nn.softmax(scores, axis=-1)
+    w = _masked_softmax(scores, jnp)
     return jnp.einsum("bhqk,bkhd->bqhd", w, v)
 
 
@@ -59,23 +70,52 @@ def attention_reference(q, k, v, causal: bool = False, kv_mask=None):
     return _local_attention(q, k, v, scale, mask)
 
 
-def ring_attention(q, k, v, mesh, axis: str = "sp", causal: bool = False,
-                   kv_mask=None):
-    """Distributed attention over sequence shards.
+def _resolve_batch_axis(mesh, batch_axis):
+    """Batch dim shards over ``batch_axis`` when the mesh has it (size-1
+    axes are harmless); None disables batch sharding."""
+    if batch_axis is not None and batch_axis in mesh.shape:
+        return batch_axis
+    return None
 
-    Args are *global* [B, L, H, D] arrays (or already sp-sharded); output is
-    sharded like q. L must divide by the ``axis`` size. ``kv_mask``
-    ([B, L] bool, True = real key) rotates around the ring with its K/V
-    block so pad keys never receive attention weight.
-    """
+
+def _run_sharded(body, mesh, axis, batch_axis, q, k, v, kv_mask):
+    """Shared tail of both strategies: build specs, commit inputs, shard_map."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    b_axis = _resolve_batch_axis(mesh, batch_axis)
+    spec = P(b_axis, axis, None, None)
+    mask_spec = P(b_axis, axis)
+    fn = jax.shard_map(body, mesh=mesh,
+                       in_specs=(spec, spec, spec, mask_spec),
+                       out_specs=spec, check_vma=False)
+    if kv_mask is None:
+        kv_mask = jnp.ones(q.shape[:2], bool)
+    sharding = NamedSharding(mesh, spec)
+    q = jax.device_put(q, sharding)
+    k = jax.device_put(k, sharding)
+    v = jax.device_put(v, sharding)
+    kv_mask = jax.device_put(jnp.asarray(kv_mask, bool),
+                             NamedSharding(mesh, mask_spec))
+    return fn(q, k, v, kv_mask)
+
+
+def ring_attention(q, k, v, mesh, axis: str = "sp", causal: bool = False,
+                   kv_mask=None, batch_axis: str | None = "dp"):
+    """Distributed attention over sequence shards.
+
+    Args are *global* [B, L, H, D] arrays (or already sharded); output is
+    sharded like q. L must divide by the ``axis`` size, B by the
+    ``batch_axis`` size. ``kv_mask`` ([B, L] bool, True = real key) rotates
+    around the ring with its K/V block so pad keys never receive attention
+    weight.
+    """
+    import jax
+    import jax.numpy as jnp
+
     sp = mesh.shape[axis]
     scale = 1.0 / np.sqrt(q.shape[-1])
-    spec = P(None, axis, None, None)
-    mask_spec = P(None, axis)
 
     def body(ql, kl, vl, maskl):
         # ql/kl/vl: [B, l, H, D] local shards; online-softmax accumulation
@@ -114,22 +154,12 @@ def ring_attention(q, k, v, mesh, axis: str = "sp", causal: bool = False,
         out = acc / jnp.maximum(denom, 1e-30)
         return jnp.einsum("bhqd->bqhd", out).astype(ql.dtype)
 
-    fn = jax.shard_map(body, mesh=mesh,
-                       in_specs=(spec, spec, spec, mask_spec),
-                       out_specs=spec, check_vma=False)
-    sharding = NamedSharding(mesh, spec)
-    if kv_mask is None:
-        kv_mask = jnp.ones(q.shape[:2], bool)
-    q = jax.device_put(q, sharding)
-    k = jax.device_put(k, sharding)
-    v = jax.device_put(v, sharding)
-    kv_mask = jax.device_put(jnp.asarray(kv_mask, bool),
-                             NamedSharding(mesh, mask_spec))
-    return fn(q, k, v, kv_mask)
+    return _run_sharded(body, mesh, axis, batch_axis, q, k, v, kv_mask)
 
 
 def ulysses_attention(q, k, v, mesh, axis: str = "sp",
-                      causal: bool = False, kv_mask=None):
+                      causal: bool = False, kv_mask=None,
+                      batch_axis: str | None = "dp"):
     """All-to-all (DeepSpeed-Ulysses style) sequence parallelism.
 
     Re-shards sequence → heads with one ``all_to_all``, runs full-sequence
@@ -138,15 +168,12 @@ def ulysses_attention(q, k, v, mesh, axis: str = "sp",
     """
     import jax
     import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
     sp = mesh.shape[axis]
     if q.shape[2] % sp:
         raise ValueError(
             f"heads ({q.shape[2]}) must divide the {axis!r} axis ({sp})")
     scale = 1.0 / np.sqrt(q.shape[-1])
-    spec = P(None, axis, None, None)
-    mask_spec = P(None, axis)
 
     def body(ql, kl, vl, maskl):
         # [B, l, H, D] → all_to_all → [B, L, H/sp, D]
@@ -168,15 +195,4 @@ def ulysses_attention(q, k, v, mesh, axis: str = "sp",
                                vg.astype(jnp.float32), scale, mask)
         return a2a(out.astype(ql.dtype), 1, 2)
 
-    fn = jax.shard_map(body, mesh=mesh,
-                       in_specs=(spec, spec, spec, mask_spec),
-                       out_specs=spec, check_vma=False)
-    sharding = NamedSharding(mesh, spec)
-    if kv_mask is None:
-        kv_mask = jnp.ones(q.shape[:2], bool)
-    q = jax.device_put(q, sharding)
-    k = jax.device_put(k, sharding)
-    v = jax.device_put(v, sharding)
-    kv_mask = jax.device_put(jnp.asarray(kv_mask, bool),
-                             NamedSharding(mesh, mask_spec))
-    return fn(q, k, v, kv_mask)
+    return _run_sharded(body, mesh, axis, batch_axis, q, k, v, kv_mask)
