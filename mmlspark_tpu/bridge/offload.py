@@ -39,6 +39,13 @@ _log = get_logger(__name__)
 _SENTINEL = object()
 
 
+class _ReaderError:
+    """Carries a source-iterator exception across the prefetch queue."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
 class ArrowBatchBridge:
     """Streams Arrow record batches through a table→table transformer.
 
@@ -53,9 +60,14 @@ class ArrowBatchBridge:
         self.latencies_ms: list[float] = []
 
     def _reader(self, source: Iterable, q: "queue.Queue") -> None:
+        # a mid-stream source failure must reach the consumer as the original
+        # exception, not as a clean end-of-stream (silent truncation of
+        # scored output in the Spark offload path)
         try:
             for item in source:
                 q.put(item)
+        except BaseException as exc:  # noqa: BLE001 — re-raised in process()
+            q.put(_ReaderError(exc))
         finally:
             q.put(_SENTINEL)
 
@@ -71,6 +83,8 @@ class ArrowBatchBridge:
             item = q.get()
             if item is _SENTINEL:
                 break
+            if isinstance(item, _ReaderError):
+                raise item.exc
             t0 = time.perf_counter()
             table = DataTable.from_arrow(item)
             out = self.transformer.transform(table)

@@ -221,11 +221,20 @@ class ComputePerInstanceStatistics(Transformer):
             table, self.label_col, self.scores_col, self.scored_labels_col)
         if kind == SchemaConstants.REGRESSION_KIND or (
                 kind is None and scored_labels is None):
+            if label is None or scores is None:
+                raise ValueError(
+                    "need label and scores columns: the table carries no "
+                    "score metadata, so set label_col/scores_col explicitly")
             y = np.asarray(table[label], dtype=np.float64)
             pred = np.asarray(table[scores], dtype=np.float64)
             out = table.with_column("L1_loss", np.abs(y - pred))
             return out.with_column("L2_loss", (y - pred) ** 2)
         # classification log-loss from the probability vectors
+        if label is None or scores is None:
+            raise ValueError(
+                "need label and scores columns: the scored-labels metadata "
+                "identifies a classification table but no label/probability "
+                "columns were found — set label_col/scores_col explicitly")
         levels = get_categorical_levels(table, scored_labels)
         if levels is None:
             raise ValueError("scored-labels column carries no levels")

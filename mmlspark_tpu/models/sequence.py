@@ -102,7 +102,7 @@ class TransformerTagger(nn.Module):
                 attn = attention_reference(q, k, v, causal=self.causal,
                                            kv_mask=mask)
             else:
-                attn = attention_fn(q, k, v, mask)
+                attn = attention_fn(q, k, v, mask, self.causal)
             attn = attn.reshape(B, L, self.embed_dim)
             x = x + nn.Dense(self.embed_dim, name=f"proj{i}")(attn)
             h = nn.LayerNorm(name=f"ln_b{i}")(x)
@@ -140,6 +140,8 @@ def bucket_batches(seqs: Sequence[Sequence[int]], batch_size: int,
     single fixed 613-token pad. Yields (tokens [b, bucket], mask, indices)
     with original row indices for order restoration.
     """
+    # ascending order makes the first covering bucket below the smallest
+    bucket_sizes = sorted(bucket_sizes)
     buckets: dict[int, list[int]] = {b: [] for b in bucket_sizes}
     overflow = max(bucket_sizes)
     for i, s in enumerate(seqs):

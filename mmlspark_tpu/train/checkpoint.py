@@ -65,7 +65,13 @@ class TrainCheckpointer:
 
     # -- save/restore --
 
-    def save(self, state: Any, step: int | None = None) -> int:
+    def fingerprint(self) -> dict[str, Any] | None:
+        """The training-schedule fingerprint recorded at save time (or None
+        for checkpoints written before one was recorded)."""
+        return self._read_manifest().get("fingerprint")
+
+    def save(self, state: Any, step: int | None = None,
+             fingerprint: dict[str, Any] | None = None) -> int:
         import orbax.checkpoint as ocp
 
         if step is None:
@@ -80,6 +86,8 @@ class TrainCheckpointer:
         ckptr.save(path, state)
         ckptr.wait_until_finished()
         m = self._read_manifest()
+        if fingerprint is not None:
+            m["fingerprint"] = fingerprint
         if step not in m["steps"]:
             m["steps"].append(step)
         m["steps"].sort()

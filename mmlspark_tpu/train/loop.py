@@ -160,6 +160,7 @@ class Trainer:
             module, self.cfg, self.mesh)
         self.state = None
         self.history: list[float] = []
+        self._fingerprint: dict | None = None
 
     def _checkpointer(self):
         if not self.cfg.checkpoint_dir:
@@ -179,6 +180,18 @@ class Trainer:
         latest = ckpt.latest_step()
         if latest is None:
             return None
+        # resume replays the first `resumed` batches as no-ops, which is only
+        # correct if the schedule (dataset length, batch size, seed, epochs)
+        # is identical to the run that wrote the checkpoint — validate it
+        saved = ckpt.fingerprint()
+        if (saved is not None and self._fingerprint is not None
+                and saved != self._fingerprint):
+            raise ValueError(
+                "checkpoint schedule fingerprint mismatch: saved "
+                f"{saved} vs current {self._fingerprint}; resuming would "
+                "silently skip the wrong batches. Start a fresh "
+                "checkpoint_dir (or set resume=False) to train with a "
+                "changed dataset/batch_size/seed/epochs")
         # restores directly to each target leaf's sharding
         self.state = ckpt.restore(latest, target=self.state)
         _log.info(f"resumed from checkpoint step {latest} "
@@ -189,16 +202,12 @@ class Trainer:
         ckpt = self._checkpointer()
         if ckpt is None:
             return None
-        return ckpt.save(self.state)
+        return ckpt.save(self.state, fingerprint=self._fingerprint)
 
     def fit_arrays(self, x: np.ndarray, y: np.ndarray) -> "Trainer":
         import jax
 
         cfg = self.cfg
-        resumed = 0
-        if self.state is None:
-            self.state = self.init_state(x.shape[1:])
-            resumed = self.maybe_restore() or 0
         # batch must divide over the data axes; round down to a multiple
         dp = self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
         bs = (min(cfg.batch_size, len(x)) // dp) * dp
@@ -206,6 +215,17 @@ class Trainer:
             raise ValueError(
                 f"dataset of {len(x)} rows is smaller than the data-parallel "
                 f"extent {dp}; provide >= {dp} rows or shrink the mesh")
+        # fingerprint the EFFECTIVE batch size: resuming on a mesh with a
+        # different dp extent changes the rounded bs (and hence the batch
+        # walk) even when cfg.batch_size is unchanged
+        self._fingerprint = {"n_rows": int(len(x)),
+                             "batch_size": int(bs),
+                             "seed": int(cfg.seed),
+                             "epochs": int(cfg.epochs)}
+        resumed = 0
+        if self.state is None:
+            self.state = self.init_state(x.shape[1:])
+            resumed = self.maybe_restore() or 0
         data = mesh_lib.batch_sharding(self.mesh)
         ckpt = self._checkpointer()
         # resume completes the REMAINDER of the configured schedule: the

@@ -55,6 +55,17 @@ class TestArrowBatchBridge:
         assert list(bridge.process(iter([]))) == []
         assert bridge.p50_latency_ms() is None
 
+    def test_source_error_propagates(self, mlp_model):
+        # a mid-stream failure in the Arrow source must surface, not end
+        # the stream cleanly with truncated output
+        def broken_source():
+            yield from stream_table(make_table(32), 16)
+            raise RuntimeError("executor died mid-partition")
+
+        bridge = ArrowBatchBridge(mlp_model)
+        with pytest.raises(RuntimeError, match="executor died"):
+            list(bridge.process(broken_source()))
+
     def test_map_in_arrow_contract(self, mlp_model):
         # fn(iterator) -> iterator, the exact mapInArrow shape
         fn = make_map_in_arrow_fn(mlp_model)

@@ -120,10 +120,35 @@ class TestSequenceModels:
         local = model.apply({"params": params}, toks)
         ring = model.apply(
             {"params": params}, toks,
-            attention_fn=lambda q, k, v, m: ring_attention(
-                q, k, v, sp_mesh, kv_mask=m))
+            attention_fn=lambda q, k, v, m, causal: ring_attention(
+                q, k, v, sp_mesh, causal=causal, kv_mask=m))
         np.testing.assert_allclose(np.asarray(ring), np.asarray(local),
                                    rtol=2e-4, atol=2e-4)
+
+    def test_causal_model_stays_causal_on_parallel_path(self, sp_mesh):
+        # a causal=True tagger must pass causality through attention_fn —
+        # the sequence-parallel path must match the local causal output
+        from mmlspark_tpu.parallel.ring_attention import ring_attention
+        model = TransformerTagger(vocab_size=64, embed_dim=32, num_heads=8,
+                                  num_layers=1, mlp_dim=32, num_tags=4,
+                                  max_len=64, causal=True)
+        toks = np.arange(2 * 32, dtype=np.int32).reshape(2, 32) % 64
+        params = model.init(jax.random.PRNGKey(0), toks)["params"]
+        local = model.apply({"params": params}, toks)
+        ring = model.apply(
+            {"params": params}, toks,
+            attention_fn=lambda q, k, v, m, causal: ring_attention(
+                q, k, v, sp_mesh, causal=causal, kv_mask=m))
+        np.testing.assert_allclose(np.asarray(ring), np.asarray(local),
+                                   rtol=2e-4, atol=2e-4)
+        # and the bidirectional output must genuinely differ (guards against
+        # the parallel path silently ignoring causality)
+        bidi = TransformerTagger(vocab_size=64, embed_dim=32, num_heads=8,
+                                 num_layers=1, mlp_dim=32, num_tags=4,
+                                 max_len=64, causal=False)
+        assert not np.allclose(
+            np.asarray(bidi.apply({"params": params}, toks)),
+            np.asarray(local))
 
 
 class TestPaddingMasks:
@@ -196,5 +221,13 @@ class TestBucketing:
     def test_overlong_truncated_into_top_bucket(self):
         seqs = [list(range(100))]
         batches = list(bucket_batches(seqs, 4, bucket_sizes=(8, 16)))
+        assert len(batches) == 1
+        assert batches[0][0].shape == (1, 16)
+
+    def test_unsorted_bucket_sizes_still_smallest_covering(self):
+        # an unsorted tuple must not over-pad: a 10-token sequence belongs
+        # in the 16 bucket even when 128 is listed first
+        seqs = [list(range(10))]
+        batches = list(bucket_batches(seqs, 4, bucket_sizes=(128, 16, 64)))
         assert len(batches) == 1
         assert batches[0][0].shape == (1, 16)

@@ -130,6 +130,21 @@ class TestCheckpointResume:
             np.asarray(tr2.state["params"]["dense0"]["kernel"]),
             np.asarray(tr1.state["params"]["dense0"]["kernel"]), rtol=1e-6)
 
+    def test_resume_schedule_mismatch_raises(self, tmp_path):
+        # resuming with a changed batch size would silently replay the wrong
+        # batches; the recorded schedule fingerprint must catch it
+        x, y = xor_data(128)
+        ckdir = str(tmp_path / "run")
+        cfg = TrainConfig(batch_size=32, epochs=2, checkpoint_dir=ckdir,
+                          seed=3)
+        Trainer(MLP(features=(16,), num_outputs=2), cfg).fit_arrays(x, y)
+
+        cfg2 = TrainConfig(batch_size=64, epochs=2, checkpoint_dir=ckdir,
+                           seed=3)
+        tr = Trainer(MLP(features=(16,), num_outputs=2), cfg2)
+        with pytest.raises(ValueError, match="fingerprint mismatch"):
+            tr.fit_arrays(x, y)
+
     def test_resume_false_ignores_checkpoints(self, tmp_path):
         x, y = xor_data(64)
         ckdir = str(tmp_path / "run")

@@ -215,6 +215,12 @@ class TestComputePerInstanceStatistics:
         np.testing.assert_allclose(out["L1_loss"], [0.5, 1.0])
         np.testing.assert_allclose(out["L2_loss"], [0.25, 1.0])
 
+    def test_missing_columns_actionable_error(self):
+        # no metadata + no params → actionable message, not KeyError(None)
+        t = DataTable({"a": np.array([1.0, 2.0])})
+        with pytest.raises(ValueError, match="label and scores"):
+            ComputePerInstanceStatistics().transform(t)
+
     def test_classification_log_loss(self):
         t = blobs(100)
         model = TrainClassifier(label_col="label").fit(t)
