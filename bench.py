@@ -100,8 +100,10 @@ def main() -> None:
         vs_baseline = round(mfu / 0.60, 4)
 
     # second BASELINE.json metric: Spark→TPU batch p50 latency through the
-    # Arrow offload bridge (partition → padded device batch → scored rows)
+    # Arrow offload bridge (partition → padded device batch → scored rows),
+    # plus raw batched-inference throughput (notebook-301 scoring path)
     bridge_p50 = None
+    infer_ips = None
     try:
         from mmlspark_tpu.bridge import ArrowBatchBridge
         from mmlspark_tpu.bridge.offload import stream_table
@@ -111,15 +113,27 @@ def main() -> None:
 
         bundle = get_model("ConvNet_CIFAR10")
         jm = JaxModel(model=bundle, input_col="image", output_col="scores",
-                      minibatch_size=256)
-        imgs = rng.integers(0, 255, size=(1024, 32, 32, 3)
-                            ).astype(np.float32)
-        table = DataTable({"image": list(imgs.reshape(1024, -1))})
-        warmup = ArrowBatchBridge(jm)  # first pass pays the XLA compile
-        for _ in warmup.process(stream_table(table, 256)):
+                      minibatch_size=1024)
+        n_inf = 8192
+        # decoded image bytes are uint8 — ship them thin, upcast on device
+        imgs = rng.integers(0, 255, size=(n_inf, 32, 32, 3)
+                            ).astype(np.uint8)
+        table = DataTable({"image": list(imgs.reshape(n_inf, -1))})
+        jm.transform(table)  # compile + param upload
+        infer_dt = None
+        for _ in range(2):  # best-of-2: tunnel throughput is noisy
+            t0 = time.perf_counter()
+            jm.transform(table)
+            dt_i = time.perf_counter() - t0
+            infer_dt = dt_i if infer_dt is None else min(infer_dt, dt_i)
+        infer_ips = round(n_inf / infer_dt / n_dev, 1)
+
+        small = table.take(np.arange(1024))
+        warmup = ArrowBatchBridge(jm)
+        for _ in warmup.process(stream_table(small, 256)):
             pass
         bridge2 = ArrowBatchBridge(jm)
-        for _ in bridge2.process(stream_table(table, 256)):
+        for _ in bridge2.process(stream_table(small, 256)):
             pass
         bridge_p50 = round(bridge2.p50_latency_ms(), 2)
     except Exception as e:  # bridge metric is best-effort in the bench
@@ -132,6 +146,7 @@ def main() -> None:
         "vs_baseline": vs_baseline,
         "device": device,
         "bridge_batch_p50_ms": bridge_p50,
+        "inference_images_per_s_per_chip": infer_ips,
     }))
 
 

@@ -15,6 +15,13 @@ evaluates minibatches, and merges outputs back row-wise
 * dispatch is asynchronous: host marshalling of batch *i+1* overlaps device
   compute of batch *i* (JAX's async dispatch replaces the reference's
   re-batching iterator pipelining),
+* inference is **data-parallel over the device mesh**: params live
+  device-resident (transferred once, replicated) and each minibatch is
+  committed batch-sharded over the ``dp``/``fsdp`` axes, so scoring keeps
+  every chip busy — the reference's primary parallelism (Spark-partition DP
+  inference, CNTKModel.scala:248-256) mapped to one host feeding a mesh,
+* outputs are fetched in a single device→host transfer per transform call
+  (no per-minibatch sync),
 * output-node selection by name or index matches CNTK node selection
   (CNTKModel.scala:98-108).
 """
@@ -32,13 +39,22 @@ from mmlspark_tpu.core.schema import is_image_column
 from mmlspark_tpu.core.stage import HasInputCol, HasOutputCol, Transformer
 from mmlspark_tpu.data.table import DataTable
 from mmlspark_tpu.models.bundle import ModelBundle, PREPROCESSORS
+from mmlspark_tpu.parallel import mesh as mesh_lib
 
 _log = get_logger(__name__)
 
 
+def _source_dtype(col: np.ndarray, sample: Any) -> Any:
+    """uint8 sources stay uint8 (¼ the host→device bytes; the on-device
+    forward upcasts) — decoded image bytes are the hot inference input, as
+    in the reference's byte-typed image schema. Everything else → float32."""
+    d = getattr(np.asarray(sample), "dtype", None)
+    return np.uint8 if d == np.uint8 else np.float32
+
+
 def coerce_input_matrix(table: DataTable, column: str,
                         input_spec: tuple) -> np.ndarray:
-    """Coerce an input column to a float32 [N, *input_spec] array.
+    """Coerce an input column to a [N, *input_spec] array (uint8 or float32).
 
     Accepts: image-struct columns (stacked HWC), vector columns (reshaped to
     the model spec), scalar numeric columns. The dtype-coercion analog of
@@ -46,8 +62,17 @@ def coerce_input_matrix(table: DataTable, column: str,
     """
     col = table[column]
     if is_image_column(table, column):
-        mats = [np.asarray(v["data"], dtype=np.float32) for v in col]
-        batch = np.stack(mats)
+        # one preallocated contiguous buffer; rows copy in without an
+        # intermediate list-of-arrays (vectorized image-column stacking)
+        dtype = _source_dtype(col, col[0]["data"])
+        first = np.asarray(col[0]["data"], dtype=dtype)
+        batch = np.empty((len(col),) + first.shape, dtype=dtype)
+        batch[0] = first
+        for i in range(1, len(col)):
+            batch[i] = col[i]["data"]
+    elif col.dtype == object:
+        batch = table.column_matrix(column,
+                                    dtype=_source_dtype(col, col[0]))
     else:
         batch = table.column_matrix(column, dtype=np.float32)
     want = (len(table),) + tuple(input_spec)
@@ -89,11 +114,16 @@ class JaxModel(Transformer, HasInputCol, HasOutputCol):
         type_=str)
     output_node_index = Param(
         default=None, doc="output node to select, by index", type_=int)
+    mesh_spec = Param(
+        default=None, is_complex=True,
+        doc="inference mesh layout (MeshSpec/dict); None = data parallelism "
+            "over every local device")
 
     def __getstate__(self):
-        # jitted closures don't pickle; drop the cache on copy/serialize
+        # jitted closures and device arrays don't pickle; drop on serialize
         d = self.__dict__.copy()
         d.pop("_jit_cache", None)
+        d.pop("_mesh_cache", None)
         return d
 
     def _resolve_node(self, bundle: ModelBundle) -> str:
@@ -103,27 +133,61 @@ class JaxModel(Transformer, HasInputCol, HasOutputCol):
             return bundle.resolve_output(self.output_node_index)
         return bundle.resolve_output(None)
 
+    def _mesh(self):
+        """The DP inference mesh over this host's devices (multi-host scoring
+        = each host runs its own partition stream, the Spark-executor
+        analog — so local devices, not the global mesh)."""
+        import jax
+
+        if self.__dict__.get("_mesh_cache") is None:
+            spec = self.mesh_spec or mesh_lib.MeshSpec(dp=-1)
+            self.__dict__["_mesh_cache"] = mesh_lib.make_mesh(
+                spec, jax.local_devices())
+        return self.__dict__["_mesh_cache"]
+
     def _compiled_apply(self, bundle: ModelBundle, node: str):
-        # cache the jitted fn per (module, preprocess, node) so repeated
-        # transform() calls reuse one compiled program instead of re-tracing
+        """(jitted fn, device params, batch sharding, data extent) — cached
+        so repeated transform() calls reuse one compiled program AND one
+        host→device param transfer (the broadcast-once analog)."""
         import jax
 
         cache = self.__dict__.setdefault("_jit_cache", {})
-        key = (id(bundle.module), bundle.preprocess, node)
+        key = (id(bundle.module), id(bundle.params), bundle.preprocess, node)
         if key in cache:
             return cache[key]
 
+        mesh = self._mesh()
         pre = PREPROCESSORS.get(bundle.preprocess) if bundle.preprocess else None
 
         def fwd(params, x):
+            import jax.numpy as jnp
+            if x.dtype == jnp.uint8:  # uint8 ships thin, computes as f32
+                x = x.astype(jnp.float32)
             if pre is not None:
                 x = pre(x)
             return bundle.module.apply({"params": params}, x, output=node)
 
-        cache[key] = jax.jit(fwd)
+        if mesh.devices.size == 1:
+            # single-device fast path: plain placement avoids the sharded
+            # transfer/fetch machinery (which costs a round-trip per shard —
+            # pathological through remote-device tunnels)
+            dev = mesh.devices.reshape(-1)[0]
+            dev_params = jax.device_put(bundle.params, dev)
+            fn = jax.jit(fwd)
+            cache[key] = (fn, dev_params, dev, 1)
+            return cache[key]
+
+        repl = mesh_lib.replicated(mesh)
+        data = mesh_lib.batch_sharding(mesh)
+        dev_params = jax.device_put(bundle.params, repl)
+        fn = jax.jit(fwd, in_shardings=(repl, data), out_shardings=data)
+        dp = mesh.shape["dp"] * mesh.shape["fsdp"]
+        cache[key] = (fn, dev_params, data, dp)
         return cache[key]
 
     def transform(self, table: DataTable) -> DataTable:
+        import jax
+
         bundle: ModelBundle = self.model
         if bundle is None:
             raise ValueError("JaxModel: no model set")
@@ -134,12 +198,20 @@ class JaxModel(Transformer, HasInputCol, HasOutputCol):
         with timed(f"JaxModel[{bundle.name}:{node}]", _log, len(table)):
             batch = coerce_input_matrix(table, self.input_col,
                                         bundle.input_spec)
-            fn = self._compiled_apply(bundle, node)
+            fn, dev_params, data, dp = self._compiled_apply(bundle, node)
+            # minibatch must divide over the data axes: round UP to a dp
+            # multiple (padding covers the excess) so every chip gets rows
+            size = -(-min(size, len(batch)) // dp) * dp
             outs = []
             valids = []
-            # async dispatch: device computes batch i while host slices i+1
-            for chunk, valid in minibatches(batch, min(size, len(batch))):
-                outs.append(fn(bundle.params, chunk))
+            # three-stage pipeline via async dispatch: upload of batch i+1
+            # and device→host copy of batch i-1 both overlap compute of
+            # batch i (copy_to_host_async issues the D2H without blocking) —
+            # wall clock ≈ max(H2D, compute, D2H), not their sum
+            for chunk, valid in minibatches(batch, size):
+                out = fn(dev_params, jax.device_put(chunk, data))
+                out.copy_to_host_async()
+                outs.append(out)
                 valids.append(valid)
             host = [np.asarray(o)[:v] for o, v in zip(outs, valids)]
             result = np.concatenate(host) if len(host) > 1 else host[0]

@@ -34,9 +34,13 @@ def test_minibatches_pads_tail():
 
 
 def test_coerce_image_column():
+    # uint8 image bytes stay uint8 (¼ the transfer bytes; device upcasts)
     t = image_table(3)
     m = coerce_input_matrix(t, "image", (32, 32, 3))
-    assert m.shape == (3, 32, 32, 3) and m.dtype == np.float32
+    assert m.shape == (3, 32, 32, 3)
+    assert m.dtype in (np.uint8, np.float32)
+    src = np.asarray(t["image"][0]["data"])
+    assert m.dtype == (np.uint8 if src.dtype == np.uint8 else np.float32)
 
 
 def test_coerce_vector_column_reshape():
@@ -102,6 +106,47 @@ def test_jax_model_bad_node():
     jm.set(model=bundle)
     with pytest.raises(ValueError):
         jm.transform(image_table(2))
+
+
+def test_jax_model_inference_is_mesh_sharded():
+    """Scoring must use every device: batches commit to the dp sharding and
+    params upload once, replicated (CNTKModel's DP inference, mesh-native)."""
+    import jax
+
+    bundle = small_cifar_bundle()
+    jm = JaxModel(input_col="image", output_col="s", minibatch_size=16)
+    jm.set(model=bundle)
+    t = image_table(16)
+    single = np.stack(list(jm.transform(t)["s"]))
+    # the cached compiled entry carries a replicated device param tree and a
+    # dp extent covering all local devices
+    node = jm._resolve_node(bundle)
+    fn, dev_params, data, dp = jm._compiled_apply(bundle, node)
+    assert dp == jax.local_device_count() == 8
+    leaf = jax.tree_util.tree_leaves(dev_params)[0]
+    assert len(leaf.sharding.device_set) == 8
+    # a sharded batch placed through the advertised sharding spans all chips
+    probe = jax.device_put(np.zeros((16, 32, 32, 3), np.float32), data)
+    assert len(probe.sharding.device_set) == 8
+    # numerics match an explicit single-device mesh
+    jm1 = JaxModel(input_col="image", output_col="s", minibatch_size=16,
+                   mesh_spec={"dp": 1})
+    jm1.set(model=bundle)
+    jm1.__dict__["_mesh_cache"] = None
+    import mmlspark_tpu.parallel.mesh as mesh_lib
+    jm1.__dict__["_mesh_cache"] = mesh_lib.make_mesh(
+        {"dp": 1}, jax.local_devices()[:1])
+    one = np.stack(list(jm1.transform(t)["s"]))
+    np.testing.assert_allclose(single, one, rtol=1e-4, atol=1e-4)
+
+
+def test_jax_model_tiny_table_pads_to_mesh():
+    # fewer rows than devices: padding must cover the dp extent
+    bundle = small_cifar_bundle()
+    jm = JaxModel(input_col="image", output_col="s", minibatch_size=64)
+    jm.set(model=bundle)
+    out = jm.transform(image_table(3))
+    assert np.stack(list(out["s"])).shape == (3, 10)
 
 
 def test_jax_model_save_load(tmp_path):
