@@ -149,9 +149,17 @@ def make_map_in_arrow_fn(transformer: Any, prefetch: int = 4
 
 def stream_table(table: DataTable, rows_per_batch: int) -> Iterator:
     """Slice a DataTable into Arrow record batches (test/bench source —
-    stands in for Spark partitions)."""
+    stands in for Spark partitions).
+
+    Batches are built eagerly on the caller's thread: the bridge's prefetch
+    thread then only dequeues ready objects. (Building Arrow arrays on a
+    secondary thread while the main thread drives a remote-device tunnel
+    segfaulted intermittently; a real Spark worker feeds already-decoded
+    record batches, so eager construction is also the faithful shape.)"""
+    out = []
     for start in range(0, len(table), rows_per_batch):
         chunk = table.take(np.arange(start,
                                      min(start + rows_per_batch,
                                          len(table))))
-        yield from chunk.to_arrow().to_batches()
+        out.extend(chunk.to_arrow().to_batches())
+    return iter(out)

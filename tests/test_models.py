@@ -108,6 +108,26 @@ def test_jax_model_bad_node():
         jm.transform(image_table(2))
 
 
+def test_patch_conv_matches_direct_conv():
+    """PatchConv3x3 must be numerically the same op as nn.Conv 3x3 SAME —
+    identical params, identical output (it's a layout trick, not a model
+    change)."""
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    from mmlspark_tpu.models.zoo import PatchConv3x3
+
+    r = np.random.default_rng(0)
+    x = jnp.asarray(r.normal(size=(2, 8, 8, 3)), jnp.float32)
+    pc = PatchConv3x3(16, dtype=jnp.float32)
+    params = pc.init(jax.random.PRNGKey(0), x)["params"]
+    direct = nn.Conv(16, (3, 3), dtype=jnp.float32)
+    out_patch = pc.apply({"params": params}, x)
+    out_direct = direct.apply({"params": params}, x)
+    np.testing.assert_allclose(np.asarray(out_patch),
+                               np.asarray(out_direct), rtol=1e-5, atol=1e-5)
+
+
 def test_jax_model_inference_is_mesh_sharded():
     """Scoring must use every device: batches commit to the dp sharding and
     params upload once, replicated (CNTKModel's DP inference, mesh-native)."""
