@@ -1,0 +1,60 @@
+"""Example 301 — pretrained CNN evaluation (the reference's flagship demo).
+
+Analog of ``301 - CIFAR10 CNTK CNN Evaluation``: download a *pretrained*
+model from the zoo repository, score an image table in device minibatches
+with ``JaxModel``, and compute the confusion matrix / accuracy (reference:
+notebooks/samples/301*.ipynb; CNTKModel.scala:215-262).
+
+Without egress the "zoo" is a local repository built by
+``tools/build_model_repo.py`` (deterministically trained weights; the
+download path — manifest, sha256 cache — is identical).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+from mmlspark_tpu.data.downloader import ModelDownloader
+from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.ml.metrics import confusion_matrix
+from mmlspark_tpu.models.jax_model import JaxModel
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+
+def ensure_repo(repo_dir: str | None = None) -> str:
+    """Build (once) and return the local model repository."""
+    import build_model_repo
+    repo_dir = repo_dir or os.path.join(tempfile.gettempdir(),
+                                        "mmlspark_tpu_model_repo")
+    if not os.path.exists(os.path.join(repo_dir, "MANIFEST.json")):
+        build_model_repo.build(repo_dir, scale="small")
+    return repo_dir
+
+
+def run(scale: str = "small", repo_dir: str | None = None) -> dict:
+    import build_model_repo
+    repo = ensure_repo(repo_dir)
+    n = 512 if scale == "small" else 8192
+
+    path = ModelDownloader(repo).download_by_name("ConvNet_CIFAR10")
+    model = (JaxModel(input_col="image", output_col="scores",
+                      minibatch_size=256)
+             .set_model_location(path))
+
+    x, y = build_model_repo._class_blobs(n, (32, 32, 3), 10, seed=1)
+    table = DataTable({"image": list(x.reshape(n, -1).astype(np.uint8))})
+    scored = model.transform(table)
+    pred = np.stack(list(scored["scores"])).argmax(-1)
+    cm = confusion_matrix(y, pred, 10)
+    acc = float((pred == y).mean())
+    return {"accuracy": acc, "n": n,
+            "confusion_diag": [int(v) for v in np.diag(cm)]}
+
+
+if __name__ == "__main__":
+    print(run())

@@ -83,3 +83,18 @@ def _center_128(x):
     # CIFAR CNTK models center pixels around 0 by subtracting the mean image;
     # a constant 128 shift is the stand-in used by notebook 301's pipeline
     return x - 128.0
+
+
+@register_preprocess("imagenet_norm")
+def _imagenet_norm(x):
+    # standard ImageNet channel statistics on 0-255 RGB input
+    import jax.numpy as jnp
+    mean = jnp.asarray([123.675, 116.28, 103.53], x.dtype)
+    std = jnp.asarray([58.395, 57.12, 57.375], x.dtype)
+    return (x - mean) / std
+
+
+@register_preprocess("scale_pm1")
+def _scale_pm1(x):
+    # 0-255 -> [-1, 1] (the ViT checkpoint-family convention)
+    return x / 127.5 - 1.0

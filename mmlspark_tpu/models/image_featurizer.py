@@ -40,6 +40,19 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
         self.set(model=get_model(name, **kwargs))
         return self
 
+    def set_model_from_repo(self, name: str, repo: Any = None,
+                            cache_dir: str | None = None
+                            ) -> "ImageFeaturizer":
+        """Fetch a *pretrained* bundle through ``ModelDownloader`` (manifest
+        + sha256 cache) — the reference's zoo-download → featurize flow
+        (ModelDownloader.scala:224-251 → ImageFeaturizer.scala:70-74)."""
+        from mmlspark_tpu.data.downloader import (
+            ModelDownloader, load_bundle_file,
+        )
+        path = ModelDownloader(repo, cache_dir).download_by_name(name)
+        self.set(model=load_bundle_file(path))
+        return self
+
     def _resolve_cut_node(self, bundle: ModelBundle) -> str:
         cut = self.cut_output_layers
         names = bundle.output_names

@@ -126,6 +126,15 @@ class JaxModel(Transformer, HasInputCol, HasOutputCol):
         d.pop("_mesh_cache", None)
         return d
 
+    def set_model_location(self, path: str) -> "JaxModel":
+        """Load the model from a published bundle file — the
+        ``CNTKModel.setModelLocation`` analog (reference:
+        CNTKModel.scala:151-154); pair with ``ModelDownloader`` for the
+        zoo-download path."""
+        from mmlspark_tpu.data.downloader import load_bundle_file
+        self.set(model=load_bundle_file(path))
+        return self
+
     def _resolve_node(self, bundle: ModelBundle) -> str:
         if self.output_node is not None:
             return bundle.resolve_output(self.output_node)

@@ -1,0 +1,113 @@
+"""ResNet-50 — the transfer-learning workhorse of the reference zoo.
+
+The reference serves a pretrained CNTK ResNet-50 through its model zoo and
+cuts layers off it for featurization (reference:
+downloader/src/main/scala/Schema.scala:54-74,
+image-featurizer/src/main/scala/ImageFeaturizer.scala:116-140; BASELINE
+config 3 "ResNet-50 ImageFeaturizer"). TPU-first choices:
+
+* NHWC layout, bfloat16 compute, float32 params.
+* **GroupNorm instead of BatchNorm**: batch statistics are mutable state
+  that must all-reduce across every dp replica each step — cross-host sync
+  the functional JAX train step doesn't need. GroupNorm(32) is the standard
+  stateless substitute (same parameter count/shape role) and keeps a model
+  a pure ``params`` pytree end to end (checkpoints, bundles, featurizer
+  cuts all stay trivial).
+* Fully convolutional + global average pool, so featurization works at any
+  input size the pipeline resizes to.
+
+Output nodes: ``features`` (pooled 2048-d embedding, the featurizer cut)
+and ``logits``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class BottleneckBlock(nn.Module):
+    """1×1 → 3×3 → 1×1 bottleneck with projection shortcut (ResNet v1.5:
+    the stride lives on the 3×3)."""
+
+    filters: int
+    strides: int = 1
+    groups: int = 32
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.filters, (1, 1), use_bias=False,
+                    dtype=self.dtype, name="conv1")(x)
+        y = nn.GroupNorm(num_groups=self.groups, dtype=self.dtype,
+                         name="gn1")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), strides=(self.strides,) * 2,
+                    use_bias=False, dtype=self.dtype, name="conv2")(y)
+        y = nn.GroupNorm(num_groups=self.groups, dtype=self.dtype,
+                         name="gn2")(y)
+        y = nn.relu(y)
+        y = nn.Conv(4 * self.filters, (1, 1), use_bias=False,
+                    dtype=self.dtype, name="conv3")(y)
+        y = nn.GroupNorm(num_groups=self.groups, dtype=self.dtype,
+                         name="gn3")(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(4 * self.filters, (1, 1),
+                               strides=(self.strides,) * 2, use_bias=False,
+                               dtype=self.dtype, name="proj")(x)
+            residual = nn.GroupNorm(num_groups=self.groups,
+                                    dtype=self.dtype, name="gn_proj")(
+                residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """ResNet v1.5 with bottleneck blocks; stage_sizes (3,4,6,3) = ResNet-50."""
+
+    num_classes: int = 1000
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)
+    width: int = 64
+    groups: int = 32
+    dtype: Any = jnp.bfloat16
+
+    OUTPUT_NAMES = ("features", "logits")
+
+    @nn.compact
+    def __call__(self, x, output: str = "logits", train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.width, (7, 7), strides=(2, 2), use_bias=False,
+                    dtype=self.dtype, name="conv_stem")(x)
+        x = nn.GroupNorm(num_groups=min(self.groups, self.width),
+                         dtype=self.dtype, name="gn_stem")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            filters = self.width * (2 ** stage)
+            for block in range(n_blocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = BottleneckBlock(
+                    filters=filters, strides=strides,
+                    groups=min(self.groups, filters),
+                    dtype=self.dtype,
+                    name=f"stage{stage}_block{block}")(x)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        features = x.astype(jnp.float32)
+        if output == "features":
+            return features
+        logits = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return logits.astype(jnp.float32)
+
+
+def resnet50(num_classes: int = 1000, dtype: Any = jnp.bfloat16) -> ResNet:
+    return ResNet(num_classes=num_classes, stage_sizes=(3, 4, 6, 3),
+                  dtype=dtype)
+
+
+def resnet18_thin(num_classes: int = 10, width: int = 16,
+                  dtype: Any = jnp.bfloat16) -> ResNet:
+    """Small same-shape-family net for tests/CI (bottleneck (2,2) stages)."""
+    return ResNet(num_classes=num_classes, stage_sizes=(2, 2), width=width,
+                  groups=8, dtype=dtype)

@@ -1,0 +1,94 @@
+"""ViT-B/16 — distributed fine-tune flagship (BASELINE config 5).
+
+A vision transformer is the natural TPU model: patch embedding and every
+block are large dense matmuls that map straight onto the MXU, and the whole
+forward is static-shaped. Design:
+
+* 16×16 patch embed as a strided conv (one big matmul per image),
+* pre-LN encoder blocks (MHSA + MLP), bfloat16 compute / float32 params,
+* global-average-pool head (the standard GAP variant — no class token, so
+  featurization and sequence handling stay uniform with the other models),
+* ``features`` node = pooled, final-LN embedding (the featurizer cut),
+  ``logits`` = classification head.
+
+The B/16 configuration (12 layers, 768 wide, 12 heads, 3072 MLP) matches
+the ubiquitous checkpoint family; smaller configs are constructor args so
+tests exercise the same class.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class EncoderBlock(nn.Module):
+    dim: int
+    heads: int
+    mlp_dim: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
+        h = nn.MultiHeadDotProductAttention(
+            num_heads=self.heads, dtype=self.dtype, name="attn")(h, h)
+        x = x + h
+        h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        h = nn.Dense(self.mlp_dim, dtype=self.dtype, name="mlp_in")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(self.dim, dtype=self.dtype, name="mlp_out")(h)
+        return x + h
+
+
+class ViT(nn.Module):
+    """Vision transformer with GAP head; defaults are B/16."""
+
+    num_classes: int = 1000
+    patch: int = 16
+    dim: int = 768
+    depth: int = 12
+    heads: int = 12
+    mlp_dim: int = 3072
+    dtype: Any = jnp.bfloat16
+
+    OUTPUT_NAMES = ("features", "logits")
+
+    @nn.compact
+    def __call__(self, x, output: str = "logits", train: bool = False):
+        B, H, W, _ = x.shape
+        if H % self.patch or W % self.patch:
+            raise ValueError(
+                f"input {H}x{W} not divisible by patch {self.patch}")
+        x = nn.Conv(self.dim, (self.patch, self.patch),
+                    strides=(self.patch, self.patch), dtype=self.dtype,
+                    name="patch_embed")(x.astype(self.dtype))
+        h, w = x.shape[1], x.shape[2]
+        x = x.reshape(B, h * w, self.dim)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (h * w, self.dim))
+        x = x + pos[None].astype(self.dtype)
+        for i in range(self.depth):
+            x = EncoderBlock(self.dim, self.heads, self.mlp_dim,
+                             dtype=self.dtype, name=f"block{i}")(x)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        x = jnp.mean(x, axis=1)  # GAP over patches
+        features = x.astype(jnp.float32)
+        if output == "features":
+            return features
+        logits = nn.Dense(self.num_classes, dtype=self.dtype,
+                          name="head")(x)
+        return logits.astype(jnp.float32)
+
+
+def vit_b16(num_classes: int = 1000, dtype: Any = jnp.bfloat16) -> ViT:
+    return ViT(num_classes=num_classes, dtype=dtype)
+
+
+def vit_tiny(num_classes: int = 10, image_patch: int = 8,
+             dtype: Any = jnp.float32) -> ViT:
+    """Small same-class config for tests/CI."""
+    return ViT(num_classes=num_classes, patch=image_patch, dim=64, depth=2,
+               heads=4, mlp_dim=128, dtype=dtype)

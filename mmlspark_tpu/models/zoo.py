@@ -206,6 +206,63 @@ def mlp(input_dim: int = 16, num_outputs: int = 2, seed: int = 0,
                        (input_dim,), "MLP", seed=seed)
 
 
+@register_model("ResNet50")
+def resnet50_bundle(num_classes: int = 1000, input_size: int = 224,
+                    seed: int = 0, **kw) -> ModelBundle:
+    """BASELINE config 3 backbone (reference zoo's pretrained ResNet-50,
+    Schema.scala:54-74). GroupNorm variant — see models/resnet.py."""
+    from mmlspark_tpu.models.resnet import resnet50
+    return init_bundle(resnet50(num_classes=num_classes, **kw),
+                       (input_size, input_size, 3), "ResNet50",
+                       preprocess="imagenet_norm", seed=seed)
+
+
+@register_model("ResNet_Small")
+def resnet_small_bundle(num_classes: int = 10, input_size: int = 32,
+                        seed: int = 0, **kw) -> ModelBundle:
+    """Same ResNet family at CI scale (tests, local-repo publishing)."""
+    from mmlspark_tpu.models.resnet import resnet18_thin
+    return init_bundle(resnet18_thin(num_classes=num_classes, **kw),
+                       (input_size, input_size, 3), "ResNet_Small",
+                       preprocess="imagenet_norm", seed=seed)
+
+
+@register_model("ViT_B16")
+def vit_b16_bundle(num_classes: int = 1000, input_size: int = 224,
+                   seed: int = 0, **kw) -> ModelBundle:
+    """BASELINE config 5 flagship (distributed fine-tune)."""
+    from mmlspark_tpu.models.vit import vit_b16
+    return init_bundle(vit_b16(num_classes=num_classes, **kw),
+                       (input_size, input_size, 3), "ViT_B16",
+                       preprocess="scale_pm1", seed=seed)
+
+
+@register_model("ViT_Tiny")
+def vit_tiny_bundle(num_classes: int = 10, input_size: int = 32,
+                    seed: int = 0, **kw) -> ModelBundle:
+    from mmlspark_tpu.models.vit import vit_tiny
+    return init_bundle(vit_tiny(num_classes=num_classes, **kw),
+                       (input_size, input_size, 3), "ViT_Tiny",
+                       preprocess="scale_pm1", seed=seed)
+
+
+@register_model("BiLSTM_MedTag")
+def bilstm_medtag_bundle(vocab_size: int = 8192, num_tags: int = 16,
+                         max_len: int = 613, seed: int = 0,
+                         **kw) -> ModelBundle:
+    """Notebook-304 analog (medical entity tagger; the reference pads
+    sentences to a fixed 613 tokens — kept as the default input length)."""
+    import jax as _jax
+
+    from mmlspark_tpu.models.sequence import BiLSTMTagger
+    module = BiLSTMTagger(vocab_size=vocab_size, num_tags=num_tags, **kw)
+    tokens = jnp.zeros((1, max_len), jnp.int32)
+    params = module.init(_jax.random.PRNGKey(seed), tokens)["params"]
+    return ModelBundle(module=module, params=params, input_spec=(max_len,),
+                       output_names=BiLSTMTagger.OUTPUT_NAMES,
+                       name="BiLSTM_MedTag")
+
+
 def get_model(name: str, **kwargs: Any) -> ModelBundle:
     if name not in ZOO:
         raise KeyError(f"unknown zoo model {name!r}; available: {sorted(ZOO)}")

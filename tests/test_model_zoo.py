@@ -1,0 +1,156 @@
+"""Model-zoo content tests: real architectures (ResNet/ViT/BiLSTM), the
+publish → download → featurize pretrained-model flow (reference:
+ModelDownloader.scala:184-252 + ImageFeaturizer.scala:116-140), and
+JaxModel.set_model_location (CNTKModel.scala:151-154 analog)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.data.downloader import (
+    ModelDownloader, load_bundle_file,
+)
+from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.models.image_featurizer import ImageFeaturizer
+from mmlspark_tpu.models.jax_model import JaxModel
+from mmlspark_tpu.models.zoo import ZOO, get_model
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+
+def image_struct_table(n, hw=32, seed=0):
+    r = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        data = r.integers(0, 255, size=(hw, hw, 3)).astype(np.uint8)
+        rows.append({"path": f"img{i}.png", "height": hw, "width": hw,
+                     "channels": 3, "data": data})
+    t = DataTable({"image": rows})
+    return t.with_meta("image", image=True)
+
+
+class TestArchitectures:
+    def test_zoo_has_real_model_families(self):
+        for name in ("ResNet50", "ViT_B16", "BiLSTM_MedTag",
+                     "ResNet_Small", "ViT_Tiny"):
+            assert name in ZOO
+
+    def test_resnet_small_forward_nodes(self):
+        b = get_model("ResNet_Small", num_classes=7)
+        x = np.random.default_rng(0).normal(size=(2, 32, 32, 3)
+                                            ).astype(np.float32)
+        logits = b.module.apply({"params": b.params}, x)
+        feats = b.module.apply({"params": b.params}, x, output="features")
+        assert logits.shape == (2, 7)
+        # thin ResNet (2,2) stages end at width*2*4 channels
+        assert feats.shape == (2, 16 * 2 * 4)
+        assert np.all(np.isfinite(np.asarray(logits, np.float32)))
+
+    def test_resnet50_structure(self):
+        # full-size init is heavy; just check the architecture builds its
+        # tabulated parameter count in the ResNet-50 ballpark (~25M)
+        import jax
+        from mmlspark_tpu.models.resnet import resnet50
+        m = resnet50(num_classes=1000)
+        params = jax.eval_shape(
+            lambda: m.init(jax.random.PRNGKey(0),
+                           np.zeros((1, 224, 224, 3), np.float32)))
+        n = sum(int(np.prod(l.shape))
+                for l in jax.tree_util.tree_leaves(params))
+        assert 20e6 < n < 30e6
+
+    def test_vit_tiny_forward_nodes(self):
+        b = get_model("ViT_Tiny", num_classes=5)
+        x = np.random.default_rng(0).normal(size=(2, 32, 32, 3)
+                                            ).astype(np.float32)
+        logits = b.module.apply({"params": b.params}, x)
+        feats = b.module.apply({"params": b.params}, x, output="features")
+        assert logits.shape == (2, 5) and feats.shape == (2, 64)
+
+    def test_vit_b16_structure(self):
+        import jax
+        from mmlspark_tpu.models.vit import vit_b16
+        m = vit_b16(num_classes=1000)
+        params = jax.eval_shape(
+            lambda: m.init(jax.random.PRNGKey(0),
+                           np.zeros((1, 224, 224, 3), np.float32)))
+        n = sum(int(np.prod(l.shape))
+                for l in jax.tree_util.tree_leaves(params))
+        assert 80e6 < n < 95e6  # B/16 (GAP head) ≈ 86M
+
+    def test_bilstm_bundle_scores_tokens_through_jax_model(self):
+        b = get_model("BiLSTM_MedTag", vocab_size=64, num_tags=4,
+                      max_len=16, embed_dim=8, hidden=8)
+        r = np.random.default_rng(0)
+        toks = [r.integers(0, 64, 16).astype(np.int32) for _ in range(6)]
+        t = DataTable({"tokens": toks})
+        jm = JaxModel(input_col="tokens", output_col="tags",
+                      minibatch_size=4)
+        jm.set(model=b)
+        out = jm.transform(t)
+        tags = np.stack(list(out["tags"]))
+        assert tags.shape == (6, 16, 4)
+
+
+@pytest.fixture(scope="module")
+def model_repo(tmp_path_factory):
+    """Build the local pretrained repo once (the no-egress CDN analog)."""
+    import build_model_repo
+    repo = str(tmp_path_factory.mktemp("model_repo"))
+    entries = build_model_repo.build(repo, scale="small")
+    return repo, {e.name: e for e in entries}
+
+
+class TestPretrainedFlow:
+    def test_manifest_lists_all_published(self, model_repo):
+        repo, entries = model_repo
+        names = {s.name for s in ModelDownloader(repo).list_models()}
+        assert {"ConvNet_CIFAR10", "ResNet_Small", "ViT_Tiny",
+                "BiLSTM_MedTag"} <= names
+
+    def test_downloaded_model_is_actually_trained(self, model_repo):
+        # scoring the training distribution must beat chance by a wide
+        # margin — proves published weights are trained, not random init
+        import build_model_repo
+        repo, _ = model_repo
+        path = ModelDownloader(repo).download_by_name("ConvNet_CIFAR10")
+        jm = JaxModel(input_col="image", output_col="scores",
+                      minibatch_size=64).set_model_location(path)
+        x, y = build_model_repo._class_blobs(128, (32, 32, 3), 10, seed=1)
+        t = DataTable({"image": list(x.reshape(128, -1))})
+        scores = np.stack(list(jm.transform(t)["scores"]))
+        acc = (scores.argmax(-1) == y).mean()
+        assert acc > 0.5, f"accuracy {acc} — weights look untrained"
+
+    def test_featurizer_from_repo_on_real_images(self, model_repo):
+        repo, _ = model_repo
+        t = image_struct_table(5, hw=48)  # featurizer resizes 48 -> 32
+        feats = (ImageFeaturizer(output_col="feat")
+                 .set_model_from_repo("ResNet_Small", repo=repo)
+                 .transform(t))
+        mat = np.stack(list(feats["feat"]))
+        assert mat.shape == (5, 128)
+        assert np.all(np.isfinite(mat))
+
+    def test_featurizer_cut_layers_zero_keeps_head(self, model_repo):
+        repo, _ = model_repo
+        t = image_struct_table(3)
+        out = (ImageFeaturizer(output_col="scores", cut_output_layers=0)
+               .set_model_from_repo("ViT_Tiny", repo=repo)
+               .transform(t))
+        assert np.stack(list(out["scores"])).shape == (3, 10)
+
+    def test_hash_verification_round_trip(self, model_repo):
+        repo, entries = model_repo
+        e = entries["ConvNet_CIFAR10"]
+        assert len(e.hash) == 64 and e.size > 0
+        # a corrupted cache entry is detected and refetched
+        dl = ModelDownloader(repo)
+        path = dl.download(e)
+        with open(path, "wb") as f:
+            f.write(b"corrupt")
+        path2 = dl.download(e)
+        bundle = load_bundle_file(path2)
+        assert bundle.name == "ConvNet_CIFAR10"
