@@ -19,6 +19,7 @@ _DEFAULTS: dict[str, Any] = {
         os.path.expanduser("~"), ".cache", "mmlspark_tpu", "datasets"),
     "model_repo_url": "",          # remote zoo endpoint ("" = local only)
     "default_minibatch_size": 64,
+    "image_threads": 8,            # host-side image-op parallelism
     "log_level": "INFO",
     "timings": True,               # per-stage timing logs (Timer analog)
 }

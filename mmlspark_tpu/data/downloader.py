@@ -124,20 +124,33 @@ class ModelDownloader:
 
     def download(self, schema: ModelSchema) -> str:
         dest = self._cache_path(schema)
+        sidecar = dest + ".sha256"
         if os.path.exists(dest):
-            if not schema.hash or _sha256_file(dest) == schema.hash:
-                return dest  # hash-dedup hit (repoTransfer analog)
+            if schema.hash:
+                if _sha256_file(dest) == schema.hash:
+                    return dest  # hash-dedup hit (repoTransfer analog)
+            elif os.path.exists(sidecar):
+                # manifest carries no hash: verify against the sha256 we
+                # recorded when the fetch completed, so a truncated or
+                # corrupted cache entry is never served (the reference
+                # always records a hash — Schema.scala:34-39; the sidecar
+                # restores that guarantee for hashless manifests)
+                with open(sidecar) as f:
+                    recorded = f.read().strip()
+                if recorded and _sha256_file(dest) == recorded:
+                    return dest
             _log.warning("cached model %s failed hash check; refetching",
                          schema.name)
             os.remove(dest)
         self.repo.fetch(schema, dest)
-        if schema.hash:
-            actual = _sha256_file(dest)
-            if actual != schema.hash:
-                os.remove(dest)
-                raise IOError(
-                    f"model {schema.name!r}: sha256 mismatch "
-                    f"(manifest {schema.hash[:12]}…, got {actual[:12]}…)")
+        actual = _sha256_file(dest)
+        if schema.hash and actual != schema.hash:
+            os.remove(dest)
+            raise IOError(
+                f"model {schema.name!r}: sha256 mismatch "
+                f"(manifest {schema.hash[:12]}…, got {actual[:12]}…)")
+        with open(sidecar, "w") as f:
+            f.write(actual)
         return dest
 
     def download_models(self, names: Iterable[str] | None = None) -> list[str]:

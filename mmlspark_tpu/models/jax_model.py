@@ -63,8 +63,14 @@ def coerce_input_matrix(table: DataTable, column: str,
     col = table[column]
     if is_image_column(table, column):
         # one preallocated contiguous buffer; rows copy in without an
-        # intermediate list-of-arrays (vectorized image-column stacking)
-        dtype = _source_dtype(col, col[0]["data"])
+        # intermediate list-of-arrays (vectorized image-column stacking).
+        # uint8 only when EVERY row is uint8 — a lone float row must not be
+        # silently truncated into a uint8 buffer
+        if all(getattr(np.asarray(r["data"]), "dtype", None) == np.uint8
+               for r in col):
+            dtype = np.uint8
+        else:
+            dtype = np.float32
         first = np.asarray(col[0]["data"], dtype=dtype)
         batch = np.empty((len(col),) + first.shape, dtype=dtype)
         batch[0] = first
@@ -117,7 +123,8 @@ class JaxModel(Transformer, HasInputCol, HasOutputCol):
     mesh_spec = Param(
         default=None, is_complex=True,
         doc="inference mesh layout (MeshSpec/dict); None = data parallelism "
-            "over every local device")
+            "over every local device; an explicit spec smaller than the "
+            "host's device count uses a prefix of the local devices")
 
     def __getstate__(self):
         # jitted closures and device arrays don't pickle; drop on serialize
@@ -157,13 +164,32 @@ class JaxModel(Transformer, HasInputCol, HasOutputCol):
     def _compiled_apply(self, bundle: ModelBundle, node: str):
         """(jitted fn, device params, batch sharding, data extent) — cached
         so repeated transform() calls reuse one compiled program AND one
-        host→device param transfer (the broadcast-once analog)."""
+        host→device param transfer (the broadcast-once analog).
+
+        One entry per (module identity, preprocess, node): the entry pins
+        the module + params objects it was built from, and a params
+        reassignment refreshes the device copy in place — no id-reuse false
+        hits, no unbounded growth of stale device trees."""
         import jax
 
         cache = self.__dict__.setdefault("_jit_cache", {})
-        key = (id(bundle.module), id(bundle.params), bundle.preprocess, node)
-        if key in cache:
-            return cache[key]
+        key = (id(bundle.module), bundle.preprocess, node)
+        entry = cache.get(key)
+        if entry is not None:
+            fn, dev_params, data, dp, pinned = entry
+            if pinned[0] is bundle.module and pinned[1] is bundle.params:
+                return fn, dev_params, data, dp
+            if pinned[0] is bundle.module:
+                # params swapped (e.g. after a training round): reuse the
+                # compiled program, re-upload the new tree onto the old
+                # copy's sharding; the old device copy is dropped here
+                # instead of pinned forever
+                leaves = jax.tree_util.tree_leaves(dev_params)
+                target = leaves[0].sharding if leaves else None
+                dev_params = jax.device_put(bundle.params, target)
+                cache[key] = (fn, dev_params, data, dp,
+                              (bundle.module, bundle.params))
+                return fn, dev_params, data, dp
 
         mesh = self._mesh()
         pre = PREPROCESSORS.get(bundle.preprocess) if bundle.preprocess else None
@@ -183,16 +209,18 @@ class JaxModel(Transformer, HasInputCol, HasOutputCol):
             dev = mesh.devices.reshape(-1)[0]
             dev_params = jax.device_put(bundle.params, dev)
             fn = jax.jit(fwd)
-            cache[key] = (fn, dev_params, dev, 1)
-            return cache[key]
+            cache[key] = (fn, dev_params, dev, 1,
+                          (bundle.module, bundle.params))
+            return cache[key][:4]
 
         repl = mesh_lib.replicated(mesh)
         data = mesh_lib.batch_sharding(mesh)
         dev_params = jax.device_put(bundle.params, repl)
         fn = jax.jit(fwd, in_shardings=(repl, data), out_shardings=data)
         dp = mesh.shape["dp"] * mesh.shape["fsdp"]
-        cache[key] = (fn, dev_params, data, dp)
-        return cache[key]
+        cache[key] = (fn, dev_params, data, dp,
+                      (bundle.module, bundle.params))
+        return cache[key][:4]
 
     def transform(self, table: DataTable) -> DataTable:
         import jax

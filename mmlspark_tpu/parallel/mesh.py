@@ -71,6 +71,16 @@ def make_mesh(spec: MeshSpec | Mapping[str, int] | None = None,
         spec = MeshSpec()
     if isinstance(spec, Mapping):
         spec = MeshSpec(**dict(spec))
+    explicit = dataclasses.asdict(spec)
+    if -1 not in explicit.values() and jax.process_count() == 1:
+        # a fully-explicit spec smaller than the host's device count means
+        # "use this many devices" — take a prefix instead of raising.
+        # Single-process only: in a multi-host run a prefix would be
+        # host-0's devices, leaving other processes nothing addressable —
+        # there the loud size-mismatch ValueError below is correct
+        total = math.prod(explicit.values())
+        if total < len(devices):
+            devices = devices[:total]
     sizes = spec.resolve(len(devices))
     shape = tuple(sizes[a] for a in AXES)
     arr = np.array(devices).reshape(shape)

@@ -62,21 +62,32 @@ def make_optimizer(cfg: TrainConfig):
 
 
 def make_loss(kind: str) -> Callable:
+    """Per-example loss [B]; callers take a plain or mask-weighted mean
+    (mask-weighting is how the padded tail batch trains without bias)."""
     import jax.numpy as jnp
     import optax
 
     if kind == "softmax_xent":
         def loss(logits, labels):
             return optax.softmax_cross_entropy_with_integer_labels(
-                logits, labels.astype(jnp.int32)).mean()
+                logits, labels.astype(jnp.int32))
     elif kind == "sigmoid_xent":
         def loss(logits, labels):
-            return optax.sigmoid_binary_cross_entropy(
-                logits.squeeze(-1), labels.astype(logits.dtype)).mean()
+            z = logits
+            if z.ndim > labels.ndim and z.shape[-1] == 1:
+                z = z.squeeze(-1)  # binary head [B,1] vs labels [B]
+            per = optax.sigmoid_binary_cross_entropy(
+                z, labels.astype(z.dtype))
+            # multi-label [B,K]: one loss per example
+            return per.reshape(per.shape[0], -1).mean(axis=1) \
+                if per.ndim > 1 else per
     elif kind == "mse":
         def loss(logits, labels):
             pred = logits.squeeze(-1) if logits.ndim > labels.ndim else logits
-            return jnp.mean((pred - labels.astype(pred.dtype)) ** 2)
+            per = (pred - labels.astype(pred.dtype)) ** 2
+            # multi-target regression: one loss per example
+            return per.reshape(per.shape[0], -1).mean(axis=1) \
+                if per.ndim > 1 else per
     else:
         raise ValueError(f"unknown loss {kind!r}")
     return loss
@@ -108,18 +119,32 @@ def make_train_step(module: Any, cfg: TrainConfig, mesh: Any):
         return {"params": params, "opt_state": opt_state,
                 "step": jax.device_put(jnp.zeros((), jnp.int32), repl)}
 
-    def _step(state, x, y):
-        def compute_loss(params):
-            logits = module.apply({"params": params}, x, train=True)
-            return loss_fn(logits, y)
-
-        loss, grads = jax.value_and_grad(compute_loss)(state["params"])
+    def _update(state, loss, grads):
         updates, opt_state = tx.update(
             grads, state["opt_state"], state["params"])
         params = optax.apply_updates(state["params"], updates)
         new_state = {"params": params, "opt_state": opt_state,
                      "step": state["step"] + 1}
         return new_state, {"loss": loss}
+
+    def _step(state, x, y):
+        def compute_loss(params):
+            logits = module.apply({"params": params}, x, train=True)
+            return loss_fn(logits, y).mean()
+
+        loss, grads = jax.value_and_grad(compute_loss)(state["params"])
+        return _update(state, loss, grads)
+
+    def _step_masked(state, x, y, w):
+        # weighted global mean: zero-weight (padded) rows contribute nothing
+        # to loss or gradients, so the tail batch trains exactly
+        def compute_loss(params):
+            logits = module.apply({"params": params}, x, train=True)
+            per = loss_fn(logits, y)
+            return (per * w).sum() / w.sum()
+
+        loss, grads = jax.value_and_grad(compute_loss)(state["params"])
+        return _update(state, loss, grads)
 
     # state shardings are inferred from the committed arrays built by
     # init_state (replicated or fsdp-sharded per param_shardings); batch
@@ -129,17 +154,32 @@ def make_train_step(module: Any, cfg: TrainConfig, mesh: Any):
     donate = (0,) if cfg.donate_state else ()
     step = jax.jit(_step, in_shardings=(None, data, data),
                    donate_argnums=donate)
-    return init_state, step
+    step_masked = jax.jit(_step_masked, in_shardings=(None, data, data, data),
+                          donate_argnums=donate)
+    return init_state, step, step_masked
 
 
-def _batches(x: np.ndarray, y: np.ndarray, batch_size: int, seed: int,
-             drop_remainder: bool = True) -> Iterator[tuple]:
+def _batches(x: np.ndarray, y: np.ndarray, batch_size: int,
+             seed: int) -> Iterator[tuple]:
+    """Shuffled fixed-shape batches ``(bx, by, bw)``. The tail batch is
+    zero-padded to ``batch_size`` with a 0/1 weight vector so no row is ever
+    dropped (round-1/2 fix: ``drop_remainder`` silently lost up to
+    ``batch_size-1`` rows per epoch) while XLA still sees one shape."""
     n = len(x)
     order = np.random.default_rng(seed).permutation(n)
-    end = n - (n % batch_size) if drop_remainder else n
-    for s in range(0, max(end, 0), batch_size):
+    ones = np.ones(batch_size, np.float32)
+    for s in range(0, n, batch_size):
         idx = order[s:s + batch_size]
-        yield x[idx], y[idx]
+        if len(idx) == batch_size:
+            yield x[idx], y[idx], ones
+        else:
+            pad = batch_size - len(idx)
+            bx = np.concatenate([x[idx], np.zeros((pad,) + x.shape[1:],
+                                                  x.dtype)])
+            by = np.concatenate([y[idx], np.zeros((pad,) + y.shape[1:],
+                                                  y.dtype)])
+            bw = np.concatenate([ones[:len(idx)], np.zeros(pad, np.float32)])
+            yield bx, by, bw
 
 
 class Trainer:
@@ -156,7 +196,7 @@ class Trainer:
         self.cfg = cfg or TrainConfig()
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh(
             self.cfg.mesh_spec)
-        self.init_state, self.step = make_train_step(
+        self.init_state, self.step, self.step_masked = make_train_step(
             module, self.cfg, self.mesh)
         self.state = None
         self.history: list[float] = []
@@ -217,11 +257,13 @@ class Trainer:
                 f"extent {dp}; provide >= {dp} rows or shrink the mesh")
         # fingerprint the EFFECTIVE batch size: resuming on a mesh with a
         # different dp extent changes the rounded bs (and hence the batch
-        # walk) even when cfg.batch_size is unchanged
+        # walk) even when cfg.batch_size is unchanged. sched=2 marks the
+        # padded-tail batch walk (one more step per epoch than sched-1 runs)
         self._fingerprint = {"n_rows": int(len(x)),
                              "batch_size": int(bs),
                              "seed": int(cfg.seed),
-                             "epochs": int(cfg.epochs)}
+                             "epochs": int(cfg.epochs),
+                             "sched": 2}
         resumed = 0
         if self.state is None:
             self.state = self.init_state(x.shape[1:])
@@ -234,14 +276,16 @@ class Trainer:
         global_step = 0
         with timed(f"Trainer[{type(self.module).__name__}]", _log, len(x)):
             for epoch in range(cfg.epochs):
-                for i, (bx, by) in enumerate(
+                for i, (bx, by, bw) in enumerate(
                         _batches(x, y, bs, cfg.seed + epoch)):
                     global_step += 1
                     if global_step <= resumed:
                         continue
                     bx = jax.device_put(bx, data)
                     by = jax.device_put(by, data)
-                    self.state, metrics = self.step(self.state, bx, by)
+                    bw = jax.device_put(bw, data)
+                    self.state, metrics = self.step_masked(
+                        self.state, bx, by, bw)
                     if i % cfg.log_every == 0:
                         self.history.append(float(metrics["loss"]))
                     if (ckpt is not None and cfg.checkpoint_every > 0

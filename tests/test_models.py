@@ -235,3 +235,51 @@ def test_graft_entry_single():
 def test_graft_entry_multichip():
     import __graft_entry__ as ge
     ge.dryrun_multichip(8)
+
+
+# ---- round-3 regression tests (ADVICE r2) ----
+
+def test_compiled_apply_no_stale_cache_on_params_reassign():
+    """Reassigning bundle.params must not serve stale device weights, even
+    if CPython reuses the freed dict's id (ADVICE r2: the cache entry now
+    pins the keyed params object alive, so id-reuse is impossible)."""
+    bundle = small_cifar_bundle()
+    jm = JaxModel(model=bundle, input_col="image", output_col="scores",
+                  minibatch_size=4)
+    t = image_table(4)
+    out1 = np.stack(jm.transform(t)["scores"])
+    cache = jm.__dict__["_jit_cache"]
+    assert all(entry[-1][1] is bundle.params for entry in cache.values())
+    # mutate the model the way tools/build_model_repo does: new params tree
+    import jax
+    for _ in range(3):
+        bundle.params = jax.tree_util.tree_map(
+            lambda p: p * 0.0, bundle.params)
+        out2 = np.stack(jm.transform(t)["scores"])
+    assert not np.allclose(out1, out2)  # zeroed weights → different scores
+    # repeated reassignment must not grow the cache (stale device trees
+    # would otherwise accumulate until OOM)
+    assert len(jm.__dict__["_jit_cache"]) == 1
+
+
+def test_coerce_heterogeneous_image_dtypes_fall_back_to_float32():
+    r = np.random.default_rng(0)
+    flt = make_image("b", r.integers(0, 255, (8, 8, 3)))
+    # e.g. a normalized image struct: float data in the same schema
+    flt["data"] = flt["data"].astype(np.float32) / 255.0 - 0.5
+    imgs = [make_image("a", r.integers(0, 255, (8, 8, 3))), flt]
+    t = DataTable({"image": imgs})
+    m = coerce_input_matrix(t, "image", (8, 8, 3))
+    assert m.dtype == np.float32
+    assert np.allclose(m[1], np.asarray(t["image"][1]["data"]))
+
+
+def test_make_mesh_explicit_spec_uses_device_prefix():
+    import jax
+    n = jax.device_count()
+    if n < 2:
+        pytest.skip("needs >1 device")
+    mesh = make_mesh(MeshSpec(dp=1, fsdp=1))
+    assert mesh.devices.size == 1
+    mesh2 = make_mesh(MeshSpec(dp=2))
+    assert mesh2.devices.size == 2
