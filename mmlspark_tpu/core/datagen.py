@@ -1,0 +1,121 @@
+"""Random table generation for the generic fuzz suite.
+
+Analog of the reference's ``core/test/datagen`` (reference:
+core/test/datagen/src/main/scala/GenerateDataset.scala:36-59,
+GenerateDataType.scala): seeded random DataTables over a randomized schema of
+mixed column types — numerics with missing values, strings with empties and
+None, categoricals, token lists, vectors, booleans, dates, and image structs
+— so every pipeline stage can be fuzzed against inputs it did not expect.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+from mmlspark_tpu.data.table import DataTable
+
+
+def _numeric(rng: np.random.Generator, n: int, missing: float) -> np.ndarray:
+    vals = rng.normal(scale=rng.uniform(0.5, 100.0), size=n)
+    if missing:
+        vals[rng.random(n) < missing] = np.nan
+    return vals
+
+
+def _integer(rng: np.random.Generator, n: int, missing: float) -> np.ndarray:
+    return rng.integers(-1000, 1000, size=n)
+
+
+def _boolean(rng: np.random.Generator, n: int, missing: float) -> np.ndarray:
+    return rng.random(n) > 0.5
+
+
+def _string(rng: np.random.Generator, n: int, missing: float) -> list:
+    words = ["alpha", "beta", "gamma", "", "δelta", "a b c", "x,y"]
+    out: list[Any] = [words[i] for i in rng.integers(0, len(words), size=n)]
+    if missing:
+        for i in np.nonzero(rng.random(n) < missing)[0]:
+            out[int(i)] = None
+    return out
+
+
+def _categorical(rng: np.random.Generator, n: int, missing: float) -> list:
+    k = int(rng.integers(1, 5))  # k=1: singleton category edge case
+    levels = [f"lvl{j}" for j in range(k)]
+    return [levels[i] for i in rng.integers(0, k, size=n)]
+
+
+def _tokens(rng: np.random.Generator, n: int, missing: float) -> list:
+    vocab = ["tok%d" % j for j in range(9)]
+    return [[vocab[i] for i in rng.integers(0, 9, size=rng.integers(0, 6))]
+            for _ in range(n)]
+
+
+def _vector(rng: np.random.Generator, n: int, missing: float) -> list:
+    d = int(rng.integers(2, 9))
+    return [rng.normal(size=d).astype(np.float32) for _ in range(n)]
+
+
+def _date_string(rng: np.random.Generator, n: int, missing: float) -> list:
+    return [f"20{rng.integers(10, 30):02d}-0{rng.integers(1, 10)}-"
+            f"{rng.integers(10, 28)} 0{rng.integers(0, 10)}:30:00"
+            for _ in range(n)]
+
+
+def _image(rng: np.random.Generator, n: int, missing: float) -> list:
+    from mmlspark_tpu.core.schema import make_image
+    h, w = int(rng.integers(4, 12)), int(rng.integers(4, 12))
+    return [make_image(f"img{i}", rng.integers(0, 255, (h, w, 3)))
+            for i in range(n)]
+
+
+GENERATORS: dict[str, Callable] = {
+    "numeric": _numeric,
+    "integer": _integer,
+    "boolean": _boolean,
+    "string": _string,
+    "categorical": _categorical,
+    "tokens": _tokens,
+    "vector": _vector,
+    "date": _date_string,
+    "image": _image,
+}
+
+
+def random_table(seed: int = 0, n_rows: int = 24,
+                 kinds: tuple[str, ...] | None = None,
+                 missing: float = 0.1) -> DataTable:
+    """A table with one column of every requested kind (default: a random
+    subset of at least 4 kinds), deterministic in ``seed``."""
+    rng = np.random.default_rng(seed)
+    if kinds is None:
+        pool = list(GENERATORS)
+        k = int(rng.integers(4, len(pool) + 1))
+        kinds = tuple(pool[i] for i in
+                      rng.choice(len(pool), size=k, replace=False))
+    cols: dict[str, Any] = {}
+    for kind in kinds:
+        cols[kind] = GENERATORS[kind](rng, n_rows, missing)
+    t = DataTable(cols)
+    if "image" in cols:
+        from mmlspark_tpu.core.schema import mark_image_column
+        t = mark_image_column(t, "image")
+    return t
+
+
+def labeled_table(seed: int = 0, n_rows: int = 48,
+                  classification: bool = True) -> DataTable:
+    """Mixed-type table with a learnable label column (for Train* fuzzing)."""
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n_rows)
+    x2 = rng.normal(size=n_rows)
+    cat = [["u", "v"][i] for i in rng.integers(0, 2, size=n_rows)]
+    signal = x1 + 0.5 * x2 + np.asarray([0.5 if c == "u" else -0.5
+                                         for c in cat])
+    if classification:
+        label = (signal > 0).astype(np.int64)
+    else:
+        label = signal + rng.normal(scale=0.1, size=n_rows)
+    return DataTable({"x1": x1, "x2": x2, "cat": cat, "label": label})

@@ -16,6 +16,12 @@ from mmlspark_tpu.data.table import DataTable
 
 
 class Pipeline(Estimator):
+    """Ordered composition of stages fit as one estimator.
+
+    Estimator stages are fit in sequence on the progressively transformed
+    table; the result is a :class:`PipelineModel` of fitted transformers
+    (SparkML ``Pipeline`` semantics as used throughout the reference)."""
+
     stages = Param(default=None, doc="ordered list of pipeline stages",
                    is_complex=True)
 
@@ -48,6 +54,8 @@ class Pipeline(Estimator):
 
 
 class PipelineModel(Transformer):
+    """A fitted :class:`Pipeline`: applies each transformer in order."""
+
     stages = Param(default=None, doc="ordered list of fitted transformers",
                    is_complex=True)
 

@@ -1,0 +1,49 @@
+"""Registry loading — import every framework module so all stages register.
+
+The reflection-loading analog of the reference's jar scan
+(reference: core/utils/src/main/scala/JarLoadingUtils.scala:17-80, which
+URL-classloads every built jar so ``Fuzzing.scala`` and codegen can discover
+all Transformer/Estimator classes). Here discovery is import-driven:
+``PipelineStage.__init_subclass__`` registers each class into
+``STAGE_REGISTRY`` at import time, so walking the package imports is the
+whole job.
+"""
+
+from __future__ import annotations
+
+import importlib
+import pkgutil
+
+from mmlspark_tpu.core.stage import STAGE_REGISTRY
+
+
+def load_all_modules() -> list[str]:
+    """Import every ``mmlspark_tpu`` submodule; returns the module names.
+
+    Idempotent (imports are cached). Modules that fail to import raise —
+    a stage module that can't import is a packaging bug, not something to
+    skip silently.
+    """
+    import mmlspark_tpu
+
+    names = []
+    for info in pkgutil.walk_packages(mmlspark_tpu.__path__,
+                                      prefix="mmlspark_tpu."):
+        spec = importlib.util.find_spec(info.name)
+        origin = getattr(spec, "origin", None) or ""
+        if not (info.ispkg or origin.endswith(".py")):
+            continue  # shared libraries (e.g. native/libimgops.so)
+        importlib.import_module(info.name)
+        names.append(info.name)
+    return names
+
+
+def all_stages(prefix: str = "mmlspark_tpu.") -> dict[str, type]:
+    """Class path → class for every registered stage, all modules loaded.
+
+    ``prefix`` restricts to framework stages (the default) — user/test
+    stages register too but are not part of the documented API surface.
+    Pass ``prefix=""`` for everything.
+    """
+    load_all_modules()
+    return {p: c for p, c in STAGE_REGISTRY.items() if p.startswith(prefix)}

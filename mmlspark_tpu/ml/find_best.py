@@ -30,6 +30,13 @@ _METRIC_INFO = {
 
 
 class FindBestModel(Estimator):
+    """Selects the best of several fitted models by an evaluation metric.
+
+    Scores every candidate on the given table with
+    :class:`ComputeModelStatistics` and keeps the winner plus the full
+    metrics table (reference: find-best-model/src/main/scala/
+    FindBestModel.scala:80-130)."""
+
     models = Param(default=None, doc="candidate fitted models",
                    is_complex=True)
     evaluation_metric = Param(default="accuracy", doc="selection metric",
@@ -70,6 +77,9 @@ class FindBestModel(Estimator):
 
 
 class BestModel(Transformer):
+    """The winning model from :class:`FindBestModel`, with its metric and
+    the per-candidate metrics table on ``all_model_metrics_``."""
+
     best_model = Param(default=None, doc="the winning fitted model",
                        is_complex=True)
     best_metric = Param(default=None, doc="winning metric value",

@@ -73,6 +73,12 @@ def drop_missing_labels(table: DataTable, label_col: str) -> DataTable:
 
 
 class TrainClassifier(Estimator, HasLabelCol):
+    """One-call classification: label indexing + automatic featurization +
+    learner fit, yielding a model that stamps score metadata.
+
+    Reference: train-classifier/src/main/scala/TrainClassifier.scala:97-184
+    (hash-size-by-learner-family heuristic at :186-201)."""
+
     model = Param(default=None, doc="the learner to fit (default "
                   "LogisticRegression)", is_complex=True)
     feature_columns = Param(default=None, doc="input columns to featurize "
@@ -107,6 +113,10 @@ class TrainClassifier(Estimator, HasLabelCol):
 
 
 class TrainedClassifierModel(Transformer, HasLabelCol):
+    """Fitted :class:`TrainClassifier`: featurizes, scores, and stamps
+    scores/scored-labels/probabilities column metadata for the evaluators
+    (reference: TrainClassifier.scala:280-381)."""
+
     features_col = Param(default="features", doc="assembled features column",
                          type_=str)
     featurize_model = Param(default=None, doc="fitted featurization pipeline",

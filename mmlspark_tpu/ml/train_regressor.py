@@ -23,6 +23,9 @@ from mmlspark_tpu.ml.train_classifier import (
 
 
 class TrainRegressor(Estimator, HasLabelCol):
+    """One-call regression: label cast + automatic featurization + learner
+    fit (reference: train-regressor/src/main/scala/TrainRegressor.scala:52-130)."""
+
     model = Param(default=None, doc="the learner to fit (default "
                   "LinearRegression)", is_complex=True)
     feature_columns = Param(default=None, doc="input columns to featurize "
@@ -56,6 +59,9 @@ class TrainRegressor(Estimator, HasLabelCol):
 
 
 class TrainedRegressorModel(Transformer, HasLabelCol):
+    """Fitted :class:`TrainRegressor`: featurizes, predicts, and stamps
+    regression score metadata (reference: TrainRegressor.scala)."""
+
     features_col = Param(default="features", doc="assembled features column",
                          type_=str)
     featurize_model = Param(default=None, doc="fitted featurization pipeline",

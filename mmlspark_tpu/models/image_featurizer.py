@@ -24,6 +24,11 @@ from mmlspark_tpu.stages.image import ImageTransformer
 
 
 class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
+    """Transfer learning from zoo models: resize to the model's input size,
+    unroll, and run a truncated forward pass (``cut_output_layers`` picks the
+    intermediate node per the bundle's ``layer_names``). Reference:
+    image-featurizer/src/main/scala/ImageFeaturizer.scala:116-140."""
+
     input_col = Param(default="image", doc="input image column", type_=str)
     output_col = Param(default="features", doc="output feature column",
                        type_=str)

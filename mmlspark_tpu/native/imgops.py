@@ -16,16 +16,31 @@ _log = get_logger(__name__)
 
 _HERE = os.path.dirname(__file__)
 _SRC = os.path.join(_HERE, "src", "imgops.cpp")
-_LIB = os.path.join(_HERE, "libimgops.so")
 
 _lock = threading.Lock()
 _lib: Any = None
 _tried = False
 
 
-def _build() -> bool:
+def _lib_path() -> str:
+    """Build target: next to the source when writable (dev checkout), else
+    a user cache dir (installed wheels ship only the .cpp — the NativeLoader
+    analog extracts/builds into a writable location, reference:
+    core/env/src/main/scala/NativeLoader.java:47-68). Resolved lazily at
+    first use (not import) so ``config.set('cache_dir', ...)`` is honored
+    and an unwritable filesystem degrades to the NumPy fallback instead of
+    breaking the import."""
+    if os.access(_HERE, os.W_OK):
+        return os.path.join(_HERE, "libimgops.so")
+    from mmlspark_tpu.core import config
+    d = os.path.join(config.get("cache_dir"), "native")
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, "libimgops.so")
+
+
+def _build(lib_path: str) -> bool:
     cmd = ["g++", "-O3", "-fPIC", "-shared", _SRC,
-           "-ljpeg", "-lpng", "-o", _LIB]
+           "-ljpeg", "-lpng", "-o", lib_path]
     try:
         res = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
     except (OSError, subprocess.TimeoutExpired) as e:
@@ -44,13 +59,19 @@ def _load() -> Any:
         if _tried:
             return _lib
         _tried = True
+        try:
+            lib_path = _lib_path()
+        except OSError as e:
+            _log.warning("imgops: no writable build dir (%s); "
+                         "using NumPy/OpenCV fallbacks", e)
+            return None
         src_mtime = os.path.getmtime(_SRC) if os.path.exists(_SRC) else 0
-        lib_fresh = (os.path.exists(_LIB)
-                     and os.path.getmtime(_LIB) >= src_mtime)
-        if not lib_fresh and not _build():
+        lib_fresh = (os.path.exists(lib_path)
+                     and os.path.getmtime(lib_path) >= src_mtime)
+        if not lib_fresh and not _build(lib_path):
             return None
         try:
-            lib = ctypes.CDLL(_LIB)
+            lib = ctypes.CDLL(lib_path)
         except OSError as e:
             _log.warning("imgops dlopen failed: %s", e)
             return None

@@ -80,6 +80,10 @@ def make_mesh(spec: MeshSpec | Mapping[str, int] | None = None,
         # there the loud size-mismatch ValueError below is correct
         total = math.prod(explicit.values())
         if total < len(devices):
+            import logging
+            logging.getLogger(__name__).info(
+                "make_mesh: explicit spec uses %d of %d local devices",
+                total, len(devices))
             devices = devices[:total]
     sizes = spec.resolve(len(devices))
     shape = tuple(sizes[a] for a in AXES)

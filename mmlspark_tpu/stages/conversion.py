@@ -40,6 +40,10 @@ def _to_date(v: Any, fmt: str) -> Any:
 
 
 class DataConversion(Transformer):
+    """Converts columns between numeric/string/boolean/date types, with
+    categorical conversion via ValueIndexer semantics (reference:
+    data-conversion/src/main/scala/DataConversion.scala:17-60)."""
+
     cols = Param(default=None, doc="columns to convert",
                  type_=(list, tuple))
     convert_to = Param(default="double", doc="target type",

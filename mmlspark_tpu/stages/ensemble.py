@@ -19,6 +19,9 @@ from mmlspark_tpu.data.table import DataTable, is_missing, to_py_scalar
 
 
 class EnsembleByKey(Transformer):
+    """Group-by-key score ensembling (mean strategy) over vector or scalar
+    columns (reference: ensemble/src/main/scala/EnsembleByKey.scala:20-80)."""
+
     keys = Param(default=None, doc="key columns to group by",
                  type_=(list, tuple))
     cols = Param(default=None, doc="score columns to ensemble",

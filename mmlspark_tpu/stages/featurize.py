@@ -54,6 +54,7 @@ _KIND_CATEGORICAL = "categorical"
 _KIND_STRING = "string"
 _KIND_DATE = "date"
 _KIND_VECTOR = "vector"
+_KIND_TOKENS = "tokens"      # pre-tokenized text: list-of-str rows
 _KIND_IMAGE = "image"
 _KIND_BOOL = "bool"
 
@@ -78,6 +79,12 @@ def _classify_column(table: DataTable, col: str) -> str:
     if isinstance(first, datetime):
         return _KIND_DATE
     if isinstance(first, (np.ndarray, list, tuple)):
+        # a sequence of strings is pre-tokenized text, not a numeric vector
+        # (the fuzz suite feeds both; misclassifying crashes at transform)
+        probe = next((v for v in arr
+                      if not is_missing(v) and len(v) > 0), None)
+        if probe is not None and isinstance(probe[0], str):
+            return _KIND_TOKENS
         return _KIND_VECTOR
     if isinstance(first, dict):
         return _KIND_IMAGE
@@ -94,6 +101,11 @@ def _date_features(v: Any) -> np.ndarray:
     ts = v.timestamp() * 1000.0
     return np.array([ts, v.year, v.isoweekday(), v.month, v.day,
                      v.hour, v.minute, v.second], dtype=np.float64)
+
+
+def _token_lists(values: Any) -> list[list[str]]:
+    """Token-list column → clean list-of-str rows (missing → empty)."""
+    return [[] if is_missing(v) else [str(t) for t in v] for v in values]
 
 
 def _hash_rows(token_lists: list[list[str]], num_features: int) -> list[dict[int, float]]:
@@ -128,17 +140,19 @@ class AssembleFeatures(Estimator, HasFeaturesCol):
         # categoricals first (FastVectorAssembler contract)
         classified = [(c, _classify_column(table, c)) for c in cols]
         classified.sort(key=lambda ck: 0 if ck[1] == _KIND_CATEGORICAL else 1)
-        string_cols = [c for c, k in classified if k == _KIND_STRING]
+        text_cols = [(c, k) for c, k in classified
+                     if k in (_KIND_STRING, _KIND_TOKENS)]
 
-        # count-based slot selection across all string columns together
+        # count-based slot selection across all string/token columns together
         # (the reference hashes all tokenized string cols into one space and
         # reduces a BitSet of non-zero slots)
         selected_slots: list[int] = []
-        if string_cols:
+        if text_cols:
             tokenizer = Tokenizer(input_col="x", output_col="y")
             nonzero: set[int] = set()
-            for c in string_cols:
-                toks = tokenizer._transform_column(table[c], None)
+            for c, k in text_cols:
+                toks = (tokenizer._transform_column(table[c], None)
+                        if k == _KIND_STRING else _token_lists(table[c]))
                 for d in _hash_rows(toks, self.number_of_features):
                     nonzero.update(d)
             selected_slots = sorted(nonzero)
@@ -164,6 +178,10 @@ class AssembleFeatures(Estimator, HasFeaturesCol):
 
 
 class AssembleFeaturesModel(Transformer, HasFeaturesCol):
+    """Fitted :class:`AssembleFeatures`: applies the per-column featurization
+    plan and assembles one features vector (reference:
+    featurize/src/main/scala/AssembleFeatures.scala:338-459)."""
+
     plan = Param(default=None, doc="per-column featurization plan",
                  is_complex=True)
     number_of_features = Param(default=NUM_FEATURES_DEFAULT,
@@ -175,7 +193,7 @@ class AssembleFeaturesModel(Transformer, HasFeaturesCol):
         n = len(table)
         blocks: list[np.ndarray] = []
         clean_mask = np.ones(n, dtype=bool)  # rows to keep (na.drop analog)
-        string_cols: list[str] = []
+        text_cols: list[tuple[str, str]] = []
 
         for entry in self.plan:
             c, kind = entry["col"], entry["kind"]
@@ -240,18 +258,19 @@ class AssembleFeaturesModel(Transformer, HasFeaturesCol):
                     if row is not None:
                         mat[i] = row
                 blocks.append(mat)
-            elif kind == _KIND_STRING:
-                string_cols.append(c)
+            elif kind in (_KIND_STRING, _KIND_TOKENS):
+                text_cols.append((c, kind))
             else:
                 raise TypeError(f"unknown plan kind {kind!r}")
 
-        if string_cols:
+        if text_cols:
             slots = list(self.selected_slots or [])
             slot_pos = {s: i for i, s in enumerate(slots)}
             tf = np.zeros((n, len(slots)), dtype=np.float64)
             tokenizer = Tokenizer(input_col="x", output_col="y")
-            for c in string_cols:
-                toks = tokenizer._transform_column(table[c], None)
+            for c, kind in text_cols:
+                toks = (tokenizer._transform_column(table[c], None)
+                        if kind == _KIND_STRING else _token_lists(table[c]))
                 for i, d in enumerate(_hash_rows(toks,
                                                  self.number_of_features)):
                     for s, cnt in d.items():

@@ -77,6 +77,10 @@ class ValueIndexer(Estimator, HasInputCol, HasOutputCol):
 
 
 class ValueIndexerModel(Transformer, HasInputCol, HasOutputCol):
+    """Fitted :class:`ValueIndexer`: maps values to level codes and stamps
+    categorical-levels column metadata (reference:
+    value-indexer/src/main/scala/ValueIndexer.scala)."""
+
     levels = Param(default=None, doc="sorted categorical levels",
                    type_=(list, tuple))
 

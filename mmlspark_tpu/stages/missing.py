@@ -47,6 +47,9 @@ def _numeric_view(col: np.ndarray) -> np.ndarray:
 
 
 class CleanMissingData(Estimator, HasInputCols, HasOutputCols):
+    """Imputation estimator: Mean/Median/Custom replacement per column
+    (reference: clean-missing-data/src/main/scala/CleanMissingData.scala:14-80)."""
+
     cleaning_mode = Param(default=MEAN, doc="imputation mode",
                           type_=str, validator=Param.one_of(*MODES))
     custom_value = Param(default=None, doc="replacement value for Custom mode")
@@ -82,6 +85,9 @@ class CleanMissingData(Estimator, HasInputCols, HasOutputCols):
 
 
 class CleanMissingDataModel(Transformer, HasInputCols, HasOutputCols):
+    """Fitted :class:`CleanMissingData`: fills missing values with the
+    per-column replacements computed at fit time."""
+
     replacement_values = Param(default=None,
                                doc="per-input-column replacement value",
                                type_=dict)

@@ -24,6 +24,10 @@ RS_PERCENT = "Percentage"
 
 
 class PartitionSample(Transformer):
+    """Sampling/partition assignment: Head, RandomSample (absolute or
+    percentage), or AssignToPartition (reference:
+    partition-sample/src/main/scala/PartitionSample.scala:13-120)."""
+
     mode = Param(default=MODE_RS, doc="sampling mode", type_=str,
                  validator=Param.one_of(MODE_HEAD, MODE_RS, MODE_ATP))
     rs_mode = Param(default=RS_PERCENT, doc="random-sample submode",

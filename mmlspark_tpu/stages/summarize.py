@@ -42,6 +42,9 @@ def _numeric_or_none(col: np.ndarray) -> np.ndarray | None:
 
 
 class SummarizeData(Transformer):
+    """Dataset profiling: counts, basic stats, sample stats, and percentiles
+    per column (reference: summarize-data/src/main/scala/SummarizeData.scala:17-130)."""
+
     counts = Param(default=True, doc="compute count statistics", type_=bool)
     basic = Param(default=True, doc="compute basic statistics", type_=bool)
     sample = Param(default=True, doc="compute sample statistics", type_=bool)

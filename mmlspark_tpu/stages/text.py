@@ -76,6 +76,10 @@ class Tokenizer(UnaryTransformer):
 
 
 class StopWordsRemover(UnaryTransformer):
+    """Filters stop words from token lists (built-in English list by
+    default); part of the TextFeaturizer chain (reference:
+    text-featurizer/src/main/scala/TextFeaturizer.scala)."""
+
     stop_words = Param(default=None, doc="words to filter out (None = "
                        "built-in English list)", type_=(list, tuple))
     case_sensitive = Param(default=False, doc="case-sensitive comparison",
@@ -92,6 +96,8 @@ class StopWordsRemover(UnaryTransformer):
 
 
 class NGram(UnaryTransformer):
+    """Token lists → space-joined n-grams (TextFeaturizer chain)."""
+
     n = Param(default=2, doc="n-gram length", type_=int,
               validator=Param.gt(0))
 
@@ -147,6 +153,9 @@ class IDF(Estimator, HasInputCol, HasOutputCol):
 
 
 class IDFModel(Transformer, HasInputCol, HasOutputCol):
+    """Fitted :class:`IDF`: rescales term-frequency vectors by the learned
+    inverse-document-frequency weights."""
+
     idf = Param(default=None, doc="per-slot idf weights", is_complex=True)
 
     def transform(self, table: DataTable) -> DataTable:

@@ -32,6 +32,9 @@ _log = get_logger("stages.utility")
 
 
 class SelectColumns(Transformer):
+    """Keeps only the listed columns (reference:
+    pipeline-stages/src/main/scala/SelectColumns.scala:21-45)."""
+
     cols = Param(default=None, doc="columns to keep", type_=(list, tuple))
 
     def transform(self, table: DataTable) -> DataTable:
@@ -39,6 +42,8 @@ class SelectColumns(Transformer):
 
 
 class DropColumns(Transformer):
+    """Drops the listed columns (reference: pipeline-stages DropColumns)."""
+
     cols = Param(default=None, doc="columns to drop", type_=(list, tuple))
 
     def transform(self, table: DataTable) -> DataTable:
@@ -46,6 +51,8 @@ class DropColumns(Transformer):
 
 
 class RenameColumns(Transformer):
+    """Renames columns via an old-name → new-name map."""
+
     mapping = Param(default=None, doc="old-name → new-name map", type_=dict)
 
     def transform(self, table: DataTable) -> DataTable:
@@ -117,6 +124,9 @@ class ClassBalancer(Estimator, HasInputCol, HasOutputCol):
 
 
 class ClassBalancerModel(Transformer, HasInputCol, HasOutputCol):
+    """Fitted :class:`ClassBalancer`: adds the inverse-frequency weight
+    column computed at fit time."""
+
     # complex: JSON would stringify non-string class keys (int/float labels)
     weights = Param(default=None, doc="class value → weight", type_=dict,
                     is_complex=True)
@@ -162,6 +172,9 @@ class Timer(Estimator):
 
 
 class TimerModel(Transformer):
+    """Fitted :class:`Timer`: times the wrapped transformer's transform
+    calls (reference: pipeline-stages/src/main/scala/Timer.scala:54-123)."""
+
     stage = Param(default=None, doc="the wrapped transformer",
                   is_complex=True)
     log_to_console = Param(default=True, doc="print timing lines", type_=bool)

@@ -1,0 +1,3 @@
+"""Command-line tools shipped with the package (zoo publishing, doc
+generation entry points). The packaging analog of the reference's
+``tools/`` scripts that ship with the built artifacts."""

@@ -1,0 +1,173 @@
+"""Build a local pretrained-model repository (the zoo-publishing tool).
+
+The reference serves pretrained CNTK models from an Azure CDN manifest
+(reference: ModelDownloader.scala:184-186). This environment has no egress,
+so the equivalent is a reproducible local repository: each zoo architecture
+is initialized deterministically, briefly trained on a deterministic
+synthetic task (so the weights are *trained*, not random — downstream
+accuracy tests can assert learning happened), and published with
+``publish_model`` (manifest + sha256).
+
+Usage:
+    mmlspark-tpu-build-repo <repo_dir> [--scale small|full]
+    (or: python -m mmlspark_tpu.tools.build_model_repo <repo_dir>)
+
+``small`` (default) publishes CI-scale models in seconds; ``full`` also
+publishes ResNet50 / ViT_B16 at real size (minutes; weights are
+few-step-trained, standing in for real pretraining which needs data egress).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def _train_briefly(bundle, x, y, steps: int = 60, lr: float = 1e-3):
+    """A few deterministic Adam steps; returns the bundle with trained
+    params."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from mmlspark_tpu.models.bundle import PREPROCESSORS
+
+    tx = optax.adam(lr)
+    opt = tx.init(bundle.params)
+    params = bundle.params
+    # train through the same preprocessing the scoring path applies
+    pre = PREPROCESSORS.get(bundle.preprocess) if bundle.preprocess else None
+
+    def loss_fn(p, xb, yb):
+        if pre is not None:
+            xb = pre(xb)
+        logits = bundle.module.apply({"params": p}, xb, output="logits")
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, yb).mean()
+
+    @jax.jit
+    def step(p, o, xb, yb):
+        l, g = jax.value_and_grad(loss_fn)(p, xb, yb)
+        up, o = tx.update(g, o)
+        return optax.apply_updates(p, up), o, l
+
+    bs = min(64, len(x))
+    first = last = None
+    for i in range(steps):
+        s = (i * bs) % max(1, len(x) - bs + 1)
+        params, opt, l = step(params, opt, x[s:s + bs], y[s:s + bs])
+        if first is None:
+            first = float(l)
+        last = float(l)
+    print(f"  {bundle.name}: loss {first:.3f} -> {last:.3f} "
+          f"({steps} steps)")
+    bundle.params = params
+    return bundle
+
+
+def _class_blobs(n, shape, n_classes, seed=0):
+    """Deterministic learnable image task: class-dependent mean shift."""
+    r = np.random.default_rng(seed)
+    y = r.integers(0, n_classes, n)
+    x = r.normal(size=(n,) + shape).astype(np.float32) * 20 + 128
+    shift = (y[:, None].astype(np.float32) - n_classes / 2) * 8
+    x = np.clip(x + shift[..., None, None], 0, 255)
+    return x.astype(np.float32), y
+
+
+def build(repo_dir: str, scale: str = "small") -> list:
+    from mmlspark_tpu.data.downloader import ModelSchema, publish_model
+    from mmlspark_tpu.models.zoo import get_model
+
+    published = []
+
+    def publish(bundle, dataset, model_type, layer_count):
+        entry = publish_model(bundle, repo_dir, ModelSchema(
+            name=bundle.name, dataset=dataset, model_type=model_type,
+            input_node="input", num_layers=layer_count))
+        published.append(entry)
+        print(f"  published {entry.name} ({entry.size} bytes, "
+              f"sha256 {entry.hash[:12]}…)")
+
+    n_cls = 10
+    print("ConvNet_CIFAR10 (notebook-301 flagship)")
+    x, y = _class_blobs(256, (32, 32, 3), n_cls, seed=1)
+    # small scale keeps CI fast; full scale publishes the MXU-sized widths
+    conv_kw = {} if scale == "full" else {
+        "widths": (16, 32), "dense_width": 64}
+    b = get_model("ConvNet_CIFAR10", **conv_kw)
+    publish(_train_briefly(b, x, y), "CIFAR10-synthetic", "CNN", 8)
+
+    print("ResNet_Small (CI-scale ResNet family)")
+    b = get_model("ResNet_Small", num_classes=n_cls)
+    publish(_train_briefly(b, x, y), "CIFAR10-synthetic", "ResNet", 18)
+
+    print("ViT_Tiny (CI-scale ViT family)")
+    b = get_model("ViT_Tiny", num_classes=n_cls)
+    publish(_train_briefly(b, x, y), "CIFAR10-synthetic", "ViT", 2)
+
+    print("BiLSTM_MedTag (notebook-304 tagger)")
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    vocab, tags, L = 512, 8, 64
+    r = np.random.default_rng(2)
+    toks = r.integers(1, vocab, size=(256, L)).astype(np.int32)
+    # learnable rule: tag = token bucket
+    tag = (toks % tags).astype(np.int32)
+    b = get_model("BiLSTM_MedTag", vocab_size=vocab, num_tags=tags,
+                  max_len=L, embed_dim=32, hidden=32)
+    tx = optax.adam(3e-3)
+    opt = tx.init(b.params)
+    params = b.params
+
+    def tag_loss(p, xb, yb):
+        lg = b.module.apply({"params": p}, xb)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            lg, yb).mean()
+
+    @jax.jit
+    def tstep(p, o, xb, yb):
+        l, g = jax.value_and_grad(tag_loss)(p, xb, yb)
+        up, o = tx.update(g, o)
+        return optax.apply_updates(p, up), o, l
+
+    first = last = None
+    for i in range(80):
+        s = (i * 64) % 192
+        params, opt, l = tstep(params, opt, toks[s:s + 64], tag[s:s + 64])
+        first = first if first is not None else float(l)
+        last = float(l)
+    print(f"  BiLSTM_MedTag: loss {first:.3f} -> {last:.3f}")
+    b.params = params
+    publish(b, "MedEntity-synthetic", "BiLSTM", 2)
+
+    if scale == "full":
+        print("ResNet50 (full size, few-step-trained)")
+        x224, y224 = _class_blobs(32, (64, 64, 3), n_cls, seed=3)
+        b = get_model("ResNet50", num_classes=n_cls, input_size=64)
+        publish(_train_briefly(b, x224, y224, steps=10), "synthetic",
+                "ResNet", 50)
+        print("ViT_B16 (full size, few-step-trained)")
+        x224, y224 = _class_blobs(16, (224, 224, 3), n_cls, seed=4)
+        b = get_model("ViT_B16", num_classes=n_cls)
+        publish(_train_briefly(b, x224, y224, steps=5), "synthetic",
+                "ViT", 12)
+
+    return published
+
+
+def main(argv: list[str] | None = None) -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("repo_dir")
+    ap.add_argument("--scale", choices=("small", "full"), default="small")
+    args = ap.parse_args(argv if argv is not None else sys.argv[1:])
+    entries = build(args.repo_dir, args.scale)
+    print(f"published {len(entries)} models to {args.repo_dir}")
+
+
+if __name__ == "__main__":
+    main()

@@ -94,11 +94,13 @@ def make_loss(kind: str) -> Callable:
 
 
 def make_train_step(module: Any, cfg: TrainConfig, mesh: Any):
-    """Build (init_state, step) for a flax module on a mesh.
+    """Build (init_state, step, step_masked) for a flax module on a mesh.
 
     ``step(state, x, y) -> (state, metrics)`` is one jit-compiled program:
     forward (bf16 on MXU), backward, global-mean gradients (XLA psum over
-    ``dp``/``fsdp`` ICI rings), optimizer update.
+    ``dp``/``fsdp`` ICI rings), optimizer update. ``step_masked`` takes an
+    extra per-example weight vector ``w`` (0/1) and computes the weighted
+    mean — how the zero-padded tail batch trains without bias.
     """
     import jax
     import jax.numpy as jnp

@@ -3,9 +3,6 @@ publish → download → featurize pretrained-model flow (reference:
 ModelDownloader.scala:184-252 + ImageFeaturizer.scala:116-140), and
 JaxModel.set_model_location (CNTKModel.scala:151-154 analog)."""
 
-import os
-import sys
-
 import numpy as np
 import pytest
 
@@ -17,7 +14,6 @@ from mmlspark_tpu.models.image_featurizer import ImageFeaturizer
 from mmlspark_tpu.models.jax_model import JaxModel
 from mmlspark_tpu.models.zoo import ZOO, get_model
 
-sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
 
 
 def image_struct_table(n, hw=32, seed=0):
@@ -97,7 +93,7 @@ class TestArchitectures:
 @pytest.fixture(scope="module")
 def model_repo(tmp_path_factory):
     """Build the local pretrained repo once (the no-egress CDN analog)."""
-    import build_model_repo
+    from mmlspark_tpu.tools import build_model_repo
     repo = str(tmp_path_factory.mktemp("model_repo"))
     entries = build_model_repo.build(repo, scale="small")
     return repo, {e.name: e for e in entries}
@@ -113,7 +109,7 @@ class TestPretrainedFlow:
     def test_downloaded_model_is_actually_trained(self, model_repo):
         # scoring the training distribution must beat chance by a wide
         # margin — proves published weights are trained, not random init
-        import build_model_repo
+        from mmlspark_tpu.tools import build_model_repo
         repo, _ = model_repo
         path = ModelDownloader(repo).download_by_name("ConvNet_CIFAR10")
         jm = JaxModel(input_col="image", output_col="scores",

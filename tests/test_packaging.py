@@ -1,0 +1,47 @@
+"""Packaging checks (reference: tools/pip/setup.py:1-35 — the pip wheel).
+
+The package must be installable (`pip install -e .`), expose console entry
+points, and ship the native kernel source as package data so installed
+wheels can source-build it (NativeLoader analog)."""
+
+import os
+
+import pytest
+
+import mmlspark_tpu
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_version_consistent_with_pyproject():
+    with open(os.path.join(REPO, "pyproject.toml")) as f:
+        py = f.read()
+    assert 'dynamic = ["version"]' in py
+    assert mmlspark_tpu.__version__.count(".") == 2
+
+
+def test_native_source_is_package_data():
+    # the wheel ships src/imgops.cpp; the loader builds it on first use
+    src = os.path.join(os.path.dirname(mmlspark_tpu.__file__),
+                       "native", "src", "imgops.cpp")
+    assert os.path.exists(src)
+    with open(os.path.join(REPO, "pyproject.toml")) as f:
+        assert 'src/*.cpp' in f.read()
+
+
+def test_console_entry_points_resolve():
+    from importlib import metadata
+    try:
+        dist = metadata.distribution("mmlspark-tpu")
+    except metadata.PackageNotFoundError:
+        pytest.skip("package not pip-installed in this environment")
+    eps = {e.name: e for e in dist.entry_points
+           if e.group == "console_scripts"}
+    assert {"mmlspark-tpu-build-repo", "mmlspark-tpu-docgen"} <= set(eps)
+    for e in eps.values():
+        assert callable(e.load())
+
+
+def test_installed_package_serves_the_stage_registry():
+    from mmlspark_tpu.core.registry import all_stages
+    assert len(all_stages()) >= 50

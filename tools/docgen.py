@@ -1,0 +1,15 @@
+"""Shim: doc generation lives in the installable package
+(``mmlspark_tpu.tools.docgen``; console script ``mmlspark-tpu-docgen``).
+Running this regenerates docs/api/ and tests/test_generated_smoke.py in the
+repo root."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mmlspark_tpu.tools.docgen import generate, main  # noqa: F401,E402
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else
+         os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
