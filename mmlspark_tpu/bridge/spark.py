@@ -1,0 +1,67 @@
+"""One-call Spark integration for the Arrow offload bridge.
+
+The reference scores inside executor JVMs via JNI (reference:
+cntk-model/src/main/scala/CNTKModel.scala:248-256 ``mapPartitions``); the
+TPU-native topology keeps executors JVM-only and offloads Arrow batches to
+the TPU host through ``DataFrame.mapInArrow``. This module packages that as
+one call::
+
+    from mmlspark_tpu.bridge.spark import spark_transform
+    scored = spark_transform(df, fitted_model)     # a Spark DataFrame
+
+pyspark is an optional dependency (``pip install mmlspark-tpu[spark]``);
+everything here degrades to a clear ImportError when it is absent, and the
+wire-level contract (iterator of RecordBatches in/out, schema stability,
+order preservation, mid-stream error propagation) is tested engine-free in
+``tests/test_spark_bridge.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from mmlspark_tpu.bridge.offload import make_map_in_arrow_fn
+from mmlspark_tpu.data.table import DataTable
+
+
+def _require_pyspark():
+    try:
+        import pyspark  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "spark_transform needs pyspark (pip install "
+            "'mmlspark-tpu[spark]')") from e
+
+
+def output_spark_schema(df: Any, transformer: Any, sample_rows: int = 4):
+    """Infer the scored DataFrame's Spark schema from a driver-side probe.
+
+    ``mapInArrow`` requires the output schema up front; scoring a small
+    sample through the transformer yields the exact Arrow schema, converted
+    to the Spark type system.
+    """
+    _require_pyspark()
+    from pyspark.sql.pandas.types import from_arrow_schema
+
+    pdf = df.limit(sample_rows).toPandas()
+    if len(pdf) == 0:
+        raise ValueError(
+            "cannot infer output schema from an empty DataFrame; pass an "
+            "explicit schema to df.mapInArrow(make_map_in_arrow_fn(...))")
+    probe = transformer.transform(DataTable.from_pandas(pdf))
+    return from_arrow_schema(probe.to_arrow().schema)
+
+
+def spark_transform(df: Any, transformer: Any, prefetch: int = 4,
+                    sample_rows: int = 4) -> Any:
+    """Score a Spark DataFrame through a fitted stage on the TPU host.
+
+    Executors stream Arrow record batches into one bridge per partition;
+    each bridge re-batches rows into fixed-shape padded device minibatches
+    and merges scores back in row order (the CNTKModel.transform analog as
+    one line of Spark API).
+    """
+    _require_pyspark()
+    schema = output_spark_schema(df, transformer, sample_rows=sample_rows)
+    return df.mapInArrow(make_map_in_arrow_fn(transformer, prefetch=prefetch),
+                         schema)

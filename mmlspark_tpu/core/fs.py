@@ -1,0 +1,291 @@
+"""Filesystem abstraction: local paths, in-memory URIs, object stores.
+
+The core/hadoop analog (reference: core/hadoop/src/main/scala/
+HadoopUtils.scala + the HDFS-backed model repository
+downloader/src/main/scala/ModelDownloader.scala:39-104 ``HDFSRepo``). The
+reference reaches distributed storage through the Hadoop FileSystem API;
+here a scheme registry routes paths:
+
+* plain paths / ``file://`` → the local filesystem,
+* ``memory://`` → a process-local in-memory store (the test/HDFS stand-in,
+  and the unit-test double for object stores),
+* ``gs://`` / ``s3://`` / ``hdfs://`` / ``abfs://`` → fsspec, when
+  installed (TPU deployments read shards and write checkpoints to GCS).
+
+Consumers (model downloader/publisher, bundle save/load, readers) call the
+module-level helpers; new schemes only need a ``FileSystem`` registration.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import posixpath
+import threading
+from glob import glob as _glob
+from typing import Any, Iterator
+
+_FSSPEC_SCHEMES = ("gs", "s3", "hdfs", "abfs", "az", "gcs")
+
+
+def split_scheme(path: str) -> tuple[str, str]:
+    """('memory', 'a/b') for 'memory://a/b'; ('', path) for local paths.
+
+    Windows drive letters and bare paths have no scheme.
+    """
+    if "://" in path:
+        scheme, rest = path.split("://", 1)
+        if len(scheme) > 1:  # not a drive letter
+            return scheme.lower(), rest
+    return "", path
+
+
+class FileSystem:
+    """Minimal FS contract needed by the framework's IO paths."""
+
+    def open(self, path: str, mode: str = "rb") -> Any:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def makedirs(self, path: str) -> None:
+        raise NotImplementedError
+
+    def remove(self, path: str) -> None:
+        raise NotImplementedError
+
+    def list(self, path: str, recursive: bool = False) -> list[str]:
+        """Files under a directory/prefix (full paths, sorted)."""
+        raise NotImplementedError
+
+    def size(self, path: str) -> int:
+        raise NotImplementedError
+
+
+class LocalFS(FileSystem):
+    def open(self, path: str, mode: str = "rb") -> Any:
+        if "w" in mode or "a" in mode:
+            d = os.path.dirname(path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+        return open(path, mode)
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def makedirs(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def remove(self, path: str) -> None:
+        os.remove(path)
+
+    def list(self, path: str, recursive: bool = False) -> list[str]:
+        if os.path.isdir(path):
+            pattern = os.path.join(path, "**" if recursive else "*")
+            files = _glob(pattern, recursive=recursive)
+        else:
+            files = _glob(path, recursive=recursive)
+        return sorted(f for f in files if os.path.isfile(f))
+
+    def size(self, path: str) -> int:
+        return os.path.getsize(path)
+
+
+class MemoryFS(FileSystem):
+    """Process-local in-memory store — deterministic object-store double."""
+
+    def __init__(self) -> None:
+        self._files: dict[str, bytes] = {}
+        self._lock = threading.Lock()
+
+    def _norm(self, path: str) -> str:
+        return posixpath.normpath(path).lstrip("/")
+
+    def open(self, path: str, mode: str = "rb") -> Any:
+        key = self._norm(path)
+        if "r" in mode and "w" not in mode:
+            with self._lock:
+                if key not in self._files:
+                    raise FileNotFoundError(f"memory://{key}")
+                data = self._files[key]
+            return io.BytesIO(data) if "b" in mode else io.StringIO(
+                data.decode())
+        fs = self
+
+        class _Writer(io.BytesIO):
+            def close(self) -> None:
+                with fs._lock:
+                    fs._files[key] = self.getvalue()
+                super().close()
+
+        class _TextWriter(io.StringIO):
+            def close(self) -> None:
+                with fs._lock:
+                    fs._files[key] = self.getvalue().encode()
+                super().close()
+
+        return _Writer() if "b" in mode else _TextWriter()
+
+    def exists(self, path: str) -> bool:
+        key = self._norm(path)
+        with self._lock:
+            return (key in self._files
+                    or any(k.startswith(key + "/") for k in self._files))
+
+    def makedirs(self, path: str) -> None:
+        pass  # directories are implicit
+
+    def remove(self, path: str) -> None:
+        key = self._norm(path)
+        with self._lock:
+            if key not in self._files:
+                raise FileNotFoundError(f"memory://{key}")
+            del self._files[key]
+
+    def list(self, path: str, recursive: bool = False) -> list[str]:
+        prefix = self._norm(path)
+        out = []
+        with self._lock:
+            for k in self._files:
+                if prefix in ("", "."):
+                    rel = k
+                elif k.startswith(prefix + "/"):
+                    rel = k[len(prefix) + 1:]
+                elif k == prefix:
+                    rel = ""
+                else:
+                    continue
+                if not recursive and "/" in rel:
+                    continue
+                out.append("memory://" + k)
+        return sorted(out)
+
+    def size(self, path: str) -> int:
+        key = self._norm(path)
+        with self._lock:
+            return len(self._files[key])
+
+    def clear(self) -> None:
+        with self._lock:
+            self._files.clear()
+
+
+class FsspecFS(FileSystem):
+    """Object stores through fsspec (gs://, s3://, hdfs://, …)."""
+
+    def __init__(self, scheme: str):
+        try:
+            import fsspec
+        except ImportError as e:
+            raise ImportError(
+                f"paths with scheme {scheme}:// need fsspec (and the "
+                f"matching backend, e.g. gcsfs for gs://)") from e
+        self._fs = fsspec.filesystem(scheme)
+        self._scheme = scheme
+
+    def _full(self, path: str) -> str:
+        return f"{self._scheme}://{path}"
+
+    def open(self, path: str, mode: str = "rb") -> Any:
+        return self._fs.open(self._full(path), mode)
+
+    def exists(self, path: str) -> bool:
+        return self._fs.exists(self._full(path))
+
+    def makedirs(self, path: str) -> None:
+        self._fs.makedirs(self._full(path), exist_ok=True)
+
+    def remove(self, path: str) -> None:
+        self._fs.rm(self._full(path))
+
+    def list(self, path: str, recursive: bool = False) -> list[str]:
+        if recursive:
+            names = self._fs.find(self._full(path))  # find is files-only
+        else:
+            names = [e["name"] for e in
+                     self._fs.ls(self._full(path), detail=True)
+                     if e.get("type") == "file"]
+        return sorted(f"{self._scheme}://{n.split('://', 1)[-1]}"
+                      for n in names)
+
+    def size(self, path: str) -> int:
+        return self._fs.size(self._full(path))
+
+
+_memory_fs = MemoryFS()
+_local_fs = LocalFS()
+_fsspec_cache: dict[str, FsspecFS] = {}
+
+
+def get_fs(path: str) -> tuple[FileSystem, str]:
+    """Resolve a path/URI to (filesystem, fs-local path)."""
+    scheme, rest = split_scheme(path)
+    if scheme in ("", "file"):
+        return _local_fs, rest
+    if scheme == "memory":
+        return _memory_fs, rest
+    if scheme in _FSSPEC_SCHEMES:
+        if scheme not in _fsspec_cache:
+            _fsspec_cache[scheme] = FsspecFS(scheme)
+        return _fsspec_cache[scheme], rest
+    raise ValueError(f"unknown filesystem scheme {scheme!r} in {path!r}")
+
+
+# ---- module-level helpers (what consumers actually call) ----
+
+def open_file(path: str, mode: str = "rb") -> Any:
+    fs, p = get_fs(path)
+    return fs.open(p, mode)
+
+
+def exists(path: str) -> bool:
+    fs, p = get_fs(path)
+    return fs.exists(p)
+
+
+def makedirs(path: str) -> None:
+    fs, p = get_fs(path)
+    fs.makedirs(p)
+
+
+def remove(path: str) -> None:
+    fs, p = get_fs(path)
+    fs.remove(p)
+
+
+def list_files(path: str, recursive: bool = False) -> list[str]:
+    fs, p = get_fs(path)
+    return fs.list(p, recursive=recursive)
+
+
+def size(path: str) -> int:
+    fs, p = get_fs(path)
+    return fs.size(p)
+
+
+def read_bytes(path: str) -> bytes:
+    with open_file(path, "rb") as f:
+        return f.read()
+
+
+def write_bytes(path: str, data: bytes) -> None:
+    with open_file(path, "wb") as f:
+        f.write(data)
+
+
+def join(base: str, *parts: str) -> str:
+    """Scheme-aware path join (posix separators for URIs)."""
+    scheme, rest = split_scheme(base)
+    if not scheme:
+        return os.path.join(base, *parts)
+    return f"{scheme}://" + posixpath.join(rest, *parts)
+
+
+def iter_chunks(path: str, chunk: int = 1 << 20) -> Iterator[bytes]:
+    with open_file(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                return
+            yield b

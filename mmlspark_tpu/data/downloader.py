@@ -19,6 +19,7 @@ import shutil
 from typing import Any, Iterable
 
 from mmlspark_tpu.core import config
+from mmlspark_tpu.core import fs as _fs
 from mmlspark_tpu.core.logging_utils import get_logger
 
 _log = get_logger(__name__)
@@ -54,42 +55,49 @@ class ModelSchema:
 
 def _sha256_file(path: str) -> str:
     h = hashlib.sha256()
-    with open(path, "rb") as f:
-        for chunk in iter(lambda: f.read(1 << 20), b""):
-            h.update(chunk)
+    for chunk in _fs.iter_chunks(path):
+        h.update(chunk)
     return h.hexdigest()
 
 
 class Repository:
-    """A model repository rooted at a local dir or URL."""
+    """A model repository rooted at a local dir, object-store URI, or URL.
+
+    ``memory://`` / ``gs://`` / ``hdfs://`` roots route through the
+    filesystem abstraction — the HDFSRepo analog (reference:
+    downloader/src/main/scala/ModelDownloader.scala:39-104); HTTP(S) stays
+    a plain manifest-over-CDN endpoint (DefaultModelRepo, :109-155).
+    """
 
     def __init__(self, root: str):
         self.root = root
 
-    def _is_remote(self) -> bool:
+    def _is_http(self) -> bool:
         return self.root.startswith(("http://", "https://"))
 
     def read_manifest(self) -> list[ModelSchema]:
-        if self._is_remote():
+        if self._is_http():
             import urllib.request
             with urllib.request.urlopen(
                     f"{self.root}/{MANIFEST_NAME}") as r:
                 entries = json.load(r)
         else:
-            with open(os.path.join(self.root, MANIFEST_NAME)) as f:
+            with _fs.open_file(_fs.join(self.root, MANIFEST_NAME), "r") as f:
                 entries = json.load(f)
         return [ModelSchema.from_json(e) for e in entries]
 
     def fetch(self, schema: ModelSchema, dest: str) -> str:
         """Copy/download the model artifact to ``dest``; returns the path."""
         os.makedirs(os.path.dirname(dest), exist_ok=True)
-        if self._is_remote():
+        if self._is_http():
             import urllib.request
             with urllib.request.urlopen(f"{self.root}/{schema.uri}") as r, \
                     open(dest, "wb") as f:
                 shutil.copyfileobj(r, f)
         else:
-            shutil.copyfile(os.path.join(self.root, schema.uri), dest)
+            with _fs.open_file(_fs.join(self.root, schema.uri)) as src, \
+                    open(dest, "wb") as f:
+                shutil.copyfileobj(src, f)
         return dest
 
 
@@ -183,8 +191,7 @@ def save_bundle_file(bundle: Any, path: str) -> None:
         "preprocess": bundle.preprocess,
         "name": bundle.name,
     }
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    with open(path, "wb") as f:
+    with _fs.open_file(path, "wb") as f:
         pickle.dump(payload, f)
 
 
@@ -195,7 +202,7 @@ def load_bundle_file(path: str) -> Any:
 
     from mmlspark_tpu.models.bundle import ModelBundle
 
-    with open(path, "rb") as f:
+    with _fs.open_file(path, "rb") as f:
         payload = pickle.load(f)
     params = serialization.from_bytes(
         payload["params_skeleton"], payload["params_bytes"])
@@ -211,22 +218,23 @@ def load_bundle_file(path: str) -> Any:
 
 def publish_model(bundle: Any, repo_root: str,
                   schema: ModelSchema | None = None) -> ModelSchema:
-    """Write a bundle + manifest entry into a local repository dir."""
-    os.makedirs(repo_root, exist_ok=True)
+    """Write a bundle + manifest entry into a repository (local dir,
+    ``memory://``, or any registered object-store scheme)."""
+    _fs.makedirs(repo_root)
     uri = f"{bundle.name}.model"
-    path = os.path.join(repo_root, uri)
+    path = _fs.join(repo_root, uri)
     save_bundle_file(bundle, path)
     entry = schema or ModelSchema(name=bundle.name)
     entry.uri = uri
     entry.hash = _sha256_file(path)
-    entry.size = os.path.getsize(path)
+    entry.size = _fs.size(path)
     entry.layer_names = tuple(bundle.output_names)
-    manifest_path = os.path.join(repo_root, MANIFEST_NAME)
+    manifest_path = _fs.join(repo_root, MANIFEST_NAME)
     entries = []
-    if os.path.exists(manifest_path):
-        with open(manifest_path) as f:
+    if _fs.exists(manifest_path):
+        with _fs.open_file(manifest_path, "r") as f:
             entries = [e for e in json.load(f) if e["name"] != entry.name]
     entries.append(entry.to_json())
-    with open(manifest_path, "w") as f:
+    with _fs.open_file(manifest_path, "w") as f:
         json.dump(entries, f, indent=1)
     return entry

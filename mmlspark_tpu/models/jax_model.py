@@ -257,3 +257,14 @@ class JaxModel(Transformer, HasInputCol, HasOutputCol):
         else:
             out_col = list(result)
         return table.with_column(self.output_col, out_col)
+
+    def transform_stream(self, tables: Any) -> Iterator[DataTable]:
+        """Score a stream of DataTable chunks with bounded memory.
+
+        The compiled program and device-resident params are shared across
+        chunks (the jit cache), so streaming costs no recompiles or
+        re-uploads — pair with ``data.readers.stream_images`` for
+        ImageNet-shard-scale scoring without materializing the dataset.
+        """
+        for chunk in tables:
+            yield self.transform(chunk)

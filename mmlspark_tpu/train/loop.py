@@ -184,6 +184,37 @@ def _batches(x: np.ndarray, y: np.ndarray, batch_size: int,
             yield bx, by, bw
 
 
+def _rebatch(chunks: Any, batch_size: int) -> Iterator[tuple]:
+    """Re-accumulate arbitrary-size (x, y) chunks into fixed-size batches
+    ``(bx, by, bw)``; the final partial batch is zero-padded with a 0/1
+    weight vector. Memory is bounded by one batch + one chunk."""
+    buf_x: list[np.ndarray] = []
+    buf_y: list[np.ndarray] = []
+    have = 0
+    for cx, cy in chunks:
+        if len(cx) != len(cy):
+            raise ValueError(f"chunk length mismatch: {len(cx)} vs {len(cy)}")
+        buf_x.append(np.asarray(cx))
+        buf_y.append(np.asarray(cy))
+        have += len(cx)
+        while have >= batch_size:
+            x = np.concatenate(buf_x) if len(buf_x) > 1 else buf_x[0]
+            y = np.concatenate(buf_y) if len(buf_y) > 1 else buf_y[0]
+            yield (x[:batch_size], y[:batch_size],
+                   np.ones(batch_size, np.float32))
+            buf_x, buf_y = [x[batch_size:]], [y[batch_size:]]
+            have -= batch_size
+    if have:
+        x = np.concatenate(buf_x) if len(buf_x) > 1 else buf_x[0]
+        y = np.concatenate(buf_y) if len(buf_y) > 1 else buf_y[0]
+        pad = batch_size - have
+        bx = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)])
+        by = np.concatenate([y, np.zeros((pad,) + y.shape[1:], y.dtype)])
+        bw = np.concatenate([np.ones(have, np.float32),
+                             np.zeros(pad, np.float32)])
+        yield bx, by, bw
+
+
 class Trainer:
     """Minimal array-in training driver used by the learners and bench.
 
@@ -247,21 +278,40 @@ class Trainer:
         return ckpt.save(self.state, fingerprint=self._fingerprint)
 
     def fit_arrays(self, x: np.ndarray, y: np.ndarray) -> "Trainer":
+        """Train on host arrays.
+
+        Multi-host: each process passes only its own equal-length shard of
+        the dataset (the per-host sharded input pipeline, SURVEY §5 — no
+        shuffle engine; file-shard → host → HBM). Global batches are
+        assembled from every process's local slice via
+        ``jax.make_array_from_process_local_data``; ``cfg.batch_size`` is
+        the GLOBAL batch size.
+        """
         import jax
 
         cfg = self.cfg
-        # batch must divide over the data axes; round down to a multiple
+        nproc = jax.process_count()
+        # the batch must divide over the data axes AND split evenly across
+        # processes (each contributes bs/nproc rows), so round down to a
+        # multiple of lcm(dp, nproc)
         dp = self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
-        bs = (min(cfg.batch_size, len(x)) // dp) * dp
+        import math as _math
+        q = _math.lcm(dp, nproc)
+        n_global = len(x) * nproc
+        bs = (min(cfg.batch_size, n_global) // q) * q
         if bs == 0:
             raise ValueError(
-                f"dataset of {len(x)} rows is smaller than the data-parallel "
-                f"extent {dp}; provide >= {dp} rows or shrink the mesh")
+                f"dataset of {n_global} rows (or batch_size "
+                f"{cfg.batch_size}) is smaller than "
+                f"lcm(data-parallel extent {dp}, processes {nproc}) = {q}")
+        # each process walks its local shard with the same seed; the global
+        # batch is the process-order concatenation of the local slices
+        bs_local = bs // nproc
         # fingerprint the EFFECTIVE batch size: resuming on a mesh with a
         # different dp extent changes the rounded bs (and hence the batch
         # walk) even when cfg.batch_size is unchanged. sched=2 marks the
         # padded-tail batch walk (one more step per epoch than sched-1 runs)
-        self._fingerprint = {"n_rows": int(len(x)),
+        self._fingerprint = {"n_rows": int(n_global),
                              "batch_size": int(bs),
                              "seed": int(cfg.seed),
                              "epochs": int(cfg.epochs),
@@ -277,22 +327,144 @@ class Trainer:
         # replayed as no-ops so batch order stays deterministic
         global_step = 0
         with timed(f"Trainer[{type(self.module).__name__}]", _log, len(x)):
+            if nproc > 1:
+                def commit(arr):
+                    # local slice → its block of the globally-sharded array
+                    return jax.make_array_from_process_local_data(data, arr)
+            else:
+                def commit(arr):
+                    return jax.device_put(arr, data)
             for epoch in range(cfg.epochs):
                 for i, (bx, by, bw) in enumerate(
-                        _batches(x, y, bs, cfg.seed + epoch)):
+                        _batches(x, y, bs_local, cfg.seed + epoch)):
                     global_step += 1
                     if global_step <= resumed:
                         continue
-                    bx = jax.device_put(bx, data)
-                    by = jax.device_put(by, data)
-                    bw = jax.device_put(bw, data)
                     self.state, metrics = self.step_masked(
-                        self.state, bx, by, bw)
+                        self.state, commit(bx), commit(by), commit(bw))
                     if i % cfg.log_every == 0:
                         self.history.append(float(metrics["loss"]))
                     if (ckpt is not None and cfg.checkpoint_every > 0
                             and global_step % cfg.checkpoint_every == 0):
                         self.save_checkpoint()
+        if ckpt is not None and global_step > resumed:
+            self.save_checkpoint()
+        return self
+
+    def fit_stream(self, source: Any, input_spec: tuple | None = None
+                   ) -> "Trainer":
+        """Train from a stream of ``(x_chunk, y_chunk)`` host arrays without
+        ever materializing the dataset (bounded-memory ingest; reference
+        streaming reader: readers/src/main/scala/ImageReader.scala:85-98).
+
+        ``source`` is an iterable of chunks, or a zero-arg callable
+        returning a fresh iterator (required when ``cfg.epochs > 1``).
+        Chunks may be any size: rows are re-accumulated into fixed
+        ``cfg.batch_size`` global batches (one XLA program), with the final
+        partial batch padded + masked. Multi-host: each process streams its
+        own shard, exactly as in :meth:`fit_arrays`.
+        """
+        import jax
+
+        cfg = self.cfg
+        nproc = jax.process_count()
+        dp = self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
+        import math as _math
+        q = _math.lcm(dp, nproc)
+        bs = (cfg.batch_size // q) * q
+        if bs == 0:
+            raise ValueError(
+                f"batch_size {cfg.batch_size} smaller than lcm("
+                f"data-parallel extent {dp}, processes {nproc}) = {q}")
+        bs_local = bs // nproc
+
+        def epoch_iter():
+            it = source() if callable(source) else source
+            return _rebatch(it, bs_local)
+
+        if cfg.epochs > 1 and not callable(source):
+            raise ValueError(
+                "epochs > 1 needs a callable source (a fresh iterator per "
+                "epoch); a plain iterator is exhausted after one pass")
+
+        data = mesh_lib.batch_sharding(self.mesh)
+        if nproc > 1:
+            def commit(arr):
+                return jax.make_array_from_process_local_data(data, arr)
+        else:
+            def commit(arr):
+                return jax.device_put(arr, data)
+
+        # streams have no stable row count; fingerprint only the schedule
+        # shape that must match for a resume to replay correctly
+        self._fingerprint = {"stream": True, "batch_size": int(bs),
+                             "seed": int(cfg.seed),
+                             "epochs": int(cfg.epochs), "sched": 2}
+        resumed = 0
+        ckpt = self._checkpointer()
+        global_step = 0
+        rows = 0
+        shapes: tuple | None = None  # (x tail shape/dtype, y tail/dtype)
+
+        def dummy_batch():
+            # zero-weight filler keeping cross-process collectives aligned
+            # when this process's shard ran dry before its peers'
+            if shapes is not None:
+                (xs, xd), (ys, yd) = shapes
+            elif input_spec is not None:
+                (xs, xd), (ys, yd) = ((tuple(input_spec), np.float32),
+                                      ((), np.int64))
+            else:
+                raise ValueError(
+                    "this process's stream yielded no data and no "
+                    "input_spec was given; cannot synthesize filler "
+                    "batches for the multi-host schedule")
+            return (np.zeros((bs_local,) + xs, xd),
+                    np.zeros((bs_local,) + ys, yd),
+                    np.zeros(bs_local, np.float32))
+
+        with timed(f"Trainer[{type(self.module).__name__}:stream]", _log):
+            for epoch in range(cfg.epochs):
+                it = iter(epoch_iter())
+                while True:
+                    batch = next(it, None)
+                    if nproc > 1:
+                        # streams rarely shard into equal batch counts per
+                        # process; sync liveness so an exhausted process
+                        # feeds zero-weight filler instead of leaving its
+                        # peers deadlocked inside the step's collectives
+                        from jax.experimental import multihost_utils
+                        alive = int(multihost_utils.process_allgather(
+                            np.asarray(batch is not None, np.int32)).sum())
+                        if alive == 0:
+                            break
+                        if batch is None:
+                            batch = dummy_batch()
+                    elif batch is None:
+                        break
+                    bx, by, bw = batch
+                    shapes = ((bx.shape[1:], bx.dtype),
+                              (by.shape[1:], by.dtype))
+                    if self.state is None:
+                        spec = tuple(input_spec or bx.shape[1:])
+                        self.state = self.init_state(spec)
+                        resumed = self.maybe_restore() or 0
+                    global_step += 1
+                    if global_step <= resumed:
+                        continue
+                    rows += int(bw.sum())
+                    self.state, metrics = self.step_masked(
+                        self.state, commit(bx), commit(by), commit(bw))
+                    if (global_step - 1) % cfg.log_every == 0:
+                        self.history.append(float(metrics["loss"]))
+                    if (ckpt is not None and cfg.checkpoint_every > 0
+                            and global_step % cfg.checkpoint_every == 0):
+                        self.save_checkpoint()
+        if global_step == 0:
+            raise ValueError(
+                "fit_stream: the stream yielded no data (empty source or "
+                "mistyped path?)")
+        _log.info("fit_stream: %d rows in %d steps", rows, global_step)
         if ckpt is not None and global_step > resumed:
             self.save_checkpoint()
         return self

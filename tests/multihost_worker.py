@@ -1,0 +1,81 @@
+"""Worker script for the 2-process multi-host training test.
+
+Each process joins the jax.distributed world (2 virtual CPU devices per
+process → a 4-device global mesh), feeds ONLY its own shard of the dataset
+through ``Trainer.fit_arrays``, and prints the loss trajectory + a params
+checksum as one JSON line. Run by tests/test_multihost.py; out-does the
+reference's never-wired multi-node MPI stub
+(cntk-train/src/main/scala/CommandBuilders.scala:95-117).
+"""
+
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> None:
+    port, pid = sys.argv[1], int(sys.argv[2])
+    from mmlspark_tpu.utils.env import distributed_init
+    distributed_init(coordinator_address=f"localhost:{port}",
+                     num_processes=2, process_id=pid)
+    assert jax.process_count() == 2 and jax.device_count() == 4
+
+    from mmlspark_tpu.models.zoo import MLP
+    from mmlspark_tpu.parallel.mesh import MeshSpec, make_mesh
+    from mmlspark_tpu.train import TrainConfig, Trainer
+
+    # deterministic dataset; THIS process holds only rows [pid*60, pid*60+60)
+    r = np.random.default_rng(0)
+    x = r.normal(size=(120, 8)).astype(np.float32)
+    y = ((x[:, 0] > 0) ^ (x[:, 1] > 0)).astype(np.int64)
+    x_local, y_local = x[pid * 60:(pid + 1) * 60], y[pid * 60:(pid + 1) * 60]
+
+    mesh = make_mesh(MeshSpec(dp=-1))  # global 4-device mesh
+    cfg = TrainConfig(batch_size=40, epochs=4, learning_rate=5e-3,
+                      log_every=1, donate_state=False)
+    tr = Trainer(MLP(features=(16,), num_outputs=2), cfg, mesh=mesh)
+    tr.fit_arrays(x_local, y_local)
+
+    # params are fully replicated after training; checksum must agree
+    # across processes (the all-reduce proof)
+    leaves = jax.tree_util.tree_leaves(tr.params)
+    checksum = float(sum(float(np.asarray(l).sum()) for l in leaves))
+
+    # ---- streamed training with UNEQUAL per-process batch counts ----
+    # process 0 streams 3 chunks, process 1 streams 5; the liveness sync
+    # must feed zero-weight filler on the short side instead of deadlocking
+    def source():
+        n_chunks = 3 if pid == 0 else 5
+        for c in range(n_chunks):
+            r2 = np.random.default_rng(100 + 10 * pid + c)
+            xs = r2.normal(size=(8, 8)).astype(np.float32)
+            ys = ((xs[:, 0] > 0) ^ (xs[:, 1] > 0)).astype(np.int64)
+            yield xs, ys
+
+    cfg2 = TrainConfig(batch_size=8, epochs=2, learning_rate=5e-3,
+                       log_every=1, donate_state=False)
+    tr2 = Trainer(MLP(features=(16,), num_outputs=2), cfg2, mesh=mesh)
+    tr2.fit_stream(source, input_spec=(8,))
+    leaves2 = jax.tree_util.tree_leaves(tr2.params)
+    checksum2 = float(sum(float(np.asarray(l).sum()) for l in leaves2))
+
+    print(json.dumps({"pid": pid, "losses": tr.history,
+                      "steps": int(tr.state["step"]),
+                      "checksum": checksum,
+                      "stream_steps": int(tr2.state["step"]),
+                      "stream_checksum": checksum2}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,149 @@
+"""Filesystem abstraction: local + memory backends, scheme routing, and the
+object-store model-repository / reader flows (core/hadoop + HDFSRepo analog,
+reference: downloader/src/main/scala/ModelDownloader.scala:39-104)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import fs
+from mmlspark_tpu.data.downloader import (
+    ModelDownloader, load_bundle_file, publish_model,
+)
+from mmlspark_tpu.data.readers import read_binary_files, stream_binary_files
+from mmlspark_tpu.models.zoo import get_model
+
+
+@pytest.fixture(autouse=True)
+def _clean_memory_fs():
+    fs._memory_fs.clear()
+    yield
+    fs._memory_fs.clear()
+
+
+class TestSchemeRouting:
+    def test_split_scheme(self):
+        assert fs.split_scheme("memory://a/b") == ("memory", "a/b")
+        assert fs.split_scheme("/tmp/x") == ("", "/tmp/x")
+        assert fs.split_scheme("gs://bucket/k") == ("gs", "bucket/k")
+        # single letters are drive letters, not schemes
+        assert fs.split_scheme("C://oddball") == ("", "C://oddball")
+
+    def test_unknown_scheme_raises(self):
+        with pytest.raises(ValueError, match="unknown filesystem scheme"):
+            fs.get_fs("bogus://x")
+
+    def test_join_scheme_aware(self):
+        assert fs.join("memory://repo", "a", "b") == "memory://repo/a/b"
+        assert fs.join("/tmp/d", "f").endswith("tmp/d/f")
+
+    def test_fsspec_gated_with_clear_error(self):
+        try:
+            import fsspec  # noqa: F401
+            pytest.skip("fsspec installed; gating not observable")
+        except ImportError:
+            pass
+        with pytest.raises(ImportError, match="fsspec"):
+            fs.get_fs("gs://bucket/obj")
+
+
+class TestMemoryFS:
+    def test_write_read_roundtrip(self):
+        fs.write_bytes("memory://d/x.bin", b"abc123")
+        assert fs.read_bytes("memory://d/x.bin") == b"abc123"
+        assert fs.exists("memory://d/x.bin")
+        assert fs.size("memory://d/x.bin") == 6
+        fs.remove("memory://d/x.bin")
+        assert not fs.exists("memory://d/x.bin")
+
+    def test_text_mode(self):
+        with fs.open_file("memory://t.txt", "w") as f:
+            f.write("héllo")
+        with fs.open_file("memory://t.txt", "r") as f:
+            assert f.read() == "héllo"
+
+    def test_missing_file_raises(self):
+        with pytest.raises(FileNotFoundError):
+            fs.read_bytes("memory://nope")
+
+    def test_list_recursive_and_flat(self):
+        for p in ("memory://r/a.bin", "memory://r/b.bin",
+                  "memory://r/sub/c.bin"):
+            fs.write_bytes(p, b"x")
+        assert fs.list_files("memory://r") == [
+            "memory://r/a.bin", "memory://r/b.bin"]
+        assert fs.list_files("memory://r", recursive=True) == [
+            "memory://r/a.bin", "memory://r/b.bin", "memory://r/sub/c.bin"]
+
+    def test_local_fs_still_default(self, tmp_path):
+        p = str(tmp_path / "f.bin")
+        fs.write_bytes(p, b"local")
+        assert fs.read_bytes(p) == b"local"
+
+
+class TestObjectStoreRepository:
+    def test_publish_download_load_via_memory_repo(self, tmp_path):
+        """The HDFSRepo flow end-to-end against the object-store double:
+        publish to memory://, download into a local hash-verified cache,
+        load, score."""
+        bundle = get_model("MLP", input_dim=5, num_outputs=2)
+        entry = publish_model(bundle, "memory://zoo")
+        assert entry.hash and entry.size > 0
+
+        dl = ModelDownloader("memory://zoo", cache_dir=str(tmp_path / "c"))
+        assert [m.name for m in dl.list_models()] == ["MLP"]
+        path = dl.download_by_name("MLP")
+        loaded = load_bundle_file(path)
+        x = np.zeros((2, 5), np.float32)
+        np.testing.assert_allclose(np.asarray(bundle.apply(x)),
+                                   np.asarray(loaded.apply(x)), atol=1e-6)
+
+    def test_corrupted_object_store_artifact_detected(self, tmp_path):
+        bundle = get_model("MLP", input_dim=3)
+        entry = publish_model(bundle, "memory://zoo2")
+        blob = fs.read_bytes(fs.join("memory://zoo2", entry.uri))
+        fs.write_bytes(fs.join("memory://zoo2", entry.uri),
+                       blob[: len(blob) // 2])
+        dl = ModelDownloader("memory://zoo2", cache_dir=str(tmp_path / "c"))
+        with pytest.raises(IOError, match="sha256 mismatch"):
+            dl.download_by_name("MLP")
+
+    def test_bundle_save_load_direct_on_memory(self):
+        bundle = get_model("MLP", input_dim=4, num_outputs=2)
+        from mmlspark_tpu.data.downloader import save_bundle_file
+        save_bundle_file(bundle, "memory://direct/m.model")
+        loaded = load_bundle_file("memory://direct/m.model")
+        assert loaded.input_spec == (4,)
+
+
+class TestObjectStoreReaders:
+    def test_read_binary_files_from_memory(self):
+        fs.write_bytes("memory://data/a.bin", b"AA")
+        fs.write_bytes("memory://data/b.bin", b"BBB")
+        t = read_binary_files("memory://data")
+        assert list(t["path"]) == ["memory://data/a.bin",
+                                   "memory://data/b.bin"]
+        assert [len(b) for b in t["bytes"]] == [2, 3]
+
+    def test_stream_from_memory_with_zip(self):
+        import io
+        import zipfile
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w") as zf:
+            zf.writestr("inner1.bin", b"one")
+            zf.writestr("inner2.bin", b"two")
+        fs.write_bytes("memory://arch/pack.zip", buf.getvalue())
+        fs.write_bytes("memory://arch/plain.bin", b"plain")
+        chunks = list(stream_binary_files("memory://arch", chunk_rows=2))
+        rows = [(p, bytes(b)) for c in chunks
+                for p, b in zip(c["path"], c["bytes"])]
+        assert ("memory://arch/pack.zip/inner1.bin", b"one") in rows
+        assert ("memory://arch/plain.bin", b"plain") in rows
+        assert len(rows) == 3
+
+
+def test_memory_root_listing_respects_recursive_flag():
+    fs.write_bytes("memory://top.bin", b"t")
+    fs.write_bytes("memory://deep/nested.bin", b"n")
+    assert fs.list_files("memory://") == ["memory://top.bin"]
+    assert fs.list_files("memory://", recursive=True) == [
+        "memory://deep/nested.bin", "memory://top.bin"]

@@ -1,0 +1,111 @@
+"""Real multi-host training: 2 jax.distributed processes, one global mesh.
+
+The reference never wired its multi-node path (the MPI hostfile launcher is
+an unused stub, cntk-train/src/main/scala/CommandBuilders.scala:95-117).
+Here two OS processes each hold 2 virtual CPU devices and ONLY HALF the
+dataset; ``Trainer.fit_arrays`` assembles global batches from the local
+shards (``jax.make_array_from_process_local_data``) and XLA all-reduces
+gradients across the 4-device world. Asserts: both processes converge, the
+trained params agree bit-for-bit across processes, and the loss trajectory
+matches a single-process run fed the identically-composed global batches.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def multihost_result():
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [subprocess.Popen(
+        [sys.executable, WORKER, str(port), str(pid)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+        for pid in (0, 1)]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+    return sorted(outs, key=lambda o: o["pid"])
+
+
+def test_both_processes_trained_full_schedule(multihost_result):
+    r0, r1 = multihost_result
+    # 120 global rows, bs 40 → 3 steps/epoch × 4 epochs
+    assert r0["steps"] == r1["steps"] == 12
+    assert r0["losses"][-1] < r0["losses"][0]
+
+
+def test_params_agree_across_processes(multihost_result):
+    r0, r1 = multihost_result
+    assert r0["checksum"] == pytest.approx(r1["checksum"], rel=0, abs=0.0), \
+        "post-training params diverged across hosts"
+
+
+def test_loss_parity_with_single_process(multihost_result):
+    """A single process fed the identically-composed global batches must
+    reproduce the 2-process loss trajectory (proves the multi-host input
+    path feeds exactly the intended data, not a resharded approximation)."""
+    import jax
+
+    from mmlspark_tpu.models.zoo import MLP
+    from mmlspark_tpu.parallel.mesh import MeshSpec, batch_sharding, make_mesh
+    from mmlspark_tpu.train import TrainConfig, Trainer
+    from mmlspark_tpu.train.loop import _batches
+
+    r = np.random.default_rng(0)
+    x = r.normal(size=(120, 8)).astype(np.float32)
+    y = ((x[:, 0] > 0) ^ (x[:, 1] > 0)).astype(np.int64)
+    shards = [(x[:60], y[:60]), (x[60:], y[60:])]
+
+    mesh = make_mesh(MeshSpec(dp=4), None)
+    cfg = TrainConfig(batch_size=40, epochs=4, learning_rate=5e-3,
+                      log_every=1, donate_state=False)
+    tr = Trainer(MLP(features=(16,), num_outputs=2), cfg, mesh=mesh)
+    tr.state = tr.init_state((8,))
+    data = batch_sharding(mesh)
+
+    losses = []
+    for epoch in range(cfg.epochs):
+        walks = [_batches(sx, sy, 20, cfg.seed + epoch) for sx, sy in shards]
+        for locals_ in zip(*walks):
+            # global batch = process-order concatenation of local slices
+            bx = np.concatenate([b[0] for b in locals_])
+            by = np.concatenate([b[1] for b in locals_])
+            bw = np.concatenate([b[2] for b in locals_])
+            tr.state, m = tr.step_masked(
+                tr.state, jax.device_put(bx, data),
+                jax.device_put(by, data), jax.device_put(bw, data))
+            losses.append(float(m["loss"]))
+
+    np.testing.assert_allclose(losses, multihost_result[0]["losses"],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_unequal_stream_shards_do_not_deadlock(multihost_result):
+    """fit_stream liveness sync: process 0 streams 3 chunks, process 1
+    streams 5 — the run must complete (filler batches on the short side)
+    with identical params on both processes."""
+    r0, r1 = multihost_result
+    # 2 epochs × max-process batch count: p1 has 5 chunks × 8 rows / 4-row
+    # local batches = 10 local batches per epoch → 20 global steps
+    assert r0["stream_steps"] == r1["stream_steps"] == 20
+    assert r0["stream_checksum"] == pytest.approx(r1["stream_checksum"],
+                                                  rel=0, abs=0.0)

@@ -1,0 +1,139 @@
+"""Proof of the Spark offload bridge at the engine boundary.
+
+Two tiers:
+
+1. **Executor-contract tests** (always run): drive ``make_map_in_arrow_fn``
+   exactly the way Spark's Python worker does — one call per partition with
+   an iterator of Arrow RecordBatches, consuming an iterator of
+   RecordBatches that must keep a stable schema, preserve row order, and
+   propagate mid-stream failures (reference executor-side scoring loop:
+   cntk-model/src/main/scala/CNTKModel.scala:248-256).
+2. **Real PySpark test** (skipped when pyspark is not installed): a local
+   SparkSession runs ``df.mapInArrow`` end-to-end via
+   ``bridge.spark.spark_transform`` and must match ``JaxModel.transform``.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from mmlspark_tpu.bridge.offload import make_map_in_arrow_fn, stream_table
+from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.models.jax_model import JaxModel
+from mmlspark_tpu.models.zoo import get_model
+
+
+def make_model(minibatch=16):
+    bundle = get_model("MLP", input_dim=6, num_outputs=3)
+    return JaxModel(model=bundle, input_col="vec", output_col="scores",
+                    minibatch_size=minibatch)
+
+
+def vec_table(n=50, seed=0):
+    r = np.random.default_rng(seed)
+    return DataTable({
+        "id": np.arange(n, dtype=np.int64),
+        "vec": [r.normal(size=6).astype(np.float32) for _ in range(n)],
+    })
+
+
+class TestExecutorContract:
+    """The exact mapInArrow worker protocol, engine-free."""
+
+    def test_partition_roundtrip_matches_direct_transform(self):
+        jm = make_model()
+        t = vec_table(50)
+        fn = make_map_in_arrow_fn(jm)
+        # Spark calls fn once per partition with a RecordBatch iterator
+        out_batches = list(fn(stream_table(t, rows_per_batch=7)))
+        assert all(isinstance(b, pa.RecordBatch) for b in out_batches)
+        merged = DataTable.from_arrow(pa.Table.from_batches(out_batches))
+        direct = jm.transform(t)
+        # row order and ids preserved
+        np.testing.assert_array_equal(merged["id"], direct["id"])
+        np.testing.assert_allclose(
+            np.stack(list(merged["scores"])),
+            np.stack(list(direct["scores"])), rtol=1e-5, atol=1e-6)
+
+    def test_output_schema_is_stable_across_batches(self):
+        # Spark hard-fails if two output batches disagree on schema
+        jm = make_model(minibatch=8)
+        fn = make_map_in_arrow_fn(jm)
+        out = list(fn(stream_table(vec_table(40), rows_per_batch=9)))
+        schemas = {b.schema for b in out}
+        assert len(schemas) == 1, [str(s) for s in schemas]
+
+    def test_one_call_per_partition_isolation(self):
+        # separate partitions → separate fn calls; outputs must not bleed
+        jm = make_model()
+        fn = make_map_in_arrow_fn(jm)
+        t = vec_table(30, seed=1)
+        parts = [t.take(np.arange(0, 10)), t.take(np.arange(10, 30))]
+        outs = []
+        for p in parts:
+            outs.append(DataTable.from_arrow(pa.Table.from_batches(
+                list(fn(stream_table(p, 4))))))
+        assert [len(o) for o in outs] == [10, 20]
+        np.testing.assert_array_equal(
+            np.concatenate([o["id"] for o in outs]), t["id"])
+
+    def test_empty_partition_yields_no_batches(self):
+        jm = make_model()
+        fn = make_map_in_arrow_fn(jm)
+        assert list(fn(iter([]))) == []
+
+    def test_midstream_failure_propagates_not_truncates(self):
+        jm = make_model()
+        fn = make_map_in_arrow_fn(jm)
+
+        def failing_source():
+            yield from stream_table(vec_table(16), 8)
+            raise RuntimeError("executor input died mid-partition")
+
+        with pytest.raises(RuntimeError, match="died mid-partition"):
+            list(fn(failing_source()))
+
+    def test_scoring_failure_propagates(self):
+        jm = make_model()
+        fn = make_map_in_arrow_fn(jm)
+        bad = DataTable({"id": np.arange(4),
+                         "vec": [np.zeros(5, np.float32)] * 4})  # wrong dim
+        with pytest.raises(ValueError, match="model expects"):
+            list(fn(stream_table(bad, 2)))
+
+
+class TestRealPySpark:
+    """End-to-end through a local SparkSession (runs where pyspark exists)."""
+
+    @pytest.fixture(scope="class")
+    def spark(self):
+        pyspark = pytest.importorskip("pyspark")
+        from pyspark.sql import SparkSession
+        spark = (SparkSession.builder.master("local[2]")
+                 .appName("mmlspark_tpu_bridge_test")
+                 .config("spark.sql.execution.arrow.pyspark.enabled", "true")
+                 .getOrCreate())
+        yield spark
+        spark.stop()
+
+    def test_spark_transform_matches_direct(self, spark):
+        from mmlspark_tpu.bridge.spark import spark_transform
+        jm = make_model()
+        t = vec_table(64)
+        df = spark.createDataFrame(t.to_pandas())
+        scored = spark_transform(df, jm).toPandas().sort_values("id")
+        direct = jm.transform(t)
+        np.testing.assert_allclose(
+            np.stack([np.asarray(v) for v in scored["scores"]]),
+            np.stack(list(direct["scores"])), rtol=1e-4, atol=1e-5)
+
+    def test_spark_failure_propagates_through_job(self, spark):
+        from mmlspark_tpu.bridge.spark import spark_transform
+        jm = make_model()
+        t = vec_table(8)
+        bad = t.with_column("vec", [np.zeros(5, np.float32)] * 8)
+        df = spark.createDataFrame(bad.to_pandas())
+        with pytest.raises(Exception) as ei:
+            spark_transform(df, jm)
+        assert "model expects" in str(ei.value) or "Py4J" in \
+            type(ei.value).__name__

@@ -1,0 +1,159 @@
+"""Streaming ingest: bounded-memory readers + stream training + scoring.
+
+Reference: the streaming-capable readers (readers/src/main/scala/
+ImageReader.scala:85-98, BinaryFileFormat.scala:118-179). Here the whole
+path is streamed: chunked decode → fixed-shape rebatching → mesh-sharded
+training, never materializing the dataset."""
+
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.data.readers import (
+    read_images, stream_binary_files, stream_images,
+)
+from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.models.jax_model import JaxModel
+from mmlspark_tpu.models.zoo import ConvNetCifar, get_model
+from mmlspark_tpu.train import TrainConfig, Trainer
+from mmlspark_tpu.train.loop import _rebatch
+
+
+@pytest.fixture(scope="module")
+def image_dir(tmp_path_factory):
+    import cv2
+    root = tmp_path_factory.mktemp("stream_imgs")
+    r = np.random.default_rng(0)
+    # class-dependent brightness so a streamed model can actually learn
+    for i in range(60):
+        label = i % 2
+        img = (r.integers(0, 100, (32, 32, 3)) + 120 * label
+               ).astype(np.uint8)
+        # index-first names: the sorted stream interleaves classes
+        cv2.imwrite(str(root / f"{i:03d}_c{label}.png"), img)
+    return str(root)
+
+
+class TestRebatch:
+    def test_uneven_chunks_to_fixed_batches(self):
+        chunks = [(np.arange(i * 10, i * 10 + n, dtype=np.float32
+                             ).reshape(-1, 1), np.full(n, i))
+                  for i, n in enumerate([3, 7, 5, 2, 6])]  # 23 rows
+        out = list(_rebatch(iter(chunks), 8))
+        assert [int(b[2].sum()) for b in out] == [8, 8, 7]
+        assert all(b[0].shape == (8, 1) for b in out)
+        # every source row appears exactly once, in order
+        got = np.concatenate([b[0][b[2] > 0, 0] for b in out])
+        want = np.concatenate([c[0][:, 0] for c in chunks])
+        np.testing.assert_array_equal(got, want)
+
+    def test_mismatched_chunk_raises(self):
+        with pytest.raises(ValueError, match="length mismatch"):
+            list(_rebatch(iter([(np.zeros((3, 1)), np.zeros(2))]), 4))
+
+
+class TestStreamReaders:
+    def test_chunks_are_bounded_and_complete(self, image_dir):
+        chunks = list(stream_images(image_dir, chunk_rows=16))
+        assert [len(c) for c in chunks] == [16, 16, 16, 12]
+        merged_paths = [v["path"] for c in chunks for v in c["image"]]
+        full = read_images(image_dir)
+        assert merged_paths == [v["path"] for v in full["image"]]
+
+    def test_binary_stream_matches_materialized(self, image_dir):
+        chunks = list(stream_binary_files(image_dir, chunk_rows=25))
+        assert [len(c) for c in chunks] == [25, 25, 10]
+        total = sum(len(b) for c in chunks for b in c["bytes"])
+        assert total > 0
+
+    def test_sharded_streams_are_disjoint(self, image_dir):
+        a = [p for c in stream_binary_files(image_dir, num_shards=2,
+                                            shard_index=0, chunk_rows=8)
+             for p in c["path"]]
+        b = [p for c in stream_binary_files(image_dir, num_shards=2,
+                                            shard_index=1, chunk_rows=8)
+             for p in c["path"]]
+        assert not (set(a) & set(b))
+        assert len(a) + len(b) == 60
+
+
+class TestStreamTraining:
+    def test_convnet_trains_from_chunked_stream(self, image_dir):
+        """The VERDICT item: train the CIFAR ConvNet from a chunked stream
+        without ever materializing the dataset."""
+        def source():
+            for chunk in stream_images(image_dir, chunk_rows=16):
+                imgs = np.stack([np.asarray(v["data"], np.float32) / 255.0
+                                 for v in chunk["image"]])
+                labels = np.asarray(
+                    [int(os.path.basename(v["path"]).split("_c")[1][0])
+                     for v in chunk["image"]], dtype=np.int64)
+                yield imgs, labels
+
+        module = ConvNetCifar(num_classes=2, widths=(8, 16), dense_width=32)
+        cfg = TrainConfig(batch_size=16, epochs=3, learning_rate=3e-3,
+                          log_every=1)
+        tr = Trainer(module, cfg)
+        tr.fit_stream(source)
+        # 60 rows / bs16 → 4 steps per epoch (last padded), 3 epochs
+        assert int(tr.state["step"]) == 12
+        assert tr.history[-1] < tr.history[0]
+
+    def test_stream_matches_arrays_numerics(self):
+        # same data via fit_stream (uneven chunks) and fit_arrays must give
+        # the same final params when the batch walk matches (no shuffling in
+        # the stream path → compare against a stream of the shuffled walk)
+        r = np.random.default_rng(1)
+        x = r.normal(size=(48, 6)).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.int64)
+
+        cfg = TrainConfig(batch_size=16, epochs=1, learning_rate=1e-2,
+                          donate_state=False)
+        mlp = get_model("MLP", input_dim=6, num_outputs=2)
+
+        tr_s = Trainer(type(mlp.module)(features=(64,), num_outputs=2), cfg)
+        # stream the exact shuffled batch order fit_arrays would use
+        from mmlspark_tpu.train.loop import _batches
+        def source():
+            for bx, by, _ in _batches(x, y, 16, cfg.seed):
+                yield bx, by
+        tr_s.fit_stream(source)
+
+        tr_a = Trainer(type(mlp.module)(features=(64,), num_outputs=2), cfg)
+        tr_a.fit_arrays(x, y)
+
+        import jax
+        for a, b in zip(jax.tree_util.tree_leaves(tr_s.params),
+                        jax.tree_util.tree_leaves(tr_a.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_multi_epoch_plain_iterator_rejected(self):
+        cfg = TrainConfig(batch_size=8, epochs=2)
+        tr = Trainer(ConvNetCifar(num_classes=2, widths=(4,), dense_width=8),
+                     cfg)
+        with pytest.raises(ValueError, match="callable source"):
+            tr.fit_stream(iter([]))
+
+
+class TestStreamScoring:
+    def test_transform_stream_matches_batch(self, image_dir):
+        bundle = get_model("ConvNet_CIFAR10", widths=(8, 16),
+                           dense_width=32)
+        jm = JaxModel(model=bundle, input_col="image", output_col="scores",
+                      minibatch_size=16)
+        streamed = [np.stack(list(out["scores"]))
+                    for out in jm.transform_stream(
+                        stream_images(image_dir, chunk_rows=20))]
+        full = jm.transform(read_images(image_dir))
+        np.testing.assert_allclose(
+            np.concatenate(streamed), np.stack(list(full["scores"])),
+            rtol=1e-5, atol=1e-5)
+
+
+def test_empty_stream_raises():
+    tr = Trainer(ConvNetCifar(num_classes=2, widths=(4,), dense_width=8),
+                 TrainConfig(batch_size=8, epochs=1))
+    with pytest.raises(ValueError, match="yielded no data"):
+        tr.fit_stream(iter([]))
