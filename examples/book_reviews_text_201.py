@@ -1,0 +1,64 @@
+"""Example 201 — text classification with TextFeaturizer.
+
+Analog of ``201 - Amazon Book Reviews - TextFeaturizer``: raw review text
+→ ``TextFeaturizer`` (tokenize → stop words → n-grams → hashing TF →
+IDF) → classifier on the hashed features → accuracy (reference:
+notebooks/samples/201*.ipynb; TextFeaturizer.scala:18-171). No egress:
+reviews are synthesized with sentiment-bearing vocabulary.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.ml import ComputeModelStatistics, TrainClassifier
+from mmlspark_tpu.stages.text import TextFeaturizer
+
+POSITIVE = ["wonderful", "gripping", "masterpiece", "loved", "brilliant",
+            "delightful", "compelling", "excellent"]
+NEGATIVE = ["boring", "tedious", "awful", "disappointing", "dull",
+            "predictable", "terrible", "waste"]
+NEUTRAL = ["book", "story", "author", "chapter", "characters", "plot",
+           "pages", "read", "the", "a", "was", "it", "this"]
+
+
+def make_reviews(n: int, seed: int = 11) -> DataTable:
+    r = np.random.default_rng(seed)
+    texts, ratings = [], []
+    for _ in range(n):
+        good = bool(r.random() < 0.5)
+        lexicon = POSITIVE if good else NEGATIVE
+        words = list(r.choice(NEUTRAL, size=r.integers(6, 14)))
+        for _ in range(int(r.integers(1, 4))):
+            words.insert(int(r.integers(0, len(words))),
+                         str(r.choice(lexicon)))
+        texts.append(" ".join(words))
+        ratings.append(1 if good else 0)
+    return DataTable({"text": texts, "rating": np.asarray(ratings)})
+
+
+def run(scale: str = "small") -> dict:
+    n = 1500 if scale == "small" else 20000
+    table = make_reviews(n)
+    split = int(0.8 * len(table))
+    train = table.take(np.arange(split))
+    test = table.take(np.arange(split, len(table)))
+
+    featurizer = TextFeaturizer(
+        input_col="text", output_col="features", use_stop_words_remover=True,
+        use_ngram=False, use_idf=True, num_features=1 << 12).fit(train)
+    model = TrainClassifier(
+        label_col="rating", feature_columns=["features"]).fit(
+        featurizer.transform(train))
+
+    scored = model.transform(featurizer.transform(test))
+    metrics = dict(ComputeModelStatistics().transform(scored).to_rows()[0])
+    metrics["n_test"] = len(test)
+    return metrics
+
+
+if __name__ == "__main__":
+    out = run()
+    print({k: (round(v, 3) if isinstance(v, float) else v)
+           for k, v in out.items()})

@@ -13,7 +13,6 @@ download path — manifest, sha256 cache — is identical).
 from __future__ import annotations
 
 import os
-import sys
 import tempfile
 
 import numpy as np
@@ -23,12 +22,11 @@ from mmlspark_tpu.data.table import DataTable
 from mmlspark_tpu.ml.metrics import confusion_matrix
 from mmlspark_tpu.models.jax_model import JaxModel
 
-sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
 
 
 def ensure_repo(repo_dir: str | None = None) -> str:
     """Build (once) and return the local model repository."""
-    import build_model_repo
+    from mmlspark_tpu.tools import build_model_repo
     repo_dir = repo_dir or os.path.join(tempfile.gettempdir(),
                                         "mmlspark_tpu_model_repo")
     if not os.path.exists(os.path.join(repo_dir, "MANIFEST.json")):
@@ -37,7 +35,7 @@ def ensure_repo(repo_dir: str | None = None) -> str:
 
 
 def run(scale: str = "small", repo_dir: str | None = None) -> dict:
-    import build_model_repo
+    from mmlspark_tpu.tools import build_model_repo
     repo = ensure_repo(repo_dir)
     n = 512 if scale == "small" else 8192
 

@@ -1,0 +1,62 @@
+"""Example 102 — regression with TrainRegressor.
+
+Analog of ``102 - Regression Example with Flight Delay Dataset``: a
+mixed-type table (carrier/origin/dest categoricals + schedule numerics),
+``TrainRegressor`` with auto-featurization, metrics via
+``ComputeModelStatistics`` and per-row residuals via
+``ComputePerInstanceStatistics`` (reference: notebooks/samples/102*.ipynb;
+TrainRegressor.scala:52-130). No egress: the flight table is generated
+deterministically with the original's shape.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.ml import (
+    ComputeModelStatistics, ComputePerInstanceStatistics, TrainRegressor,
+)
+
+
+def make_flights_like(n: int, seed: int = 3) -> DataTable:
+    r = np.random.default_rng(seed)
+    carrier = r.choice(["AA", "DL", "UA", "WN", "B6"], n)
+    origin = r.choice(["JFK", "SEA", "ORD", "ATL", "SFO", "DEN"], n)
+    dest = r.choice(["LAX", "BOS", "MIA", "PHX", "IAD", "MSP"], n)
+    dep_hour = r.integers(5, 23, n).astype(np.float64)
+    distance = r.integers(200, 2800, n).astype(np.float64)
+    day_of_week = r.integers(1, 8, n).astype(np.float64)
+    carrier_delay = {"AA": 8, "DL": 4, "UA": 9, "WN": 6, "B6": 11}
+    delay = (np.array([carrier_delay[c] for c in carrier])
+             + 0.8 * np.maximum(dep_hour - 15, 0) ** 1.5
+             + 0.002 * distance
+             + 3.0 * (day_of_week >= 6)
+             + r.gamma(2.0, 4.0, n) - 8.0)
+    return DataTable({
+        "carrier": list(carrier), "origin": list(origin), "dest": list(dest),
+        "dep_hour": dep_hour, "distance": distance,
+        "day_of_week": day_of_week, "delay_minutes": delay,
+    })
+
+
+def run(scale: str = "small") -> dict:
+    n = 2000 if scale == "small" else 50000
+    table = make_flights_like(n)
+    split = int(0.8 * len(table))
+    train = table.take(np.arange(split))
+    test = table.take(np.arange(split, len(table)))
+
+    model = TrainRegressor(label_col="delay_minutes").fit(train)
+    scored = model.transform(test)
+    metrics = dict(ComputeModelStatistics().transform(scored).to_rows()[0])
+    per_row = ComputePerInstanceStatistics().transform(scored)
+    metrics["n_test"] = len(test)
+    metrics["median_L1"] = float(np.median(per_row["L1_loss"]))
+    return metrics
+
+
+if __name__ == "__main__":
+    out = run()
+    print({k: (round(v, 3) if isinstance(v, float) else v)
+           for k, v in out.items()})

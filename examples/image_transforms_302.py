@@ -1,0 +1,65 @@
+"""Example 302 — pipeline image transformations.
+
+Analog of ``302 - Pipeline Image Transformations``: read images from disk,
+chain geometric/color ops with ``ImageTransformer`` (resize → crop → flip),
+unroll to feature vectors, and profile the result (reference:
+notebooks/samples/302*.ipynb; ImageTransformer.scala:329-360,
+UnrollImage.scala:18-42). No egress: images are synthesized to disk first,
+then ingested through the real reader path.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+import numpy as np
+
+from mmlspark_tpu.core.pipeline import Pipeline
+from mmlspark_tpu.data.readers import read_images
+from mmlspark_tpu.stages.image import ImageTransformer, UnrollImage
+
+
+def ensure_images(n: int, root: str | None = None) -> str:
+    import cv2
+    root = root or os.path.join(tempfile.gettempdir(),
+                                "mmlspark_tpu_302_images")
+    os.makedirs(root, exist_ok=True)
+    r = np.random.default_rng(0)
+    for i in range(n):
+        f = os.path.join(root, f"img{i:04d}.png")
+        if not os.path.exists(f):
+            cv2.imwrite(f, r.integers(0, 255, (64 + i % 32, 96, 3)
+                                      ).astype(np.uint8))
+    return root
+
+
+def run(scale: str = "small") -> dict:
+    n = 48 if scale == "small" else 2048
+    root = ensure_images(n)
+    table = read_images(root)
+
+    pipeline = Pipeline(stages=[
+        ImageTransformer().resize(height=60, width=60)
+                          .crop(x=0, y=0, height=48, width=48)
+                          .flip(flip_code=1),
+        UnrollImage(input_col="image", output_col="features",
+                    scale=1 / 255.0),
+    ])
+    out = pipeline.fit(table).transform(table)
+
+    feats = np.stack(list(out["features"]))
+    first = out["image"][0]
+    return {
+        "n_images": len(out),
+        "transformed_hw": [first["height"], first["width"]],
+        "feature_dim": int(feats.shape[1]),
+        "feature_mean": float(feats.mean()),
+        "feature_std": float(feats.std()),
+    }
+
+
+if __name__ == "__main__":
+    out = run()
+    print({k: (round(v, 4) if isinstance(v, float) else v)
+           for k, v in out.items()})

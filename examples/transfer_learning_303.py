@@ -1,0 +1,67 @@
+"""Example 303 — transfer learning by DNN featurization.
+
+Analog of ``303 - Transfer Learning by DNN Featurization - Airplane or
+Automobile``: download a pretrained CNN from the zoo, cut its classifier
+head with ``ImageFeaturizer`` (intermediate activations as features), and
+train a cheap classifier on two classes (reference:
+notebooks/samples/303*.ipynb; ImageFeaturizer.scala:116-140). No egress:
+the zoo is the deterministic local repository; the two "classes" are
+synthetic image distributions.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mmlspark_tpu.core.schema import make_image, mark_image_column
+from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.ml import ComputeModelStatistics, TrainClassifier
+from mmlspark_tpu.models.image_featurizer import ImageFeaturizer
+
+try:
+    from examples.cifar_eval_301 import ensure_repo
+except ImportError:  # run directly: python examples/<name>.py
+    from cifar_eval_301 import ensure_repo
+
+
+def make_two_class_images(n: int, seed: int = 5) -> DataTable:
+    r = np.random.default_rng(seed)
+    rows, labels = [], []
+    for i in range(n):
+        label = i % 2
+        base = r.integers(0, 90, (32, 32, 3))
+        if label:  # "automobile": bright horizontal band
+            base[12:20, :, :] = r.integers(160, 255, (8, 32, 3))
+        else:      # "airplane": bright vertical band
+            base[:, 12:20, :] = r.integers(160, 255, (32, 8, 3))
+        rows.append(make_image(f"img{i}", base))
+        labels.append(label)
+    t = DataTable({"image": rows, "label": np.asarray(labels)})
+    return mark_image_column(t, "image")
+
+
+def run(scale: str = "small", repo_dir: str | None = None) -> dict:
+    n = 160 if scale == "small" else 4096
+    repo = ensure_repo(repo_dir)
+    table = make_two_class_images(n)
+    split = int(0.75 * len(table))
+    train = table.take(np.arange(split))
+    test = table.take(np.arange(split, len(table)))
+
+    featurizer = (ImageFeaturizer(output_col="features", cut_output_layers=1,
+                                  minibatch_size=64)
+                  .set_model_from_repo("ResNet_Small", repo=repo))
+    model = TrainClassifier(
+        label_col="label", feature_columns=["features"]).fit(
+        featurizer.transform(train))
+
+    scored = model.transform(featurizer.transform(test))
+    metrics = dict(ComputeModelStatistics().transform(scored).to_rows()[0])
+    metrics["n_test"] = len(test)
+    return metrics
+
+
+if __name__ == "__main__":
+    out = run()
+    print({k: (round(v, 3) if isinstance(v, float) else v)
+           for k, v in out.items()})

@@ -64,6 +64,10 @@ def list_files(path: str, recursive: bool = False,
     scheme, _ = _fs.split_scheme(path)
     if scheme and scheme != "file":
         files = _fs.list_files(path, recursive=recursive)
+        if not files and not _fs.exists(path):
+            # match the local branch: a typo'd prefix is an error, not a
+            # silent empty dataset
+            raise FileNotFoundError(path)
         if extensions:
             files = [f for f in files
                      if f.lower().endswith(extensions)

@@ -109,26 +109,39 @@ def replicated(mesh) -> Any:
 
 
 def param_shardings(mesh, params) -> Any:
-    """Pytree of shardings for the params: with ``fsdp > 1`` each leaf's
-    largest fsdp-divisible dim is sharded over the fsdp axis (zero-style
-    parameter sharding; XLA all-gathers for the forward and reduce-scatters
-    the grads); leaves with no divisible dim — and everything when
-    ``fsdp == 1`` — replicate."""
+    """Pytree of shardings for the params.
+
+    * ``tp > 1``: every ≥2-D leaf's LAST (output-feature) dim shards over
+      the tensor-parallel axis when divisible — column-parallel matmuls;
+      GSPMD propagates the activation shardings and inserts the
+      all-reduces/all-gathers (the annotate-and-let-XLA recipe; no manual
+      collectives).
+    * ``fsdp > 1``: the largest remaining divisible dim shards over fsdp
+      (zero-style parameter sharding; XLA all-gathers for the forward and
+      reduce-scatters the grads).
+    * Leaves with no divisible dim — and everything on a pure-dp mesh —
+      replicate. ``pp``/``ep`` are reserved axes: nothing shards over them
+      yet (pipeline/expert layouts are model-specific).
+    """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     fsdp = mesh.shape["fsdp"]
+    tp = mesh.shape["tp"]
 
     def one(leaf):
         shape = getattr(leaf, "shape", ())
-        if fsdp == 1 or len(shape) == 0:
-            return NamedSharding(mesh, P())
-        divisible = [(d, s) for d, s in enumerate(shape) if s % fsdp == 0]
-        if not divisible:
-            return NamedSharding(mesh, P())
-        d = max(divisible, key=lambda t: t[1])[0]
         spec: list = [None] * len(shape)
-        spec[d] = "fsdp"
+        if tp > 1 and len(shape) >= 2 and shape[-1] % tp == 0:
+            spec[-1] = "tp"
+        if fsdp > 1 and len(shape) > 0:
+            divisible = [(d, s) for d, s in enumerate(shape)
+                         if spec[d] is None and s % fsdp == 0]
+            if divisible:
+                d = max(divisible, key=lambda t: t[1])[0]
+                spec[d] = "fsdp"
+        if all(s is None for s in spec):
+            return NamedSharding(mesh, P())
         return NamedSharding(mesh, P(*spec))
 
     return jax.tree_util.tree_map(one, params)

@@ -184,6 +184,39 @@ def _batches(x: np.ndarray, y: np.ndarray, batch_size: int,
             yield bx, by, bw
 
 
+_SIG_BYTES = 256
+
+
+def _sync_batch_signature(batch: Any) -> tuple | None:
+    """All-gather this process's (x, y) tail shapes/dtypes; return the
+    first non-empty peer signature. Keeps multi-host filler batches (and
+    hence the compiled step program) identical on every process even when
+    one process's stream is empty."""
+    import json
+
+    from jax.experimental import multihost_utils
+
+    if batch is None:
+        mine = np.zeros(_SIG_BYTES, np.uint8)
+    else:
+        bx, by, _ = batch
+        enc = json.dumps({
+            "xs": [int(d) for d in bx.shape[1:]], "xd": bx.dtype.str,
+            "ys": [int(d) for d in by.shape[1:]], "yd": by.dtype.str,
+        }).encode()
+        if len(enc) > _SIG_BYTES:
+            raise ValueError(f"batch signature too large: {enc!r}")
+        mine = np.frombuffer(enc.ljust(_SIG_BYTES, b"\0"), np.uint8).copy()
+    sigs = np.asarray(multihost_utils.process_allgather(mine))
+    for row in sigs.reshape(-1, _SIG_BYTES):
+        raw = bytes(row).rstrip(b"\0")
+        if raw:
+            d = json.loads(raw)
+            return ((tuple(d["xs"]), np.dtype(d["xd"])),
+                    (tuple(d["ys"]), np.dtype(d["yd"])))
+    return None
+
+
 def _rebatch(chunks: Any, batch_size: int) -> Iterator[tuple]:
     """Re-accumulate arbitrary-size (x, y) chunks into fixed-size batches
     ``(bx, by, bw)``; the final partial batch is zero-padded with a 0/1
@@ -291,6 +324,18 @@ class Trainer:
 
         cfg = self.cfg
         nproc = jax.process_count()
+        if nproc > 1:
+            # every process must walk the same number of steps or the
+            # gradient all-reduce deadlocks — validate loudly up front
+            from jax.experimental import multihost_utils
+            lens = np.asarray(multihost_utils.process_allgather(
+                np.asarray(len(x), np.int64)))
+            if len(set(lens.tolist())) != 1:
+                raise ValueError(
+                    "fit_arrays multi-host requires equal per-process "
+                    f"shard lengths, got {lens.tolist()} — pad or trim the "
+                    "shards, or use fit_stream (which reconciles unequal "
+                    "streams with filler batches)")
         # the batch must divide over the data axes AND split evenly across
         # processes (each contributes bs/nproc rows), so round down to a
         # multiple of lcm(dp, nproc)
@@ -423,9 +468,21 @@ class Trainer:
                     np.zeros((bs_local,) + ys, yd),
                     np.zeros(bs_local, np.float32))
 
+        sig_synced = False
         with timed(f"Trainer[{type(self.module).__name__}:stream]", _log):
             for epoch in range(cfg.epochs):
                 it = iter(epoch_iter())
+                if nproc > 1 and not sig_synced:
+                    # exchange batch signatures once (symmetric across
+                    # processes): a process whose shard is empty adopts its
+                    # peers' shapes/dtypes for filler batches, so every
+                    # process compiles the identical step program
+                    import itertools as _itertools
+                    first = next(it, None)
+                    shapes = _sync_batch_signature(first) or shapes
+                    sig_synced = True
+                    if first is not None:
+                        it = _itertools.chain([first], it)
                 while True:
                     batch = next(it, None)
                     if nproc > 1:

@@ -1,0 +1,68 @@
+"""Every example runs in CI at small scale with asserted outcomes.
+
+The reference executes every sample notebook in its test suite
+(tools/notebook/tester/NotebookTestSuite.py:13-60, TestNotebooksLocally.py);
+these are the analogs for the 101/102/201/301/302/303/304 family — dead
+examples cannot rot silently."""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "examples"))
+
+
+@pytest.fixture(scope="module")
+def zoo_repo(tmp_path_factory):
+    """One shared pretrained repo for the 301/303/304 examples."""
+    from cifar_eval_301 import ensure_repo
+    return ensure_repo(str(tmp_path_factory.mktemp("examples_zoo")))
+
+
+def test_example_101_tabular_classification():
+    import tabular_classification_101 as ex
+    out = ex.run("small")
+    assert out["accuracy"] > 0.72, out  # noisy synthetic census task
+    assert out["auc"] is None or out["auc"] > 0.85
+
+
+def test_example_102_flight_delay_regression():
+    import flight_delay_regression_102 as ex
+    out = ex.run("small")
+    assert out["R^2"] > 0.2, out
+    assert out["root_mean_squared_error"] < 12.0
+
+
+def test_example_201_text_featurizer():
+    import book_reviews_text_201 as ex
+    out = ex.run("small")
+    assert out["accuracy"] > 0.85, out
+
+
+def test_example_301_cifar_eval(zoo_repo):
+    import cifar_eval_301 as ex
+    out = ex.run("small", repo_dir=zoo_repo)
+    assert out["accuracy"] > 0.5, out  # 10 classes, chance = 0.1
+
+
+def test_example_302_image_transforms():
+    import image_transforms_302 as ex
+    out = ex.run("small")
+    assert out["transformed_hw"] == [48, 48]
+    assert out["feature_dim"] == 3 * 48 * 48
+    assert 0.0 < out["feature_mean"] < 1.0
+
+
+def test_example_303_transfer_learning(zoo_repo):
+    import transfer_learning_303 as ex
+    out = ex.run("small", repo_dir=zoo_repo)
+    assert out["accuracy"] > 0.85, out
+
+
+def test_example_304_medical_entity(zoo_repo):
+    import medical_entity_304 as ex
+    out = ex.run("small", repo_dir=zoo_repo)
+    assert out["token_accuracy"] > 0.9, out
+    assert out["bucket_shapes"] == [16, 32, 64]

@@ -147,3 +147,10 @@ def test_memory_root_listing_respects_recursive_flag():
     assert fs.list_files("memory://") == ["memory://top.bin"]
     assert fs.list_files("memory://", recursive=True) == [
         "memory://deep/nested.bin", "memory://top.bin"]
+
+
+def test_missing_memory_prefix_raises_like_local():
+    from mmlspark_tpu.data.readers import read_binary_files
+    fs.write_bytes("memory://realdata/a.bin", b"x")
+    with pytest.raises(FileNotFoundError):
+        read_binary_files("memory://datq")  # typo'd prefix
