@@ -22,13 +22,15 @@ from mmlspark_tpu.stages.image import ImageTransformer, UnrollImage
 
 def ensure_images(n: int, root: str | None = None) -> str:
     import cv2
+    # per-scale directory + per-file seeds: content is reproducible and a
+    # small run never ingests a larger run's leftovers
     root = root or os.path.join(tempfile.gettempdir(),
-                                "mmlspark_tpu_302_images")
+                                f"mmlspark_tpu_302_images_{n}")
     os.makedirs(root, exist_ok=True)
-    r = np.random.default_rng(0)
     for i in range(n):
         f = os.path.join(root, f"img{i:04d}.png")
         if not os.path.exists(f):
+            r = np.random.default_rng(i)
             cv2.imwrite(f, r.integers(0, 255, (64 + i % 32, 96, 3)
                                       ).astype(np.uint8))
     return root

@@ -28,6 +28,34 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 
+class _PallasGN(nn.Module):
+    """GroupNorm(+fused ReLU) through the Pallas kernel, with the same
+    param names/shapes as ``nn.GroupNorm`` so published bundles and
+    checkpoints load interchangeably (the kernel auto-falls back to the
+    XLA lowering for blocks too large for VMEM)."""
+
+    num_groups: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, relu: bool = False):
+        from mmlspark_tpu.ops.group_norm import group_norm
+        c = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (c,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (c,), jnp.float32)
+        return group_norm(x, scale, bias, self.num_groups,
+                          relu=relu).astype(self.dtype)
+
+
+def _gn(name: str, groups: int, dtype: Any, impl: str, y, relu: bool = False):
+    """One GroupNorm site: the default XLA path is byte-identical to before
+    (plain nn.GroupNorm); ``impl="pallas"`` swaps in the fused kernel."""
+    if impl == "pallas":
+        return _PallasGN(num_groups=groups, dtype=dtype, name=name)(y, relu)
+    y = nn.GroupNorm(num_groups=groups, dtype=dtype, name=name)(y)
+    return nn.relu(y) if relu else y
+
+
 class BottleneckBlock(nn.Module):
     """1×1 → 3×3 → 1×1 bottleneck with projection shortcut (ResNet v1.5:
     the stride lives on the 3×3)."""
@@ -36,31 +64,26 @@ class BottleneckBlock(nn.Module):
     strides: int = 1
     groups: int = 32
     dtype: Any = jnp.bfloat16
+    gn_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x):
         residual = x
         y = nn.Conv(self.filters, (1, 1), use_bias=False,
                     dtype=self.dtype, name="conv1")(x)
-        y = nn.GroupNorm(num_groups=self.groups, dtype=self.dtype,
-                         name="gn1")(y)
-        y = nn.relu(y)
+        y = _gn("gn1", self.groups, self.dtype, self.gn_impl, y, relu=True)
         y = nn.Conv(self.filters, (3, 3), strides=(self.strides,) * 2,
                     use_bias=False, dtype=self.dtype, name="conv2")(y)
-        y = nn.GroupNorm(num_groups=self.groups, dtype=self.dtype,
-                         name="gn2")(y)
-        y = nn.relu(y)
+        y = _gn("gn2", self.groups, self.dtype, self.gn_impl, y, relu=True)
         y = nn.Conv(4 * self.filters, (1, 1), use_bias=False,
                     dtype=self.dtype, name="conv3")(y)
-        y = nn.GroupNorm(num_groups=self.groups, dtype=self.dtype,
-                         name="gn3")(y)
+        y = _gn("gn3", self.groups, self.dtype, self.gn_impl, y)
         if residual.shape != y.shape:
             residual = nn.Conv(4 * self.filters, (1, 1),
                                strides=(self.strides,) * 2, use_bias=False,
                                dtype=self.dtype, name="proj")(x)
-            residual = nn.GroupNorm(num_groups=self.groups,
-                                    dtype=self.dtype, name="gn_proj")(
-                residual)
+            residual = _gn("gn_proj", self.groups, self.dtype,
+                           self.gn_impl, residual)
         return nn.relu(y + residual)
 
 
@@ -72,6 +95,7 @@ class ResNet(nn.Module):
     width: int = 64
     groups: int = 32
     dtype: Any = jnp.bfloat16
+    gn_impl: str = "xla"   # "pallas" = fused GN+ReLU kernel (ops/group_norm)
 
     OUTPUT_NAMES = ("features", "logits")
 
@@ -80,9 +104,8 @@ class ResNet(nn.Module):
         x = x.astype(self.dtype)
         x = nn.Conv(self.width, (7, 7), strides=(2, 2), use_bias=False,
                     dtype=self.dtype, name="conv_stem")(x)
-        x = nn.GroupNorm(num_groups=min(self.groups, self.width),
-                         dtype=self.dtype, name="gn_stem")(x)
-        x = nn.relu(x)
+        x = _gn("gn_stem", min(self.groups, self.width), self.dtype,
+                self.gn_impl, x, relu=True)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for stage, n_blocks in enumerate(self.stage_sizes):
             filters = self.width * (2 ** stage)
@@ -91,7 +114,7 @@ class ResNet(nn.Module):
                 x = BottleneckBlock(
                     filters=filters, strides=strides,
                     groups=min(self.groups, filters),
-                    dtype=self.dtype,
+                    dtype=self.dtype, gn_impl=self.gn_impl,
                     name=f"stage{stage}_block{block}")(x)
         x = jnp.mean(x, axis=(1, 2))  # global average pool
         features = x.astype(jnp.float32)
@@ -101,13 +124,14 @@ class ResNet(nn.Module):
         return logits.astype(jnp.float32)
 
 
-def resnet50(num_classes: int = 1000, dtype: Any = jnp.bfloat16) -> ResNet:
+def resnet50(num_classes: int = 1000, dtype: Any = jnp.bfloat16,
+             gn_impl: str = "xla") -> ResNet:
     return ResNet(num_classes=num_classes, stage_sizes=(3, 4, 6, 3),
-                  dtype=dtype)
+                  dtype=dtype, gn_impl=gn_impl)
 
 
 def resnet18_thin(num_classes: int = 10, width: int = 16,
-                  dtype: Any = jnp.bfloat16) -> ResNet:
+                  dtype: Any = jnp.bfloat16, gn_impl: str = "xla") -> ResNet:
     """Small same-shape-family net for tests/CI (bottleneck (2,2) stages)."""
     return ResNet(num_classes=num_classes, stage_sizes=(2, 2), width=width,
-                  groups=8, dtype=dtype)
+                  groups=8, dtype=dtype, gn_impl=gn_impl)

@@ -1,0 +1,11 @@
+"""TPU device kernels (Pallas) and op-level utilities.
+
+The reference's device compute lives in CNTK's C++ kernels behind JNI;
+here the hot device ops XLA doesn't already schedule optimally get
+hand-written Pallas kernels, with jnp reference implementations for
+equivalence tests and non-TPU backends.
+"""
+
+from mmlspark_tpu.ops.group_norm import group_norm, group_norm_reference
+
+__all__ = ["group_norm", "group_norm_reference"]

@@ -223,3 +223,24 @@ def test_image_helpers():
 def test_find_unused_column_name():
     t = DataTable({"x": [1], "x_1": [2]})
     assert S.find_unused_column_name(t, "x") == "x_2"
+
+
+def test_profiler_trace_writes_events(tmp_path):
+    """utils/profiling.trace captures a real device trace (SURVEY §5
+    tracing: profiler hooks beyond the Timer stage's wall clocks)."""
+    import glob
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.utils.profiling import annotate, trace
+
+    d = str(tmp_path / "prof")
+    with trace(d):
+        with annotate("tiny-matmul"):
+            a = jnp.ones((64, 64))
+            float(jnp.sum(jax.jit(lambda m: m @ m)(a)))
+    files = glob.glob(os.path.join(d, "**", "*"), recursive=True)
+    assert any(f.endswith((".pb", ".json.gz", ".xplane.pb"))
+               or "trace" in os.path.basename(f) for f in files), files
