@@ -1,0 +1,79 @@
+"""Example 103 — the same task with and without the one-call API.
+
+Analog of ``103 - Before and After MMLSpark``: the "before" path
+hand-assembles the pipeline (index the labels, impute missing values,
+index categoricals, hash text, assemble a vector, fit a learner, compute
+metrics by hand); the "after" path is a single ``TrainClassifier`` +
+``ComputeModelStatistics``. Both run here and must agree — the point of
+the notebook is that the one-call API does the same work (reference:
+notebooks/samples/103*.ipynb).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.ml import ComputeModelStatistics, TrainClassifier
+from mmlspark_tpu.ml.learners import LogisticRegression
+from mmlspark_tpu.stages.featurize import AssembleFeatures
+from mmlspark_tpu.stages.indexers import ValueIndexer
+from mmlspark_tpu.stages.missing import CleanMissingData
+
+try:
+    from examples.tabular_classification_101 import make_census_like
+except ImportError:  # run directly: python examples/<name>.py
+    from tabular_classification_101 import make_census_like
+
+
+def run(scale: str = "small") -> dict:
+    n = 2000 if scale == "small" else 30000
+    table = make_census_like(n)
+    split = int(0.8 * n)
+    train = table.take(np.arange(split))
+    test = table.take(np.arange(split, n))
+
+    # ---- BEFORE: every step by hand ----
+    label_ix = ValueIndexer(input_col="income", output_col="label").fit(train)
+    clean = CleanMissingData(input_cols=["age"], output_cols=["age"],
+                             cleaning_mode="Mean").fit(train)
+    edu_ix = ValueIndexer(input_col="education",
+                          output_col="education").fit(train)
+    occ_ix = ValueIndexer(input_col="occupation",
+                          output_col="occupation").fit(train)
+    feats = AssembleFeatures(
+        columns_to_featurize=["age", "hours_per_week", "education",
+                              "occupation", "capital_gain"],
+        number_of_features=4096).fit(
+        occ_ix.transform(edu_ix.transform(clean.transform(train))))
+
+    def before_prep(t):
+        t = label_ix.transform(t)
+        t = clean.transform(t)
+        t = occ_ix.transform(edu_ix.transform(t))
+        return feats.transform(t)
+
+    btrain, btest = before_prep(train), before_prep(test)
+    learner = LogisticRegression().fit_arrays(
+        btrain.column_matrix("features"),
+        np.asarray(btrain["label"], np.int64), num_classes=2)
+    pred, _ = learner.predict_arrays(btest.column_matrix("features"))
+    before_acc = float((np.asarray(pred) ==
+                        np.asarray(btest["label"])).mean())
+
+    # ---- AFTER: one call ----
+    model = TrainClassifier(label_col="income").fit(train)
+    scored = model.transform(test)
+    after = dict(ComputeModelStatistics().transform(scored).to_rows()[0])
+
+    return {"before_accuracy": before_acc,
+            "after_accuracy": float(after["accuracy"]),
+            "after_auc": float(after["AUC"]),
+            "hand_written_stages": 6, "one_call_stages": 1,
+            "n_test": len(test)}
+
+
+if __name__ == "__main__":
+    out = run()
+    print({k: (round(v, 3) if isinstance(v, float) else v)
+           for k, v in out.items()})

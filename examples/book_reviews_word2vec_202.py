@@ -1,0 +1,73 @@
+"""Example 202 — Word2Vec features + model selection.
+
+Analog of ``202 - Amazon Book Reviews - Word2Vec``: tokenize review text,
+learn skip-gram embeddings with ``Word2Vec``, average them into row
+features, train several classifiers, pick the winner with
+``FindBestModel`` by AUC, and report validation metrics (reference:
+notebooks/samples/202*.ipynb). No egress: reviews are synthesized with
+sentiment-bearing vocabulary (same generator as example 201).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.ml import (
+    ComputeModelStatistics, FindBestModel, TrainClassifier,
+)
+from mmlspark_tpu.ml.learners import LogisticRegression, MLPClassifier
+from mmlspark_tpu.stages.text import Tokenizer
+from mmlspark_tpu.stages.word2vec import Word2Vec
+
+try:
+    from examples.book_reviews_text_201 import make_reviews
+except ImportError:  # run directly: python examples/<name>.py
+    from book_reviews_text_201 import make_reviews
+
+
+def run(scale: str = "small") -> dict:
+    n = 1500 if scale == "small" else 20000
+    table = make_reviews(n)
+    s1, s2 = int(0.6 * n), int(0.8 * n)
+    train = table.take(np.arange(s1))
+    test = table.take(np.arange(s1, s2))
+    validation = table.take(np.arange(s2, n))
+
+    # text → tokens → averaged skip-gram embeddings
+    tok = Tokenizer(input_col="text", output_col="words")
+    w2v = Word2Vec(input_col="words", output_col="features",
+                   vector_size=32, epochs=6, min_count=2, seed=42).fit(
+        tok.transform(train))
+
+    def featurize(t: DataTable) -> DataTable:
+        return w2v.transform(tok.transform(t))
+
+    ftrain, ftest, fval = map(featurize, (train, test, validation))
+
+    candidates = [
+        TrainClassifier(model=LogisticRegression(reg_param=reg),
+                        label_col="rating",
+                        feature_columns=["features"]).fit(ftrain)
+        for reg in (0.0, 1e-3)
+    ] + [
+        TrainClassifier(model=MLPClassifier(layers=[32]),
+                        label_col="rating",
+                        feature_columns=["features"]).fit(ftrain)
+    ]
+
+    best = FindBestModel(models=candidates,
+                         evaluation_metric="AUC").fit(ftest)
+    metrics = dict(ComputeModelStatistics().transform(
+        best.transform(fval)).to_rows()[0])
+    metrics["n_validation"] = len(validation)
+    metrics["best_metric_on_test"] = best.best_metric
+    metrics["synonym_probe"] = [w for w, _ in
+                                w2v.find_synonyms("wonderful", 3)]
+    return metrics
+
+
+if __name__ == "__main__":
+    out = run()
+    print({k: (round(v, 3) if isinstance(v, float) else v)
+           for k, v in out.items()})

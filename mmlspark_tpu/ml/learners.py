@@ -76,7 +76,11 @@ def _train_jax(loss_fn: Callable, params0: Any, x: np.ndarray, y: np.ndarray,
 
     @jax.jit
     def step(params, opt_state, xb, yb):
-        loss, grads = jax.value_and_grad(loss_fn)(params, xb, yb)
+        # classical learners are tiny: full-f32 matmuls cost nothing on the
+        # MXU but the default bf16 visibly degrades tabular accuracy (the
+        # CPU and TPU backends must agree on what these models learn)
+        with jax.default_matmul_precision("float32"):
+            loss, grads = jax.value_and_grad(loss_fn)(params, xb, yb)
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 

@@ -52,6 +52,9 @@ def _gn(name: str, groups: int, dtype: Any, impl: str, y, relu: bool = False):
     (plain nn.GroupNorm); ``impl="pallas"`` swaps in the fused kernel."""
     if impl == "pallas":
         return _PallasGN(num_groups=groups, dtype=dtype, name=name)(y, relu)
+    if impl != "xla":
+        raise ValueError(f"unknown gn_impl {impl!r}; one of ['xla', "
+                         "'pallas']")
     y = nn.GroupNorm(num_groups=groups, dtype=dtype, name=name)(y)
     return nn.relu(y) if relu else y
 

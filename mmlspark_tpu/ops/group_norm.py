@@ -33,6 +33,7 @@ def group_norm_reference(x: jnp.ndarray, scale: jnp.ndarray,
                          ) -> jnp.ndarray:
     """Plain-jnp GroupNorm over the channel (last) axis of NHWC input."""
     n, h, w, c = x.shape
+    _validate_groups(c, num_groups)
     cg = c // num_groups
     xf = x.astype(jnp.float32).reshape(n, h * w, num_groups, cg)
     mean = xf.mean(axis=(1, 3), keepdims=True)
@@ -130,12 +131,22 @@ def _group_norm_custom(x: jnp.ndarray, scale: jnp.ndarray,
     return _group_norm_fwd_pallas(x, scale, bias, num_groups, eps, relu)
 
 
+def _validate_groups(c: int, num_groups: int) -> None:
+    # channels that match no group would silently normalize to zero (the
+    # iota mask has no row for them) — refuse loudly instead
+    if num_groups <= 0 or c % num_groups != 0:
+        raise ValueError(
+            f"group_norm: {c} channels not divisible into "
+            f"{num_groups} groups")
+
+
 def group_norm(x: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray,
                num_groups: int, eps: float = 1e-6,
                relu: bool = False) -> jnp.ndarray:
     """Fused GroupNorm(+ReLU): Pallas forward (when the per-sample block
     fits VMEM), reference-impl backward; XLA reference otherwise."""
     n, h, w, c = x.shape
+    _validate_groups(c, num_groups)
     if not _fits_vmem(h, w, c, x.dtype.itemsize):
         return group_norm_reference(x, scale, bias, num_groups, eps, relu)
     return _group_norm_custom(x, scale, bias, num_groups, eps, relu)

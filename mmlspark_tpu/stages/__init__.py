@@ -26,6 +26,7 @@ from mmlspark_tpu.stages.text import (
     IDF, IDFModel, HashingTF, NGram, StopWordsRemover, TextFeaturizer,
     Tokenizer,
 )
+from mmlspark_tpu.stages.word2vec import Word2Vec, Word2VecModel
 from mmlspark_tpu.stages.utility import (
     Cacher, CheckpointData, ClassBalancer, ClassBalancerModel, DropColumns,
     MultiColumnAdapter, RenameColumns, Repartition, SelectColumns, Timer,
@@ -39,7 +40,9 @@ __all__ = [
     "Featurize", "HashingTF", "IDF", "IDFModel", "ImageSetAugmenter",
     "ImageTransformer", "IndexToValue", "MultiColumnAdapter", "NGram",
     "PartitionSample", "RenameColumns", "Repartition", "SelectColumns",
-    "StopWordsRemover", "SummarizeData", "TextFeaturizer", "Timer",
+    "StopWordsRemover", "SummarizeData",
+    "Word2Vec",
+    "Word2VecModel", "TextFeaturizer", "Timer",
     "TimerModel", "Tokenizer", "UnrollImage", "ValueIndexer",
     "ValueIndexerModel",
 ]

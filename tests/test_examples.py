@@ -66,3 +66,22 @@ def test_example_304_medical_entity(zoo_repo):
     out = ex.run("small", repo_dir=zoo_repo)
     assert out["token_accuracy"] > 0.9, out
     assert out["bucket_shapes"] == [16, 32, 64]
+
+
+def test_example_103_before_after():
+    import before_after_103 as ex
+    out = ex.run("small")
+    # both paths must land in the same accuracy regime (the notebook's
+    # point: the one-call API does the same work)
+    assert out["after_accuracy"] > 0.72, out
+    assert abs(out["before_accuracy"] - out["after_accuracy"]) < 0.12, out
+
+
+def test_example_202_word2vec():
+    import book_reviews_word2vec_202 as ex
+    out = ex.run("small")
+    assert out["accuracy"] > 0.85, out
+    # embeddings must cluster sentiment vocabulary
+    from book_reviews_text_201 import NEGATIVE, POSITIVE
+    assert set(out["synonym_probe"]) <= set(POSITIVE + NEGATIVE), out
+    assert len(set(out["synonym_probe"]) & set(POSITIVE)) >= 2, out

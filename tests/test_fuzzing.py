@@ -199,6 +199,12 @@ CONFIG: dict[str, dict] = {
                                                  output_col="feats",
                                                  num_features=64),
         table=_text_table),
+    "Word2Vec": dict(
+        build=lambda ctx: _cls("Word2Vec")(input_col="toks",
+                                           output_col="w2v",
+                                           vector_size=8, epochs=1,
+                                           min_count=1),
+        table=_text_table),
     # ---- featurize ----
     "AssembleFeatures": dict(
         build=lambda ctx: _cls("AssembleFeatures")(number_of_features=64),
@@ -276,6 +282,7 @@ _MODEL_VIA = {
     "TrainedRegressorModel": "TrainRegressor",
     "BestModel": "FindBestModel",
     "JaxLearnerModel": "JaxLearner",
+    "Word2VecModel": "Word2Vec",
 }
 
 

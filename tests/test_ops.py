@@ -102,3 +102,19 @@ class TestResNetWiring:
         from mmlspark_tpu.models.zoo import get_model
         b = get_model("ResNet_Small", num_classes=3, gn_impl="pallas")
         assert b.module.gn_impl == "pallas"
+
+
+def test_indivisible_groups_raise():
+    x = jnp.zeros((1, 4, 4, 20))
+    s, b = jnp.ones(20), jnp.zeros(20)
+    with pytest.raises(ValueError, match="not divisible"):
+        group_norm(x, s, b, 3)
+    with pytest.raises(ValueError, match="not divisible"):
+        group_norm_reference(x, s, b, 3)
+
+
+def test_unknown_gn_impl_raises():
+    from mmlspark_tpu.models.resnet import resnet18_thin
+    m = resnet18_thin(num_classes=2, gn_impl="Pallas")  # typo'd case
+    with pytest.raises(ValueError, match="unknown gn_impl"):
+        m.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))

@@ -238,3 +238,74 @@ class TestFeaturize:
         out = model.transform(t)
         assert out.column_matrix("fa").shape == (4, 1)
         assert out.column_matrix("fb").shape == (4, 1)
+
+
+class TestWord2Vec:
+    """Word2Vec skip-gram embeddings (notebook-202 analog; reference spec:
+    core/ml/src/test/scala/Word2VecSpec.scala)."""
+
+    @staticmethod
+    def topic_corpus(n=300, seed=0):
+        # two disjoint topic clusters: co-occurrence must pull each topic's
+        # words together in embedding space
+        r = np.random.default_rng(seed)
+        space = ["rocket", "orbit", "launch", "satellite", "astronaut"]
+        ocean = ["whale", "coral", "tide", "reef", "dolphin"]
+        rows = []
+        for _ in range(n):
+            topic = space if r.random() < 0.5 else ocean
+            rows.append([topic[i] for i in r.integers(0, 5, size=8)])
+        return DataTable({"tokens": rows})
+
+    def test_synonyms_respect_topics(self):
+        from mmlspark_tpu.stages.word2vec import Word2Vec
+        t = self.topic_corpus()
+        model = Word2Vec(vector_size=16, epochs=8, min_count=2,
+                         window=3, seed=1).fit(t)
+        assert len(model.vocab) == 10
+        syns = [w for w, _ in model.find_synonyms("rocket", 4)]
+        space = {"orbit", "launch", "satellite", "astronaut"}
+        assert len(set(syns) & space) >= 3, syns
+
+    def test_transform_averages_vectors(self):
+        from mmlspark_tpu.stages.word2vec import Word2Vec
+        t = self.topic_corpus(100)
+        model = Word2Vec(vector_size=8, epochs=2).fit(t)
+        out = model.transform(DataTable({"tokens": [
+            ["rocket", "orbit"], ["unknownword"], None]}))
+        vecs = list(out["features"])
+        v = np.asarray(model.vectors)
+        idx = {w: i for i, w in enumerate(model.vocab)}
+        np.testing.assert_allclose(
+            vecs[0], (v[idx["rocket"]] + v[idx["orbit"]]) / 2, rtol=1e-5)
+        np.testing.assert_array_equal(vecs[1], np.zeros(8))  # OOV → zeros
+        np.testing.assert_array_equal(vecs[2], np.zeros(8))  # missing row
+
+    def test_save_load_roundtrip(self, tmp_path):
+        from mmlspark_tpu.core.stage import PipelineStage
+        from mmlspark_tpu.stages.word2vec import Word2Vec
+        t = self.topic_corpus(80)
+        model = Word2Vec(vector_size=8, epochs=2).fit(t)
+        model.save(str(tmp_path / "w2v"))
+        loaded = PipelineStage.load(str(tmp_path / "w2v"))
+        a = np.stack(list(model.transform(t)["features"]))
+        b = np.stack(list(loaded.transform(t)["features"]))
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+    def test_min_count_filters_and_empty_vocab_raises(self):
+        from mmlspark_tpu.stages.word2vec import Word2Vec
+        t = DataTable({"tokens": [["a", "b"], ["a", "c"]]})
+        m = Word2Vec(vector_size=4, min_count=2, epochs=1).fit(t)
+        assert m.vocab == ["a"]
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="min_count"):
+            Word2Vec(min_count=5).fit(t)
+
+
+def test_word2vec_param_domains():
+    from mmlspark_tpu.core.params import ParamValidationError
+    from mmlspark_tpu.stages.word2vec import Word2Vec
+    for bad in (dict(epochs=0), dict(batch_size=0), dict(negatives=0),
+                dict(vector_size=0), dict(window=0)):
+        with pytest.raises(ParamValidationError):
+            Word2Vec(**bad)
