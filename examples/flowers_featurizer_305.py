@@ -1,0 +1,88 @@
+"""Example 305 — multi-class ImageFeaturizer pipeline.
+
+Analog of ``305 - Flowers ImageFeaturizer``: featurize a multi-class
+image dataset with a pretrained backbone's cut layers, train a logistic
+regression on the embeddings, and compare against training the same
+classifier on raw pixels — transfer learning must win (reference:
+notebooks/samples/305*.ipynb). No egress: five synthetic "flower"
+classes with class-dependent color/texture statistics.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mmlspark_tpu.core.schema import make_image, mark_image_column
+from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.ml import ComputeModelStatistics, TrainClassifier
+from mmlspark_tpu.models.image_featurizer import ImageFeaturizer
+from mmlspark_tpu.stages.image import UnrollImage
+
+try:
+    from examples.cifar_eval_301 import ensure_repo
+except ImportError:  # run directly: python examples/<name>.py
+    from cifar_eval_301 import ensure_repo
+
+N_CLASSES = 5
+
+
+def make_flowers(n: int, seed: int = 13) -> DataTable:
+    """Class = petal-stripe *frequency*, with random phase, orientation
+    flip, hue, and brightness per image — so a linear model on raw pixels
+    has no fixed positional signal to latch onto, while convolutional
+    features see the texture (the transfer-learning point of notebook
+    305)."""
+    r = np.random.default_rng(seed)
+    yy, xx = np.mgrid[0:32, 0:32].astype(np.float64)
+    rows, labels = [], []
+    for i in range(n):
+        k = i % N_CLASSES
+        freq = (k + 1) * 2 * np.pi / 32.0            # class frequency
+        phase = r.uniform(0, 2 * np.pi)              # nuisance phase
+        axis = yy if r.random() < 0.5 else xx        # nuisance orientation
+        stripes = np.sin(freq * axis + phase)        # [-1, 1]
+        hue = r.uniform(0.4, 1.0, size=3)            # nuisance color
+        base = (110 + 70 * stripes)[..., None] * hue[None, None, :]
+        base += r.normal(scale=12, size=(32, 32, 3)) + r.uniform(-20, 20)
+        rows.append(make_image(f"flower{i}", np.clip(base, 0, 255)))
+        labels.append(k)
+    t = DataTable({"image": rows, "label": np.asarray(labels)})
+    return mark_image_column(t, "image")
+
+
+def run(scale: str = "small", repo_dir: str | None = None) -> dict:
+    n = 300 if scale == "small" else 6000
+    repo = ensure_repo(repo_dir)
+    table = make_flowers(n)
+    split = int(0.75 * n)
+    train = table.take(np.arange(split))
+    test = table.take(np.arange(split, n))
+
+    # transfer learning: pretrained backbone embeddings
+    featurizer = (ImageFeaturizer(output_col="features", cut_output_layers=1,
+                                  minibatch_size=64)
+                  .set_model_from_repo("ResNet_Small", repo=repo))
+    deep_model = TrainClassifier(
+        label_col="label", feature_columns=["features"]).fit(
+        featurizer.transform(train))
+    deep = dict(ComputeModelStatistics().transform(
+        deep_model.transform(featurizer.transform(test))).to_rows()[0])
+
+    # baseline: the same classifier on raw unrolled pixels
+    unroll = UnrollImage(input_col="image", output_col="pixels",
+                         scale=1 / 255.0)
+    raw_model = TrainClassifier(
+        label_col="label", feature_columns=["pixels"]).fit(
+        unroll.transform(train))
+    raw = dict(ComputeModelStatistics().transform(
+        raw_model.transform(unroll.transform(test))).to_rows()[0])
+
+    return {"deep_accuracy": float(deep["accuracy"]),
+            "raw_pixel_accuracy": float(raw["accuracy"]),
+            "n_classes": N_CLASSES, "n_test": len(test)}
+
+
+if __name__ == "__main__":
+    out = run()
+    print({k: (round(v, 3) if isinstance(v, float) else v)
+           for k, v in out.items()})

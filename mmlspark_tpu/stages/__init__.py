@@ -40,9 +40,7 @@ __all__ = [
     "Featurize", "HashingTF", "IDF", "IDFModel", "ImageSetAugmenter",
     "ImageTransformer", "IndexToValue", "MultiColumnAdapter", "NGram",
     "PartitionSample", "RenameColumns", "Repartition", "SelectColumns",
-    "StopWordsRemover", "SummarizeData",
-    "Word2Vec",
-    "Word2VecModel", "TextFeaturizer", "Timer",
+    "StopWordsRemover", "SummarizeData", "TextFeaturizer", "Timer",
     "TimerModel", "Tokenizer", "UnrollImage", "ValueIndexer",
-    "ValueIndexerModel",
+    "ValueIndexerModel", "Word2Vec", "Word2VecModel",
 ]

@@ -43,7 +43,7 @@ def _build_vocab(rows: Sequence, min_count: int,
             counts[t] = counts.get(t, 0) + 1
     vocab = [w for w, c in counts.items() if c >= min_count]
     vocab.sort(key=lambda w: (-counts[w], w))  # frequent first, stable
-    return vocab[:max_vocab] if max_vocab else vocab
+    return vocab[:max_vocab] if max_vocab is not None else vocab
 
 
 def _skipgram_pairs(rows: Sequence, index: dict[str, int], window: int,
@@ -87,7 +87,8 @@ class Word2Vec(Estimator, HasInputCol, HasOutputCol):
     window = Param(default=5, doc="max context window", type_=int,
                    validator=Param.gt(0))
     min_count = Param(default=2, doc="minimum token frequency", type_=int)
-    max_vocab = Param(default=None, doc="cap on vocabulary size", type_=int)
+    max_vocab = Param(default=None, doc="cap on vocabulary size", type_=int,
+                      validator=Param.gt(0))
     negatives = Param(default=5, doc="negative samples per pair", type_=int,
                       validator=Param.gt(0))
     epochs = Param(default=5, doc="passes over the skip-gram pairs",
@@ -201,9 +202,14 @@ class Word2VecModel(Transformer, HasInputCol, HasOutputCol):
                     is_complex=True)
 
     def _index(self) -> dict[str, int]:
-        if getattr(self, "_index_cache", None) is None:
-            self._index_cache = {w: i for i, w in enumerate(self.vocab)}
-        return self._index_cache
+        # cache keyed on vocab identity: set()/copy() replacing the vocab
+        # must not serve the old word→row map against new vectors
+        vocab = self.vocab
+        cached = getattr(self, "_index_cache", None)
+        if cached is None or cached[0] is not vocab:
+            cached = (vocab, {w: i for i, w in enumerate(vocab)})
+            self._index_cache = cached
+        return cached[1]
 
     def transform(self, table: DataTable) -> DataTable:
         index = self._index()

@@ -85,3 +85,11 @@ def test_example_202_word2vec():
     from book_reviews_text_201 import NEGATIVE, POSITIVE
     assert set(out["synonym_probe"]) <= set(POSITIVE + NEGATIVE), out
     assert len(set(out["synonym_probe"]) & set(POSITIVE)) >= 2, out
+
+
+def test_example_305_flowers_featurizer(zoo_repo):
+    import flowers_featurizer_305 as ex
+    out = ex.run("small", repo_dir=zoo_repo)
+    # transfer learning must beat the raw-pixel baseline decisively
+    assert out["deep_accuracy"] > 0.5, out          # chance = 0.2
+    assert out["deep_accuracy"] > 2 * out["raw_pixel_accuracy"], out

@@ -397,3 +397,57 @@ def test_random_table_has_missing_values():
     t = random_table(seed=1, kinds=("numeric", "string"), missing=0.3)
     assert np.isnan(t["numeric"]).any()
     assert any(v is None for v in t["string"])
+
+
+# ---------------------------------------------------------------------------
+# pipeline-level round trips (RoundTripTestBase.testRoundTrip analog,
+# reference: core/test/base/src/main/scala/TestBase.scala:179-256): stages
+# composed into a Pipeline must fit, save/load as an UNFITTED pipeline,
+# save/load as a FITTED PipelineModel, and transform identically.
+# ---------------------------------------------------------------------------
+
+PIPELINES = {
+    "tabular": lambda: [
+        _cls("CleanMissingData")(input_cols=["numeric"],
+                                 output_cols=["numeric"]),
+        _cls("ValueIndexer")(input_col="categorical", output_col="cat_idx"),
+        _cls("AssembleFeatures")(number_of_features=64,
+                                 columns_to_featurize=[
+                                     "numeric", "integer", "cat_idx"]),
+    ],
+    "text": lambda: [
+        _cls("Tokenizer")(input_col="text", output_col="toks2"),
+        _cls("StopWordsRemover")(input_col="toks2", output_col="kept"),
+        _cls("HashingTF")(input_col="kept", output_col="tf2",
+                          num_features=32),
+        _cls("IDF")(input_col="tf2", output_col="tfidf"),
+    ],
+    "word2vec": lambda: [
+        _cls("Tokenizer")(input_col="text", output_col="toks2"),
+        _cls("Word2Vec")(input_col="toks2", output_col="emb",
+                         vector_size=8, epochs=1, min_count=1),
+    ],
+}
+
+
+@pytest.mark.parametrize("name", sorted(PIPELINES))
+def test_pipeline_round_trip(name, tmp_path):
+    from mmlspark_tpu.core.pipeline import Pipeline
+
+    ctx = _ctx(tmp_path)
+    table = _text_table(ctx) if name != "tabular" else _tabular(ctx)
+    pipe = Pipeline(stages=PIPELINES[name]())
+
+    fitted = pipe.fit(table)
+    out = fitted.transform(table)
+
+    # unfitted pipeline round trip → refit → same outputs
+    pipe.save(str(tmp_path / "pipe"))
+    pipe2 = PipelineStage.load(str(tmp_path / "pipe"))
+    out2 = pipe2.fit(table).transform(table)
+    assert_tables_equal(out, out2)
+
+    # fitted model round trip → same outputs without refitting
+    fitted.save(str(tmp_path / "model"))
+    model2 = PipelineStage.load(str(tmp_path / "model"))
+    assert_tables_equal(out, model2.transform(table))

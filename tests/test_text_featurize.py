@@ -297,8 +297,7 @@ class TestWord2Vec:
         t = DataTable({"tokens": [["a", "b"], ["a", "c"]]})
         m = Word2Vec(vector_size=4, min_count=2, epochs=1).fit(t)
         assert m.vocab == ["a"]
-        import pytest as _pytest
-        with _pytest.raises(ValueError, match="min_count"):
+        with pytest.raises(ValueError, match="min_count"):
             Word2Vec(min_count=5).fit(t)
 
 
@@ -309,3 +308,19 @@ def test_word2vec_param_domains():
                 dict(vector_size=0), dict(window=0)):
         with pytest.raises(ParamValidationError):
             Word2Vec(**bad)
+
+
+def test_word2vec_model_copy_with_new_vocab_reindexes():
+    # review finding r3: copy(vocab=..., vectors=...) must not serve the
+    # old word→row map against the new vectors
+    from mmlspark_tpu.stages.word2vec import Word2VecModel
+    v1 = np.eye(3, 4, dtype=np.float32)
+    m1 = Word2VecModel(vocab=["a", "b", "c"], vectors=v1)
+    t = DataTable({"tokens": [["a"]]})
+    np.testing.assert_allclose(m1.transform(t)["features"][0], v1[0])
+    m2 = m1.copy(vocab=["z", "a"], vectors=np.asarray(
+        [[9, 9, 9, 9], [1, 2, 3, 4]], np.float32))
+    np.testing.assert_allclose(m2.transform(t)["features"][0],
+                               [1, 2, 3, 4])
+    syn = m2.find_synonyms("z", 1)
+    assert syn[0][0] == "a"
