@@ -231,3 +231,42 @@ class TestBucketing:
         batches = list(bucket_batches(seqs, 4, bucket_sizes=(128, 16, 64)))
         assert len(batches) == 1
         assert batches[0][0].shape == (1, 16)
+
+
+class TestLongContext:
+    """Round-3: genuinely long sequences through the SP paths — the
+    first-class long-context claim at lengths where a naive [L, L] score
+    matrix would already be the dominant memory term."""
+
+    def test_ring_attention_4k_tokens(self, sp_mesh):
+        # blockwise ring: peak per-device score block is (L/sp)² = 512²,
+        # 64× smaller than the full 4096² matrix the reference's padded
+        # approach would imply
+        q, k, v = qkv(B=1, L=4096, H=2, D=8, seed=5)
+        ref = attention_reference(q, k, v)
+        out = ring_attention(q, k, v, sp_mesh)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=5e-5, atol=5e-5)
+
+    def test_causal_2k_with_padding_mask(self, sp_mesh):
+        r = np.random.default_rng(7)
+        L = 2048
+        q, k, v = qkv(B=2, L=L, H=2, D=8, seed=6)
+        mask = jnp.asarray(np.arange(L)[None, :] <
+                           np.asarray([L, L - 300])[:, None])
+        ref = attention_reference(q, k, v, causal=True, kv_mask=mask)
+        out = ring_attention(q, k, v, sp_mesh, causal=True, kv_mask=mask)
+        # batch 0 is unpadded: compare every query position, including the
+        # final causal ring blocks; batch 1 only over its valid prefix
+        np.testing.assert_allclose(np.asarray(out)[0], np.asarray(ref)[0],
+                                   rtol=5e-5, atol=5e-5)
+        np.testing.assert_allclose(np.asarray(out)[1, :L - 300],
+                                   np.asarray(ref)[1, :L - 300],
+                                   rtol=5e-5, atol=5e-5)
+
+    def test_ulysses_2k_tokens(self, sp_mesh):
+        q, k, v = qkv(B=1, L=2048, H=8, D=8, seed=8)
+        ref = attention_reference(q, k, v)
+        out = ulysses_attention(q, k, v, sp_mesh)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=5e-5, atol=5e-5)

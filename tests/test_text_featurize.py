@@ -305,9 +305,17 @@ def test_word2vec_param_domains():
     from mmlspark_tpu.core.params import ParamValidationError
     from mmlspark_tpu.stages.word2vec import Word2Vec
     for bad in (dict(epochs=0), dict(batch_size=0), dict(negatives=0),
-                dict(vector_size=0), dict(window=0)):
+                dict(vector_size=0), dict(window=0), dict(max_vocab=0),
+                dict(max_vocab=-3)):
         with pytest.raises(ParamValidationError):
             Word2Vec(**bad)
+
+
+def test_word2vec_max_vocab_truncates_to_most_frequent():
+    from mmlspark_tpu.stages.word2vec import Word2Vec
+    t = DataTable({"tokens": [["a", "a", "a", "b", "b", "c"]] * 4})
+    m = Word2Vec(vector_size=4, min_count=1, epochs=1, max_vocab=2).fit(t)
+    assert m.vocab == ["a", "b"]
 
 
 def test_word2vec_model_copy_with_new_vocab_reindexes():
