@@ -20,7 +20,9 @@ from typing import Any, Sequence
 
 import numpy as np
 
-from mmlspark_tpu.data.table import DataTable
+# IMAGE_FIELDS' canonical definition lives next to the Arrow wire format
+# in data.table; re-exported here as the schema-facing name
+from mmlspark_tpu.data.table import DataTable, IMAGE_FIELDS  # noqa: F401
 
 
 class SchemaConstants:
@@ -120,7 +122,6 @@ def is_categorical(table: DataTable, column: str) -> bool:
 
 # ---- image columns (ImageSchema analog) ----
 
-IMAGE_FIELDS = ("path", "height", "width", "channels", "data")
 """An image cell is a dict with these keys: decoded HWC uint8 BGR bytes in
 ``data`` (reference: core/schema/src/main/scala/ImageSchema.scala:12-17 uses
 (path, height, width, type, bytes))."""

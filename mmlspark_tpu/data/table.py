@@ -72,6 +72,71 @@ def _as_column(values: Any) -> np.ndarray:
     return arr
 
 
+# Canonical image-struct contract. core/schema re-exports these — one
+# definition of "image dict" for the whole framework (schema.py imports
+# this module, so the constants must live here to avoid a cycle).
+IMAGE_FIELDS = ("path", "height", "width", "channels", "data")
+K_IMAGE = "is_image"            # column-meta marker for image columns
+# wire format over Arrow: the ImageSchema struct plus 'mode' carrying the
+# numpy dtype so float images round-trip
+_IMAGE_WIRE_FIELDS = {"path", "height", "width", "channels", "mode", "data"}
+
+
+def _looks_like_image_column(col: np.ndarray) -> bool:
+    first = next((v for v in col if v is not None), None)
+    return (isinstance(first, dict)
+            and set(IMAGE_FIELDS) <= set(first.keys()))
+
+
+def _image_structs_to_arrow(name: str, col: np.ndarray) -> Any:
+    import pyarrow as pa
+    paths, hs, ws, cs, modes, blobs = [], [], [], [], [], []
+    mask = []
+    for i, v in enumerate(col):
+        if v is None:
+            mask.append(True)
+            paths.append(None); hs.append(None); ws.append(None)
+            cs.append(None); modes.append(None); blobs.append(None)
+            continue
+        if not (isinstance(v, dict) and set(IMAGE_FIELDS) <= set(v.keys())):
+            raise ValueError(
+                f"image column {name!r} row {i} is not an image struct "
+                f"(need fields {IMAGE_FIELDS}, got {v!r:.120})")
+        mask.append(False)
+        arr = np.ascontiguousarray(np.asarray(v["data"]))
+        h, w, c = int(v["height"]), int(v["width"]), int(v["channels"])
+        if arr.size != h * w * c:
+            raise ValueError(
+                f"image column {name!r} row {i}: data has {arr.size} "
+                f"values, dims say {h}x{w}x{c}")
+        paths.append(v.get("path", ""))
+        hs.append(h)
+        ws.append(w)
+        cs.append(c)
+        modes.append(arr.dtype.str)
+        blobs.append(arr.tobytes())
+    return pa.StructArray.from_arrays(
+        [pa.array(paths, pa.string()), pa.array(hs, pa.int32()),
+         pa.array(ws, pa.int32()), pa.array(cs, pa.int32()),
+         pa.array(modes, pa.string()), pa.array(blobs, pa.binary())],
+        names=["path", "height", "width", "channels", "mode", "data"],
+        mask=pa.array(mask, pa.bool_()))
+
+
+def _image_structs_from_arrow(col: Any) -> list:
+    out = []
+    for v in col.to_pylist():
+        if v is None:
+            out.append(None)
+            continue
+        h, w, c = int(v["height"]), int(v["width"]), int(v["channels"])
+        data = np.frombuffer(v["data"],
+                             np.dtype(v["mode"])).reshape(h, w, c)
+        out.append({"path": v["path"], "height": h, "width": w,
+                    "channels": c, "data": data})
+    return out
+
+
 class DataTable:
     """An ordered mapping column-name → 1-D column, with per-column metadata."""
 
@@ -281,21 +346,46 @@ class DataTable:
     @staticmethod
     def from_arrow(batch: Any, meta: Mapping[str, Mapping[str, Any]] | None = None
                    ) -> "DataTable":
-        """From a pyarrow Table or RecordBatch (the Spark-bridge wire format)."""
+        """From a pyarrow Table or RecordBatch (the Spark-bridge wire format).
+
+        Image-struct columns (the ImageSchema wire shape:
+        path/height/width/channels/mode/data-bytes) rebuild into the
+        in-memory image dicts and the column is marked as an image column.
+        """
+        import pyarrow as pa
         cols: dict[str, Any] = {}
+        image_cols: list[str] = []
         for name in batch.schema.names:
             col = batch.column(name)
+            field_type = batch.schema.field(name).type
+            if (pa.types.is_struct(field_type)
+                    and {f.name for f in field_type} >= _IMAGE_WIRE_FIELDS):
+                cols[name] = _image_structs_from_arrow(col)
+                image_cols.append(name)
+                continue
             try:
                 cols[name] = col.to_numpy(zero_copy_only=False)
             except Exception:
                 cols[name] = col.to_pylist()
-        return DataTable(cols, meta)
+        table = DataTable(cols, meta)
+        for name in image_cols:
+            table = table.with_meta(name, **{K_IMAGE: True})
+        return table
 
     def to_arrow(self) -> Any:
+        """To a pyarrow Table. Image-struct columns serialize as a struct of
+        (path, height, width, channels, mode, data-bytes) — the Arrow form
+        of the reference's ImageSchema (reference:
+        core/schema/src/main/scala/ImageSchema.scala:12-17), so image
+        tables cross the Spark bridge losslessly."""
         import pyarrow as pa
         arrays = {}
         for k, v in self._cols.items():
-            if v.dtype == object:
+            is_image = self.column_meta(k).get(K_IMAGE) or (
+                v.dtype == object and _looks_like_image_column(v))
+            if is_image:
+                arrays[k] = _image_structs_to_arrow(k, v)
+            elif v.dtype == object:
                 arrays[k] = pa.array(list(v))
             else:
                 arrays[k] = pa.array(v)

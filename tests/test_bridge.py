@@ -111,3 +111,87 @@ class TestArrowBatchBridge:
         assert "scored_labels" in table.columns
         acc = (np.asarray(table["scored_labels"]) == y).mean()
         assert acc > 0.95
+
+
+class TestImageWireFormat:
+    """Image-struct columns cross the Arrow boundary losslessly (the
+    ImageSchema wire shape — reference ImageSchema.scala:12-17), so image
+    tables score through the Spark bridge without manual flattening."""
+
+    def test_image_table_round_trips_arrow(self):
+        import pyarrow as pa
+
+        from mmlspark_tpu.core.schema import is_image_column, make_image
+        r = np.random.default_rng(0)
+        rows = [make_image(f"i{k}", r.integers(0, 255, (6, 5, 3)))
+                for k in range(3)] + [None]
+        t = DataTable({"image": rows, "id": np.arange(4)})
+        back = DataTable.from_arrow(t.to_arrow())
+        assert is_image_column(back, "image")
+        assert back["image"][3] is None
+        for a, b in zip(rows[:3], back["image"][:3]):
+            assert a["path"] == b["path"]
+            np.testing.assert_array_equal(np.asarray(a["data"]),
+                                          np.asarray(b["data"]))
+        np.testing.assert_array_equal(back["id"], t["id"])
+
+    def test_float_image_data_round_trips(self):
+        from mmlspark_tpu.core.schema import make_image
+        img = make_image("f", np.zeros((4, 4, 3)))
+        img["data"] = np.linspace(0, 1, 48).reshape(4, 4, 3
+                                                    ).astype(np.float32)
+        t = DataTable({"image": [img]})
+        back = DataTable.from_arrow(t.to_arrow())
+        got = np.asarray(back["image"][0]["data"])
+        assert got.dtype == np.float32
+        np.testing.assert_allclose(got, img["data"])
+
+    def test_bridge_scores_image_struct_table(self, tmp_path):
+        from mmlspark_tpu.core.schema import make_image, mark_image_column
+        from mmlspark_tpu.models.zoo import get_model
+
+        r = np.random.default_rng(1)
+        t = DataTable({"image": [make_image(f"x{k}",
+                                            r.integers(0, 255, (32, 32, 3)))
+                                 for k in range(10)]})
+        t = mark_image_column(t, "image")
+        bundle = get_model("ConvNet_CIFAR10", widths=(4, 8), dense_width=16)
+        jm = JaxModel(model=bundle, input_col="image", output_col="scores",
+                      minibatch_size=4)
+        fn = make_map_in_arrow_fn(jm)
+        out = DataTable.from_arrow(
+            pa.Table.from_batches(list(fn(stream_table(t, 3)))))
+        direct = jm.transform(t)
+        np.testing.assert_allclose(
+            np.stack(list(out["scores"])),
+            np.stack(list(direct["scores"])), rtol=1e-4, atol=1e-4)
+
+    def test_null_first_row_still_marked_image(self):
+        # from_arrow must mark via the canonical meta key, not rely on
+        # structurally sniffing row 0 (which can be null)
+        from mmlspark_tpu.core.schema import is_image_column, make_image
+        r = np.random.default_rng(2)
+        rows = [None, make_image("a", r.integers(0, 255, (4, 4, 3)))]
+        t = DataTable({"image": rows})
+        back = DataTable.from_arrow(t.to_arrow())
+        assert is_image_column(back, "image")
+        assert back["image"][0] is None
+
+    def test_malformed_image_rows_raise_clearly(self):
+        from mmlspark_tpu.core.schema import make_image, mark_image_column
+        img = make_image("a", np.zeros((4, 4, 3)))
+        t = DataTable({"image": [img, {"path": "not-an-image"}]})
+        t = mark_image_column(t, "image")
+        with pytest.raises(ValueError, match="not an image struct"):
+            t.to_arrow()
+        bad = make_image("b", np.zeros((4, 4, 3)))
+        bad["height"] = 5  # dims lie about the buffer
+        t2 = mark_image_column(DataTable({"image": [bad]}), "image")
+        with pytest.raises(ValueError, match="dims say"):
+            t2.to_arrow()
+
+    def test_generic_dict_column_still_serializes(self):
+        # non-image dicts keep the old generic path
+        t = DataTable({"d": [{"a": 1}, {"a": 2}]})
+        back = DataTable.from_arrow(t.to_arrow())
+        assert list(back["d"]) == [{"a": 1}, {"a": 2}]

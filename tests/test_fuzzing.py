@@ -337,12 +337,15 @@ def _ctx(tmp_path, seed=7):
     return {"tmp": tmp_path, "seed": seed}
 
 
+@pytest.mark.parametrize("seed", [7, 19])
 @pytest.mark.parametrize("name", _ALL_NAMES)
-def test_fuzz_stage(name, tmp_path):
-    """Construct → run on random data → save → load → identical re-run."""
+def test_fuzz_stage(name, seed, tmp_path):
+    """Construct → run on random data → save → load → identical re-run.
+    Two seeds: random_table draws a different schema subset and different
+    edge content (missing rates, category counts) per seed."""
     if name in SKIP:
         pytest.skip(SKIP[name])
-    ctx = _ctx(tmp_path)
+    ctx = _ctx(tmp_path, seed=seed)
     via = _MODEL_VIA.get(name)
     if via is not None:
         spec = CONFIG[via]
