@@ -20,9 +20,11 @@ from typing import Any, Sequence
 
 import numpy as np
 
-# IMAGE_FIELDS' canonical definition lives next to the Arrow wire format
-# in data.table; re-exported here as the schema-facing name
-from mmlspark_tpu.data.table import DataTable, IMAGE_FIELDS  # noqa: F401
+# the image-struct contract's canonical definitions live next to the Arrow
+# wire format in data.table; re-exported here as the schema-facing names
+from mmlspark_tpu.data.table import (  # noqa: F401
+    DataTable, IMAGE_FIELDS, K_IMAGE as _K_IMAGE,
+)
 
 
 class SchemaConstants:
@@ -50,7 +52,7 @@ class SchemaConstants:
     K_SCORE_VALUE_KIND = "score_value_kind"
     K_CATEGORICAL_LEVELS = "categorical_levels"
     K_IS_CATEGORICAL = "is_categorical"
-    K_IMAGE = "is_image"
+    K_IMAGE = _K_IMAGE  # canonical literal lives in data.table
     K_VECTOR_SIZE = "vector_size"
 
 
@@ -121,10 +123,10 @@ def is_categorical(table: DataTable, column: str) -> bool:
 
 
 # ---- image columns (ImageSchema analog) ----
-
-"""An image cell is a dict with these keys: decoded HWC uint8 BGR bytes in
-``data`` (reference: core/schema/src/main/scala/ImageSchema.scala:12-17 uses
-(path, height, width, type, bytes))."""
+# An image cell is a dict with the IMAGE_FIELDS keys (canonical definition
+# in data.table, next to the Arrow wire format): decoded HWC uint8 BGR
+# bytes in ``data`` (reference: core/schema/src/main/scala/
+# ImageSchema.scala:12-17 uses (path, height, width, type, bytes)).
 
 
 def make_image(path: str, array_hwc: np.ndarray) -> dict[str, Any]:

@@ -83,9 +83,20 @@ _IMAGE_WIRE_FIELDS = {"path", "height", "width", "channels", "mode", "data"}
 
 
 def _looks_like_image_column(col: np.ndarray) -> bool:
-    first = next((v for v in col if v is not None), None)
-    return (isinstance(first, dict)
-            and set(IMAGE_FIELDS) <= set(first.keys()))
+    """Unmarked-column fallback: EVERY non-None row must be a dict with
+    exactly the image fields. Subset/first-row sniffing would hijack
+    generic dict columns that merely share key names (and silently drop
+    their extra keys on the wire); columns marked via ``K_IMAGE`` meta
+    skip this and get strict per-row validation instead."""
+    want = set(IMAGE_FIELDS)
+    seen = False
+    for v in col:
+        if v is None:
+            continue
+        if not (isinstance(v, dict) and set(v.keys()) == want):
+            return False
+        seen = True
+    return seen
 
 
 def _image_structs_to_arrow(name: str, col: np.ndarray) -> Any:
@@ -130,8 +141,11 @@ def _image_structs_from_arrow(col: Any) -> list:
             out.append(None)
             continue
         h, w, c = int(v["height"]), int(v["width"]), int(v["channels"])
+        # copy: frombuffer over bytes is read-only, but image dicts are
+        # writable everywhere else (in-place normalization must not crash
+        # only on tables that crossed the bridge)
         data = np.frombuffer(v["data"],
-                             np.dtype(v["mode"])).reshape(h, w, c)
+                             np.dtype(v["mode"])).reshape(h, w, c).copy()
         out.append({"path": v["path"], "height": h, "width": w,
                     "channels": c, "data": data})
     return out

@@ -195,3 +195,21 @@ class TestImageWireFormat:
         t = DataTable({"d": [{"a": 1}, {"a": 2}]})
         back = DataTable.from_arrow(t.to_arrow())
         assert list(back["d"]) == [{"a": 1}, {"a": 2}]
+
+    def test_unmarked_dict_column_with_extra_keys_stays_generic(self):
+        # dicts sharing image key names PLUS extras must not be hijacked
+        # into the wire struct (their extra keys would silently vanish)
+        from mmlspark_tpu.core.schema import make_image
+        img = dict(make_image("a", np.zeros((2, 2, 3))), label=7)
+        img["data"] = img["data"].tolist()  # keep it arrow-serializable
+        t = DataTable({"d": [img]})
+        back = DataTable.from_arrow(t.to_arrow())
+        assert back["d"][0]["label"] == 7  # extra key survived
+
+    def test_rebuilt_image_data_is_writable(self):
+        from mmlspark_tpu.core.schema import make_image
+        t = DataTable({"image": [make_image("a", np.ones((3, 3, 3)))]})
+        back = DataTable.from_arrow(t.to_arrow())
+        arr = back["image"][0]["data"]
+        arr[0, 0, 0] = 42  # in-place normalization must not crash
+        assert arr[0, 0, 0] == 42
