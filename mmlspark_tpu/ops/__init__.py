@@ -6,6 +6,14 @@ hand-written Pallas kernels, with jnp reference implementations for
 equivalence tests and non-TPU backends.
 """
 
+from mmlspark_tpu.ops.augment import (
+    augment_batch, random_brightness, random_contrast, random_crop,
+    random_flip_lr, random_flip_ud,
+)
 from mmlspark_tpu.ops.group_norm import group_norm, group_norm_reference
 
-__all__ = ["group_norm", "group_norm_reference"]
+__all__ = [
+    "augment_batch", "group_norm", "group_norm_reference",
+    "random_brightness", "random_contrast", "random_crop",
+    "random_flip_lr", "random_flip_ud",
+]

@@ -1,0 +1,110 @@
+"""Device-side batched image augmentation (jit-compiled, per-sample PRNG).
+
+The reference augments by materializing flipped copies host-side
+(reference: image-featurizer/src/main/scala/ImageSetAugmenter.scala:38-61
+unions a LR-flipped DataFrame); `stages.image.ImageSetAugmenter` mirrors
+that for parity. On TPU the profitable form (SURVEY §2.5 item 4) is
+augmentation INSIDE the compiled train step: the batch is already in HBM,
+the ops are elementwise/gather work the VPU hides under the matmuls, and
+no extra host↔device traffic or dataset copies exist.
+
+All functions take a PRNG key and an NHWC batch and are safe under
+``jax.jit``/``shard_map`` (fixed shapes, no host control flow)::
+
+    def train_step(state, key, x, y):
+        x = augment_batch(key, x, flip_lr=True, crop_pad=4,
+                          brightness=0.1)
+        ...
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def random_flip_lr(key: jax.Array, batch: jnp.ndarray) -> jnp.ndarray:
+    """Flip each sample left-right with probability 0.5."""
+    coin = jax.random.bernoulli(key, 0.5, (batch.shape[0],))
+    return jnp.where(coin[:, None, None, None], batch[:, :, ::-1, :], batch)
+
+
+def random_flip_ud(key: jax.Array, batch: jnp.ndarray) -> jnp.ndarray:
+    """Flip each sample up-down with probability 0.5."""
+    coin = jax.random.bernoulli(key, 0.5, (batch.shape[0],))
+    return jnp.where(coin[:, None, None, None], batch[:, ::-1, :, :], batch)
+
+
+def _photometric(batch: jnp.ndarray, fn) -> jnp.ndarray:
+    """Run a photometric op in float and cast back. Integer batches
+    (uint8 pixels) round + clip to the dtype's range — computing in the
+    integer dtype would wrap negative shifts modularly and truncate
+    fractional contrast factors to 0/1."""
+    if jnp.issubdtype(batch.dtype, jnp.integer):
+        info = jnp.iinfo(batch.dtype)
+        out = fn(batch.astype(jnp.float32))
+        return jnp.clip(jnp.round(out), info.min, info.max
+                        ).astype(batch.dtype)
+    return fn(batch).astype(batch.dtype)
+
+
+def random_brightness(key: jax.Array, batch: jnp.ndarray,
+                      delta: float) -> jnp.ndarray:
+    """Add a per-sample uniform offset in [-delta, delta] (values in the
+    batch's own scale — pass delta≈0.1 for [0,1] inputs, ≈25 for uint8
+    ranges; integer batches round + clip to the dtype range)."""
+    shift = jax.random.uniform(key, (batch.shape[0], 1, 1, 1),
+                               minval=-delta, maxval=delta)
+    return _photometric(batch, lambda b: b + shift)
+
+
+def random_contrast(key: jax.Array, batch: jnp.ndarray,
+                    lo: float = 0.8, hi: float = 1.2) -> jnp.ndarray:
+    """Scale each sample's deviation from its own mean by U[lo, hi]."""
+    factor = jax.random.uniform(key, (batch.shape[0], 1, 1, 1),
+                                minval=lo, maxval=hi)
+
+    def op(b):
+        mean = b.mean(axis=(1, 2, 3), keepdims=True)
+        return mean + (b - mean) * factor
+
+    return _photometric(batch, op)
+
+
+def random_crop(key: jax.Array, batch: jnp.ndarray,
+                pad: int) -> jnp.ndarray:
+    """Pad ``pad`` pixels on each spatial side (reflect) and take a random
+    H×W crop per sample — the standard CIFAR augmentation, as one gather.
+    """
+    n, h, w, c = batch.shape
+    padded = jnp.pad(batch, ((0, 0), (pad, pad), (pad, pad), (0, 0)),
+                     mode="reflect")
+    ky, kx = jax.random.split(key)
+    oy = jax.random.randint(ky, (n,), 0, 2 * pad + 1)
+    ox = jax.random.randint(kx, (n,), 0, 2 * pad + 1)
+
+    def crop_one(img, y0, x0):
+        return jax.lax.dynamic_slice(img, (y0, x0, 0), (h, w, c))
+
+    return jax.vmap(crop_one)(padded, oy, ox)
+
+
+def augment_batch(key: jax.Array, batch: jnp.ndarray,
+                  flip_lr: bool = True, flip_ud: bool = False,
+                  crop_pad: int = 0, brightness: float = 0.0,
+                  contrast: tuple[float, float] | None = None
+                  ) -> jnp.ndarray:
+    """Compose the enabled augmentations (static config → one compiled
+    program; per-sample randomness folds out of the single key)."""
+    keys = jax.random.split(key, 5)
+    if crop_pad:
+        batch = random_crop(keys[0], batch, crop_pad)
+    if flip_lr:
+        batch = random_flip_lr(keys[1], batch)
+    if flip_ud:
+        batch = random_flip_ud(keys[2], batch)
+    if brightness:
+        batch = random_brightness(keys[3], batch, brightness)
+    if contrast is not None:
+        batch = random_contrast(keys[4], batch, *contrast)
+    return batch

@@ -233,7 +233,10 @@ class ImageSetAugmenter(Transformer, HasInputCol, HasOutputCol):
     """Dataset augmentation by unioning flipped copies.
 
     Reference: ImageSetAugmenter.scala:38-61 — emits the original rows plus
-    left-right (and optionally up-down) flipped copies.
+    left-right (and optionally up-down) flipped copies. For training loops
+    prefer :func:`mmlspark_tpu.ops.augment_batch` — the same augmentations
+    applied INSIDE the compiled step on device, with per-sample randomness
+    and no dataset copies.
     """
 
     input_col = Param(default="image", doc="input image column", type_=str)

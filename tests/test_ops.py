@@ -118,3 +118,84 @@ def test_unknown_gn_impl_raises():
     m = resnet18_thin(num_classes=2, gn_impl="Pallas")  # typo'd case
     with pytest.raises(ValueError, match="unknown gn_impl"):
         m.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+
+
+class TestDeviceAugment:
+    """Device-side batched augmentation (ops/augment.py): jit-safe,
+    per-sample randomness, exact semantics (SURVEY §2.5 item 4 — the
+    in-step counterpart to the host-side ImageSetAugmenter)."""
+
+    @staticmethod
+    def batch(n=8, h=8, w=6, seed=0):
+        r = np.random.default_rng(seed)
+        return jnp.asarray(r.normal(size=(n, h, w, 3)).astype(np.float32))
+
+    def test_flip_semantics_and_per_sample_independence(self):
+        from mmlspark_tpu.ops import random_flip_lr
+        x = self.batch(64)
+        out = jax.jit(random_flip_lr)(jax.random.PRNGKey(0), x)
+        flipped = np.asarray(out) == np.asarray(x[:, :, ::-1, :])
+        kept = np.asarray(out) == np.asarray(x)
+        per_sample_flip = flipped.all(axis=(1, 2, 3))
+        per_sample_keep = kept.all(axis=(1, 2, 3))
+        # every sample is exactly one of the two, and both occur
+        assert (per_sample_flip | per_sample_keep).all()
+        assert per_sample_flip.any() and per_sample_keep.any()
+
+    def test_crop_matches_manual_slice(self):
+        from mmlspark_tpu.ops import random_crop
+        x = self.batch(4, h=8, w=8)
+        out = jax.jit(lambda k, b: random_crop(k, b, 2))(
+            jax.random.PRNGKey(3), x)
+        assert out.shape == x.shape
+        # each crop must appear verbatim inside the reflect-padded image
+        padded = np.pad(np.asarray(x), ((0, 0), (2, 2), (2, 2), (0, 0)),
+                        mode="reflect")
+        for i in range(4):
+            found = any(
+                np.array_equal(padded[i, y:y + 8, xo:xo + 8], out[i])
+                for y in range(5) for xo in range(5))
+            assert found, f"crop {i} not a valid window"
+
+    def test_brightness_and_contrast_bounds(self):
+        from mmlspark_tpu.ops import random_brightness, random_contrast
+        x = self.batch(16)
+        out = random_brightness(jax.random.PRNGKey(1), x, 0.5)
+        shift = (np.asarray(out) - np.asarray(x)).reshape(16, -1)
+        assert (np.ptp(shift, axis=1) < 1e-5).all()  # per-sample constant
+        assert (np.abs(shift[:, 0]) <= 0.5).all()
+        out2 = random_contrast(jax.random.PRNGKey(2), x, 0.5, 1.5)
+        m_in = np.asarray(x).mean(axis=(1, 2, 3))
+        m_out = np.asarray(out2).mean(axis=(1, 2, 3))
+        np.testing.assert_allclose(m_out, m_in, atol=1e-5)  # mean preserved
+
+    def test_augment_batch_composes_under_jit(self):
+        from mmlspark_tpu.ops import augment_batch
+        x = self.batch(8)
+        fn = jax.jit(lambda k, b: augment_batch(
+            k, b, flip_lr=True, crop_pad=2, brightness=0.1,
+            contrast=(0.9, 1.1)))
+        a = fn(jax.random.PRNGKey(0), x)
+        b = fn(jax.random.PRNGKey(0), x)
+        c = fn(jax.random.PRNGKey(1), x)
+        assert a.shape == x.shape
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))  # keyed
+        assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+    def test_uint8_batches_clip_instead_of_wrapping(self):
+        # review finding r3: integer pixels must not wrap modularly on a
+        # negative brightness draw nor truncate contrast factors to 0/1
+        from mmlspark_tpu.ops import random_brightness, random_contrast
+        r = np.random.default_rng(5)
+        x = jnp.asarray(r.integers(0, 255, (32, 6, 6, 3)), jnp.uint8)
+        out = random_brightness(jax.random.PRNGKey(0), x, 25.0)
+        assert out.dtype == jnp.uint8
+        diff = np.asarray(out, np.int32) - np.asarray(x, np.int32)
+        # shifts stay bounded (no modular wrap to ~246)
+        assert np.abs(diff).max() <= 26
+        assert (diff < 0).any() and (diff > 0).any()  # darken AND brighten
+        out2 = random_contrast(jax.random.PRNGKey(1), x, 0.8, 1.2)
+        d2 = np.asarray(out2, np.int32) - np.asarray(x, np.int32)
+        # intermediate contrast jitter occurs (not all samples 0-or-mean)
+        changed = np.abs(d2).reshape(32, -1).max(axis=1)
+        assert ((changed > 0) & (changed < 100)).any()
