@@ -372,8 +372,12 @@ class DataTable:
         for name in batch.schema.names:
             col = batch.column(name)
             field_type = batch.schema.field(name).type
+            # exact field-set match, mirroring _looks_like_image_column on
+            # the serialize side — a non-image struct that happens to carry
+            # these six names PLUS extras must not be rebuilt as images
+            # (which would silently drop its extra fields)
             if (pa.types.is_struct(field_type)
-                    and {f.name for f in field_type} >= _IMAGE_WIRE_FIELDS):
+                    and {f.name for f in field_type} == _IMAGE_WIRE_FIELDS):
                 cols[name] = _image_structs_from_arrow(col)
                 image_cols.append(name)
                 continue

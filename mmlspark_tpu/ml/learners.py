@@ -143,8 +143,9 @@ class LinearRegression(Learner):
     reg_param = Param(default=1e-6, doc="ridge regularization", type_=float)
 
     def fit_arrays(self, x, y, num_classes=None):
-        # closed-form ridge: (X'X + λI)^-1 X'y — one MXU matmul pair; no
-        # iterative loop needed at featurized dims
+        # closed-form ridge: (X'X + λI)^-1 X'y, solved host-side in float64
+        # — at featurized dims the normal-equations solve is cheap enough
+        # that it never needs the device (and f64 beats bf16 conditioning)
         x64 = np.column_stack([x.astype(np.float64),
                                np.ones(len(x))])
         a = x64.T @ x64 + self.reg_param * np.eye(x64.shape[1])

@@ -125,6 +125,13 @@ class JaxModel(Transformer, HasInputCol, HasOutputCol):
         doc="inference mesh layout (MeshSpec/dict); None = data parallelism "
             "over every local device; an explicit spec smaller than the "
             "host's device count uses a prefix of the local devices")
+    max_inflight = Param(
+        default=8, type_=int, validator=Param.gt(1),
+        doc="max minibatch outputs resident on device at once during "
+            "transform(); older outputs are fetched to host as newer "
+            "batches dispatch, bounding HBM use on very large tables "
+            "while keeping the async upload/compute/fetch overlap. "
+            "Minimum 2: a window of 1 would serialize fetch with compute")
 
     def __getstate__(self):
         # jitted closures and device arrays don't pickle; drop on serialize
@@ -239,18 +246,26 @@ class JaxModel(Transformer, HasInputCol, HasOutputCol):
             # minibatch must divide over the data axes: round UP to a dp
             # multiple (padding covers the excess) so every chip gets rows
             size = -(-min(size, len(batch)) // dp) * dp
-            outs = []
-            valids = []
+            from collections import deque
+            window: deque = deque()
+            host = []
+            inflight = int(self.max_inflight)
             # three-stage pipeline via async dispatch: upload of batch i+1
             # and device→host copy of batch i-1 both overlap compute of
             # batch i (copy_to_host_async issues the D2H without blocking) —
-            # wall clock ≈ max(H2D, compute, D2H), not their sum
+            # wall clock ≈ max(H2D, compute, D2H), not their sum. The
+            # deque caps device-resident outputs (a full table of logits
+            # would otherwise sit in HBM until the final fetch)
             for chunk, valid in minibatches(batch, size):
                 out = fn(dev_params, jax.device_put(chunk, data))
                 out.copy_to_host_async()
-                outs.append(out)
-                valids.append(valid)
-            host = [np.asarray(o)[:v] for o, v in zip(outs, valids)]
+                window.append((out, valid))
+                if len(window) > inflight:
+                    o, v = window.popleft()
+                    host.append(np.asarray(o)[:v])
+            while window:
+                o, v = window.popleft()
+                host.append(np.asarray(o)[:v])
             result = np.concatenate(host) if len(host) > 1 else host[0]
         if result.ndim == 1:
             out_col: Any = result

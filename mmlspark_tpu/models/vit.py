@@ -53,6 +53,10 @@ class ViT(nn.Module):
     heads: int = 12
     mlp_dim: int = 3072
     dtype: Any = jnp.bfloat16
+    # rematerialize each encoder block on the backward pass: activation HBM
+    # drops from O(depth) block outputs to O(1), buying larger fine-tune
+    # batches at ~1/3 extra forward FLOPs (jax.checkpoint semantics)
+    remat: bool = False
 
     OUTPUT_NAMES = ("features", "logits")
 
@@ -70,9 +74,10 @@ class ViT(nn.Module):
         pos = self.param("pos_embed", nn.initializers.normal(0.02),
                          (h * w, self.dim))
         x = x + pos[None].astype(self.dtype)
+        block_cls = nn.remat(EncoderBlock) if self.remat else EncoderBlock
         for i in range(self.depth):
-            x = EncoderBlock(self.dim, self.heads, self.mlp_dim,
-                             dtype=self.dtype, name=f"block{i}")(x)
+            x = block_cls(self.dim, self.heads, self.mlp_dim,
+                          dtype=self.dtype, name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         x = jnp.mean(x, axis=1)  # GAP over patches
         features = x.astype(jnp.float32)
@@ -83,12 +88,13 @@ class ViT(nn.Module):
         return logits.astype(jnp.float32)
 
 
-def vit_b16(num_classes: int = 1000, dtype: Any = jnp.bfloat16) -> ViT:
-    return ViT(num_classes=num_classes, dtype=dtype)
+def vit_b16(num_classes: int = 1000, dtype: Any = jnp.bfloat16,
+            **kw: Any) -> ViT:
+    return ViT(num_classes=num_classes, dtype=dtype, **kw)
 
 
 def vit_tiny(num_classes: int = 10, image_patch: int = 8,
-             dtype: Any = jnp.float32) -> ViT:
+             dtype: Any = jnp.float32, **kw: Any) -> ViT:
     """Small same-class config for tests/CI."""
     return ViT(num_classes=num_classes, patch=image_patch, dim=64, depth=2,
-               heads=4, mlp_dim=128, dtype=dtype)
+               heads=4, mlp_dim=128, dtype=dtype, **kw)

@@ -138,11 +138,19 @@ def unroll(hwc: np.ndarray, to_rgb: bool = False, scale: float = 1.0,
 
     The UnrollImage hot loop (reference: image-transformer/src/main/scala/
     UnrollImage.scala:18-42 iterates pixel-by-pixel in Scala); here one C++
-    pass, or a vectorized NumPy fallback.
+    pass, or a vectorized NumPy fallback. Float images (a legitimate wire
+    dtype — see the image mode field in data/table.py) are processed in
+    float32 host-side rather than silently truncated to uint8.
     """
-    hwc = np.ascontiguousarray(hwc, dtype=np.uint8)
+    hwc = np.asarray(hwc)
     if hwc.ndim == 2:
         hwc = hwc[:, :, None]
+    if hwc.dtype != np.uint8:
+        x = hwc.astype(np.float32, copy=False)
+        if to_rgb and x.shape[2] == 3:
+            x = x[:, :, ::-1]
+        return np.transpose(x, (2, 0, 1)).astype(np.float32) * scale + offset
+    hwc = np.ascontiguousarray(hwc)
     h, w, c = hwc.shape
     lib = _load()
     if lib is None:
@@ -159,8 +167,18 @@ def unroll(hwc: np.ndarray, to_rgb: bool = False, scale: float = 1.0,
 
 def unroll_batch(batch_hwc: np.ndarray, to_rgb: bool = False,
                  scale: float = 1.0, offset: float = 0.0) -> np.ndarray:
-    """[N,H,W,C] uint8 → [N,C,H,W] float32 in one native call."""
-    batch_hwc = np.ascontiguousarray(batch_hwc, dtype=np.uint8)
+    """[N,H,W,C] uint8 → [N,C,H,W] float32 in one native call.
+
+    Float batches stay float (vectorized host path) — no silent uint8
+    truncation of legitimate float image columns."""
+    batch_hwc = np.asarray(batch_hwc)
+    if batch_hwc.dtype != np.uint8:
+        x = batch_hwc.astype(np.float32, copy=False)
+        if to_rgb and x.shape[-1] == 3:
+            x = x[..., ::-1]
+        return (np.transpose(x, (0, 3, 1, 2)).astype(np.float32) * scale
+                + offset)
+    batch_hwc = np.ascontiguousarray(batch_hwc)
     n, h, w, c = batch_hwc.shape
     lib = _load()
     if lib is None:

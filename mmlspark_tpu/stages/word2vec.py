@@ -34,7 +34,8 @@ _log = get_logger(__name__)
 
 
 def _build_vocab(rows: Sequence, min_count: int,
-                 max_vocab: int | None) -> list[str]:
+                 max_vocab: int | None) -> tuple[list[str], np.ndarray]:
+    """Vocabulary (frequent-first) plus index-aligned corpus counts."""
     counts: dict[str, int] = {}
     for toks in rows:
         if is_missing(toks):
@@ -43,7 +44,9 @@ def _build_vocab(rows: Sequence, min_count: int,
             counts[t] = counts.get(t, 0) + 1
     vocab = [w for w, c in counts.items() if c >= min_count]
     vocab.sort(key=lambda w: (-counts[w], w))  # frequent first, stable
-    return vocab[:max_vocab] if max_vocab is not None else vocab
+    if max_vocab is not None:
+        vocab = vocab[:max_vocab]
+    return vocab, np.asarray([counts[w] for w in vocab], np.float64)
 
 
 def _skipgram_pairs(rows: Sequence, index: dict[str, int], window: int,
@@ -105,7 +108,7 @@ class Word2Vec(Estimator, HasInputCol, HasOutputCol):
         import optax
 
         rows = table[self.input_col]
-        vocab = _build_vocab(rows, self.min_count, self.max_vocab)
+        vocab, counts = _build_vocab(rows, self.min_count, self.max_vocab)
         if not vocab:
             raise ValueError(
                 f"Word2Vec: no token appears >= min_count={self.min_count} "
@@ -124,6 +127,11 @@ class Word2Vec(Estimator, HasInputCol, HasOutputCol):
         tx = optax.adam(self.learning_rate)
         opt = tx.init(params)
         neg = self.negatives
+        # negatives follow the unigram^0.75 distribution (word2vec's noise
+        # distribution, same as Spark's Word2Vec) — host-built CDF once,
+        # device-sampled via searchsorted on uniform draws
+        noise = counts ** 0.75
+        noise_cdf = jnp.asarray(np.cumsum(noise) / noise.sum(), jnp.float32)
 
         def step(params, opt, centers, contexts, w, key):
             def loss_fn(p):
@@ -131,11 +139,16 @@ class Word2Vec(Estimator, HasInputCol, HasOutputCol):
                 co = p["out"][contexts]                  # [B, D]
                 pos = jax.nn.log_sigmoid(
                     jnp.sum(ci * co, axis=-1))           # [B]
-                nids = jax.random.randint(key, (centers.shape[0], neg),
-                                          0, v)
+                u = jax.random.uniform(key, (centers.shape[0], neg))
+                nids = jnp.searchsorted(noise_cdf, u).astype(jnp.int32)
+                nids = jnp.minimum(nids, v - 1)
                 nv = p["out"][nids]                      # [B, neg, D]
-                negl = jax.nn.log_sigmoid(
-                    -jnp.einsum("bd,bnd->bn", ci, nv)).sum(axis=-1)
+                # a negative that collides with the true context would push
+                # the pair apart with one hand while pos pulls it together
+                # with the other — zero those terms out
+                ok = (nids != contexts[:, None]).astype(jnp.float32)
+                negl = (jax.nn.log_sigmoid(
+                    -jnp.einsum("bd,bnd->bn", ci, nv)) * ok).sum(axis=-1)
                 per = -(pos + negl)
                 return (per * w).sum() / jnp.maximum(w.sum(), 1.0)
 
