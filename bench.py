@@ -59,13 +59,18 @@ def compiled_flops(jitted_fn, *args) -> float | None:
         return None
 
 
-def _bench_loop(run_once, passes: int = 3, steps: int = 10) -> float:
+def _bench_loop(run_once, passes: int = 3, steps: int = 30) -> float:
     """Best-of-N timed windows; returns seconds per call.
 
     The window ends on a host fetch of a value data-dependent on the LAST
     call — block_until_ready is not a reliable barrier through
     remote-device tunnels, so async dispatch could otherwise end the clock
-    before the compute finishes."""
+    before the compute finishes. The fetch itself costs a ~50-130 ms
+    round-trip through the tunnel regardless of size (PERF_NOTES round 2),
+    so short windows fold RTT/steps into every per-step number — 10-step
+    windows inflated the ViT step by ~5-13 ms/step (round 4). 30 steps
+    bounds the artifact at ~2-4 ms while keeping the window short enough
+    for best-of-3 drift rejection."""
     import jax
     import jax.numpy as jnp
     best = None
@@ -141,12 +146,15 @@ def bench_flagship_models(rng, n_dev: int, peak: float | None) -> dict:
         bundle = get_model("ViT_B16", num_classes=10)
         module = bundle.module
         batch = 64
+        # master-free bf16 fine-tune (param_dtype) + momentum: the
+        # measured round-4 winning config (PERF_NOTES) — remat and larger
+        # batches both LOSE on this chip
         cfg = TrainConfig(batch_size=batch, epochs=1, optimizer="momentum",
-                          learning_rate=1e-3, log_every=10**9)
+                          learning_rate=1e-3, log_every=10**9,
+                          param_dtype="bfloat16")
         trainer = Trainer(module, cfg)
         trainer.state = trainer.init_state((224, 224, 3))
-        from mmlspark_tpu.parallel.mesh import batch_sharding
-        data = batch_sharding(trainer.mesh)
+        data = trainer.data_target()
         xb = jax.device_put(rng.normal(size=(batch, 224, 224, 3)
                                        ).astype(np.float32), data)
         yb = jax.device_put(rng.integers(0, 10, batch), data)
@@ -195,9 +203,9 @@ def main() -> None:
     trainer.state = trainer.init_state(x.shape[1:])
     # batches must be committed to the dp sharding: the jit infers shardings
     # from its args, so an uncommitted numpy batch would replicate (each chip
-    # redundantly computing the full batch) and skew per-chip throughput
-    from mmlspark_tpu.parallel.mesh import batch_sharding
-    data = batch_sharding(trainer.mesh)
+    # redundantly computing the full batch) and skew per-chip throughput.
+    # (On a 1-device mesh data_target is the bare device — plain transfers.)
+    data = trainer.data_target()
     x = jax.device_put(x, data)
     y = jax.device_put(y, data)
     # warmup/compile; the scalar fetch (not block_until_ready, which is not
@@ -231,11 +239,37 @@ def main() -> None:
         mfu = steps * step_flops / dt / (peak * n_dev)
         vs_baseline = round(mfu / 0.60, 4)
 
+    # transfer calibration: the inference/bridge numbers are dominated by
+    # the host→device link (through the driver's tunnel its incompressible
+    # bandwidth swings run-to-run by >2x — r2 measured 14.8k img/s against
+    # r3's 6.9k with byte-identical hot-path code). Measuring the link in
+    # the same process makes every round's number self-attributing:
+    # compute-vs-transfer splits cleanly instead of reading as a code
+    # regression. (PERF_NOTES round 4.)
+    tunnel_mb_s = None
+    try:
+        import jax
+        import jax.numpy as jnp
+        payload = rng.integers(0, 256, size=24 << 20).astype(np.uint8)
+        fetch = jax.jit(lambda a: jnp.sum(a.astype(jnp.uint32)))
+        dev0 = jax.devices()[0]
+        int(fetch(jax.device_put(payload[: 1 << 16], dev0)))  # warm
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            int(fetch(jax.device_put(payload, dev0)))
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        tunnel_mb_s = round(len(payload) / best / 2**20, 1)
+    except Exception as e:
+        tunnel_mb_s = f"error: {e}"
+
     # second BASELINE.json metric: Spark→TPU batch p50 latency through the
     # Arrow offload bridge (partition → padded device batch → scored rows),
     # plus raw batched-inference throughput (notebook-301 scoring path)
     bridge_p50 = None
     infer_ips = None
+    infer_compute_ips = None
     table = None
     jm = None
     try:
@@ -261,6 +295,25 @@ def main() -> None:
         infer_ips = round(n_inf / infer_dt / n_dev, 1)
     except Exception as e:  # best-effort metric; label failures accurately
         infer_ips = f"error: {e}"
+
+    try:
+        if jm is None or table is None:
+            raise RuntimeError("inference setup failed")
+        # compute-only companion number: the same compiled forward with the
+        # batch already device-resident. Tunnel-independent, so a drop in
+        # infer_ips with a steady infer_compute_ips is link drift, not code.
+        # (Its own try: a failure here must label THIS metric, not clobber
+        # an already-measured infer_ips.)
+        fn, dev_params, data, _dp = jm._compiled_apply(
+            jm.model, jm._resolve_node(jm.model))
+        mb = 1024
+        imgs_c = rng.integers(0, 255, size=(mb, 32, 32, 3)).astype(np.uint8)
+        dev_batch = jax.device_put(imgs_c, data)
+        fn(dev_params, dev_batch).block_until_ready()
+        cdt = _bench_loop(lambda: fn(dev_params, dev_batch))
+        infer_compute_ips = round(mb / cdt / n_dev, 1)
+    except Exception as e:
+        infer_compute_ips = f"error: {e}"
 
     try:
         if table is None or jm is None:
@@ -295,6 +348,8 @@ def main() -> None:
         "device": device,
         "bridge_batch_p50_ms": bridge_p50,
         "inference_images_per_s_per_chip": infer_ips,
+        "inference_compute_images_per_s_per_chip": infer_compute_ips,
+        "tunnel_upload_mb_s": tunnel_mb_s,
         **extra,
     }))
 

@@ -35,22 +35,33 @@ def ensure_repo(repo_dir: str | None = None) -> str:
 
 
 def run(scale: str = "small", repo_dir: str | None = None) -> dict:
+    # `scale` kept for CLI symmetry with the other examples; the eval set
+    # is the fixed digits-rgb32 held-out split either way (real data, and
+    # the split the manifest's recorded accuracy refers to)
+    del scale
     from mmlspark_tpu.tools import build_model_repo
     repo = ensure_repo(repo_dir)
-    n = 512 if scale == "small" else 8192
 
     path = ModelDownloader(repo).download_by_name("ConvNet_CIFAR10")
     model = (JaxModel(input_col="image", output_col="scores",
                       minibatch_size=256)
              .set_model_location(path))
 
-    x, y = build_model_repo._class_blobs(n, (32, 32, 3), 10, seed=1)
+    # evaluate on REAL data: the held-out split of the dataset the zoo
+    # model was trained on (the manifest records the publisher's own
+    # held-out accuracy for this exact split — the notebook's "download a
+    # pretrained model and reproduce its accuracy" flow)
+    _, _, x, y = build_model_repo.digits_rgb32()
+    n = len(x)
     table = DataTable({"image": list(x.reshape(n, -1).astype(np.uint8))})
     scored = model.transform(table)
     pred = np.stack(list(scored["scores"])).argmax(-1)
     cm = confusion_matrix(y, pred, 10)
     acc = float((pred == y).mean())
-    return {"accuracy": acc, "n": n,
+    manifest_acc = next(e.eval_value
+                        for e in ModelDownloader(repo).list_models()
+                        if e.name == "ConvNet_CIFAR10")
+    return {"accuracy": acc, "n": n, "manifest_accuracy": manifest_acc,
             "confusion_diag": [int(v) for v in np.diag(cm)]}
 
 

@@ -40,6 +40,11 @@ class ModelSchema:
     input_node: str = "input"
     num_layers: int = 0
     layer_names: tuple = ()
+    # measured held-out performance recorded at publish time (the honesty
+    # contract: a zoo entry states what its weights are actually worth on
+    # the dataset it names; "" = not evaluated, e.g. size stand-ins)
+    eval_metric: str = ""
+    eval_value: float = 0.0
 
     def to_json(self) -> dict:
         d = dataclasses.asdict(self)

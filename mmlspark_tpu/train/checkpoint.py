@@ -72,29 +72,45 @@ class TrainCheckpointer:
 
     def save(self, state: Any, step: int | None = None,
              fingerprint: dict[str, Any] | None = None) -> int:
+        import jax
         import orbax.checkpoint as ocp
 
         if step is None:
             step = int(np.asarray(state["step"]))
         path = self._step_dir(step)
-        if os.path.exists(path):
+        # multi-host: every process calls save() (Orbax coordinates the
+        # collective write), but file-tree mutations outside Orbax —
+        # clearing a stale dir, the manifest, pruning — are primary-only,
+        # so a worker that dies mid-save can never leave the manifest
+        # pointing at an uncommitted checkpoint (the manifest updates
+        # strictly AFTER the barriered Orbax save completes everywhere)
+        primary = jax.process_index() == 0
+        if primary and os.path.exists(path):
             shutil.rmtree(path)
+        if jax.process_count() > 1:
+            # barrier: non-primary processes must not enter Orbax's own
+            # destination-exists check while the primary is still clearing
+            # a stale dir (a crashed run's partial save being overwritten)
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(
+                f"mmlspark_tpu_ckpt_clear_{step}")
         # pass device arrays straight to Orbax: sharded jax.Arrays are saved
         # shard-per-host (no all-gather, multi-host safe); numpy passes
         # through unchanged
         ckptr = ocp.StandardCheckpointer()
         ckptr.save(path, state)
         ckptr.wait_until_finished()
-        m = self._read_manifest()
-        if fingerprint is not None:
-            m["fingerprint"] = fingerprint
-        if step not in m["steps"]:
-            m["steps"].append(step)
-        m["steps"].sort()
-        while len(m["steps"]) > self.max_to_keep:
-            old = m["steps"].pop(0)
-            shutil.rmtree(self._step_dir(old), ignore_errors=True)
-        self._write_manifest(m)
+        if primary:
+            m = self._read_manifest()
+            if fingerprint is not None:
+                m["fingerprint"] = fingerprint
+            if step not in m["steps"]:
+                m["steps"].append(step)
+            m["steps"].sort()
+            while len(m["steps"]) > self.max_to_keep:
+                old = m["steps"].pop(0)
+                shutil.rmtree(self._step_dir(old), ignore_errors=True)
+            self._write_manifest(m)
         return step
 
     def restore(self, step: int | None = None,

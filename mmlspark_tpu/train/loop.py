@@ -37,6 +37,12 @@ class TrainConfig:
     weight_decay: float = 0.0
     momentum: float = 0.9
     loss: str = "softmax_xent"       # softmax_xent | sigmoid_xent | mse
+    # master-free low-precision training: cast params (and hence the
+    # optimizer moments, which inherit leaf dtypes) to this dtype at init.
+    # "bfloat16" halves param/moment HBM traffic per step — standard for
+    # fine-tuning with SGD/momentum; avoid with adam (its second-moment
+    # statistics need f32). None = float32 params (default)
+    param_dtype: str | None = None
     seed: int = 0
     mesh_spec: Any = None            # MeshSpec | dict | None (dp over all)
     donate_state: bool = True
@@ -48,6 +54,10 @@ class TrainConfig:
     # Short processes pad the block with zero-weight filler, so step
     # counts are identical for any value
     liveness_sync_every: int = 8
+    # multi-host fit_arrays: unequal per-process shard lengths normally
+    # pad shorter shards with zero-weight rows (exact training — padded
+    # rows contribute nothing); True restores the loud error instead
+    strict_shards: bool = False
     # mid-training checkpoint/resume (beyond-reference capability; SURVEY §5)
     checkpoint_dir: str | None = None
     checkpoint_every: int = 0        # global steps between saves; 0 = end only
@@ -100,6 +110,17 @@ def make_loss(kind: str) -> Callable:
     return loss
 
 
+def single_device(mesh) -> Any | None:
+    """The 1-device fast-path criterion: the bare device when the mesh has
+    exactly one, else None. THE single source of truth — make_train_step's
+    plain-jit path and Trainer.data_target's commit target must always
+    agree, or batches committed with a NamedSharding would feed a
+    plain-jit program (or vice versa)."""
+    if int(mesh.devices.size) == 1:
+        return mesh.devices.reshape(-1)[0]
+    return None
+
+
 def make_train_step(module: Any, cfg: TrainConfig, mesh: Any):
     """Build (init_state, step, step_masked) for a flax module on a mesh.
 
@@ -115,16 +136,47 @@ def make_train_step(module: Any, cfg: TrainConfig, mesh: Any):
 
     tx = make_optimizer(cfg)
     loss_fn = make_loss(cfg.loss)
-    repl = mesh_lib.replicated(mesh)
+    # single-device fast path: plain placement + plain jit. NamedSharding
+    # transfers/fetches take a multi-round-trip path through remote-device
+    # tunnels (~4.5 ms/step measured on the ViT bench config, PERF_NOTES
+    # round 4) — the same choice models/jax_model.py makes for inference
+    dev0 = single_device(mesh)
+    single = dev0 is not None
+    repl = dev0 if single else mesh_lib.replicated(mesh)
 
     def init_state(input_spec: tuple) -> dict:
+        from jax.sharding import NamedSharding
+
         rng = jax.random.PRNGKey(cfg.seed)
         dummy = jnp.zeros((1,) + tuple(input_spec), jnp.float32)
         params = module.init(rng, dummy)["params"]
+        if cfg.param_dtype:
+            dt = jnp.dtype(cfg.param_dtype)
+            params = jax.tree_util.tree_map(
+                lambda a: a.astype(dt) if jnp.issubdtype(
+                    a.dtype, jnp.floating) else a, params)
         # fsdp > 1 → zero-style parameter sharding; optimizer moments
         # inherit the leaf shardings through eager zeros_like propagation
-        params = jax.device_put(params, mesh_lib.param_shardings(mesh, params))
+        params = jax.device_put(
+            params, dev0 if single
+            else mesh_lib.param_shardings(mesh, params))
         opt_state = tx.init(params)
+
+        # scalar leaves optax creates itself (e.g. adam's step count) land
+        # uncommitted on the default device; commit them replicated so the
+        # WHOLE state tree has explicit mesh shardings — required for a
+        # checkpoint restore to rebuild arrays every process can address
+        # (a single-local-device scalar restores fine on one process but
+        # is not a global array, and the multi-host step rejects it)
+        def commit_leaf(leaf):
+            if single:
+                return jax.device_put(leaf, dev0)
+            sh = getattr(leaf, "sharding", None)
+            if isinstance(sh, NamedSharding):
+                return leaf  # inherited a mesh sharding already
+            return jax.device_put(leaf, repl)
+
+        opt_state = jax.tree_util.tree_map(commit_leaf, opt_state)
         return {"params": params, "opt_state": opt_state,
                 "step": jax.device_put(jnp.zeros((), jnp.int32), repl)}
 
@@ -160,36 +212,48 @@ def make_train_step(module: Any, cfg: TrainConfig, mesh: Any):
     # state shardings are inferred from the committed arrays built by
     # init_state (replicated or fsdp-sharded per param_shardings); batch
     # shardings stay EXPLICIT so direct callers passing host numpy batches
-    # still get dp-sharded data rather than silent replication
-    data = mesh_lib.batch_sharding(mesh)
+    # still get dp-sharded data rather than silent replication. On a
+    # 1-device mesh plain jit skips the sharding machinery entirely
     donate = (0,) if cfg.donate_state else ()
-    step = jax.jit(_step, in_shardings=(None, data, data),
-                   donate_argnums=donate)
-    step_masked = jax.jit(_step_masked, in_shardings=(None, data, data, data),
-                          donate_argnums=donate)
+    if single:
+        step = jax.jit(_step, donate_argnums=donate)
+        step_masked = jax.jit(_step_masked, donate_argnums=donate)
+    else:
+        data = mesh_lib.batch_sharding(mesh)
+        step = jax.jit(_step, in_shardings=(None, data, data),
+                       donate_argnums=donate)
+        step_masked = jax.jit(_step_masked,
+                              in_shardings=(None, data, data, data),
+                              donate_argnums=donate)
     return init_state, step, step_masked
 
 
 def _batches(x: np.ndarray, y: np.ndarray, batch_size: int,
-             seed: int) -> Iterator[tuple]:
+             seed: int, valid: np.ndarray | None = None) -> Iterator[tuple]:
     """Shuffled fixed-shape batches ``(bx, by, bw)``. The tail batch is
     zero-padded to ``batch_size`` with a 0/1 weight vector so no row is ever
     dropped (round-1/2 fix: ``drop_remainder`` silently lost up to
-    ``batch_size-1`` rows per epoch) while XLA still sees one shape."""
+    ``batch_size-1`` rows per epoch) while XLA still sees one shape.
+
+    ``valid`` (0/1 per row) marks rows that are themselves padding (the
+    unequal-multi-host-shard case): they shuffle through the walk like any
+    row but carry weight 0, so the batch count stays process-uniform while
+    the padded rows train as exact no-ops."""
     n = len(x)
     order = np.random.default_rng(seed).permutation(n)
-    ones = np.ones(batch_size, np.float32)
+    weights = (np.ones(n, np.float32) if valid is None
+               else np.asarray(valid, np.float32))
     for s in range(0, n, batch_size):
         idx = order[s:s + batch_size]
         if len(idx) == batch_size:
-            yield x[idx], y[idx], ones
+            yield x[idx], y[idx], weights[idx]
         else:
             pad = batch_size - len(idx)
             bx = np.concatenate([x[idx], np.zeros((pad,) + x.shape[1:],
                                                   x.dtype)])
             by = np.concatenate([y[idx], np.zeros((pad,) + y.shape[1:],
                                                   y.dtype)])
-            bw = np.concatenate([ones[:len(idx)], np.zeros(pad, np.float32)])
+            bw = np.concatenate([weights[idx], np.zeros(pad, np.float32)])
             yield bx, by, bw
 
 
@@ -277,6 +341,15 @@ class Trainer:
         self.history: list[float] = []
         self._fingerprint: dict | None = None
 
+    def data_target(self):
+        """Where host batches commit: the bare device on a 1-device mesh
+        (plain transfers — see make_train_step's fast path), else the
+        dp-sharded NamedSharding. Shares the `single_device` predicate
+        with make_train_step so the two can never disagree."""
+        dev0 = single_device(self.mesh)
+        return dev0 if dev0 is not None else mesh_lib.batch_sharding(
+            self.mesh)
+
     def _checkpointer(self):
         if not self.cfg.checkpoint_dir:
             return None
@@ -333,18 +406,38 @@ class Trainer:
 
         cfg = self.cfg
         nproc = jax.process_count()
+        valid: np.ndarray | None = None
         if nproc > 1:
             # every process must walk the same number of steps or the
-            # gradient all-reduce deadlocks — validate loudly up front
+            # gradient all-reduce deadlocks. Unequal shards pad up to the
+            # longest with zero-weight rows (exact: padded rows shuffle
+            # through the walk contributing nothing); strict_shards=True
+            # restores the loud error for jobs where unequal shards can
+            # only mean an upstream partitioning bug
             from jax.experimental import multihost_utils
             lens = np.asarray(multihost_utils.process_allgather(
                 np.asarray(len(x), np.int64)))
             if len(set(lens.tolist())) != 1:
-                raise ValueError(
-                    "fit_arrays multi-host requires equal per-process "
-                    f"shard lengths, got {lens.tolist()} — pad or trim the "
-                    "shards, or use fit_stream (which reconciles unequal "
-                    "streams with filler batches)")
+                if cfg.strict_shards:
+                    raise ValueError(
+                        "fit_arrays multi-host requires equal per-process "
+                        f"shard lengths, got {lens.tolist()} (strict_shards"
+                        "=True) — pad or trim the shards, or use fit_stream "
+                        "(which reconciles unequal streams with filler "
+                        "batches)")
+                longest = int(lens.max())
+                _log.warning(
+                    "fit_arrays: unequal per-process shards %s — padding "
+                    "to %d rows with zero-weight filler",
+                    lens.tolist(), longest)
+                pad = longest - len(x)
+                valid = np.concatenate([np.ones(len(x), np.float32),
+                                        np.zeros(pad, np.float32)])
+                if pad:
+                    x = np.concatenate(
+                        [x, np.zeros((pad,) + x.shape[1:], x.dtype)])
+                    y = np.concatenate(
+                        [y, np.zeros((pad,) + y.shape[1:], y.dtype)])
         # the batch must divide over the data axes AND split evenly across
         # processes (each contributes bs/nproc rows), so round down to a
         # multiple of lcm(dp, nproc)
@@ -365,16 +458,20 @@ class Trainer:
         # different dp extent changes the rounded bs (and hence the batch
         # walk) even when cfg.batch_size is unchanged. sched=2 marks the
         # padded-tail batch walk (one more step per epoch than sched-1 runs)
+        # param_dtype is part of the fingerprint: restoring an f32
+        # checkpoint into bf16 targets (or vice versa) would silently
+        # change precision mid-run instead of erroring loudly
         self._fingerprint = {"n_rows": int(n_global),
                              "batch_size": int(bs),
                              "seed": int(cfg.seed),
                              "epochs": int(cfg.epochs),
+                             "param_dtype": cfg.param_dtype or "float32",
                              "sched": 2}
         resumed = 0
         if self.state is None:
             self.state = self.init_state(x.shape[1:])
             resumed = self.maybe_restore() or 0
-        data = mesh_lib.batch_sharding(self.mesh)
+        data = self.data_target()
         ckpt = self._checkpointer()
         # resume completes the REMAINDER of the configured schedule: the
         # first `resumed` (already-trained) steps of the epoch/batch walk are
@@ -390,7 +487,7 @@ class Trainer:
                     return jax.device_put(arr, data)
             for epoch in range(cfg.epochs):
                 for i, (bx, by, bw) in enumerate(
-                        _batches(x, y, bs_local, cfg.seed + epoch)):
+                        _batches(x, y, bs_local, cfg.seed + epoch, valid)):
                     global_step += 1
                     if global_step <= resumed:
                         continue
@@ -441,7 +538,7 @@ class Trainer:
                 "epochs > 1 needs a callable source (a fresh iterator per "
                 "epoch); a plain iterator is exhausted after one pass")
 
-        data = mesh_lib.batch_sharding(self.mesh)
+        data = self.data_target()
         if nproc > 1:
             def commit(arr):
                 return jax.make_array_from_process_local_data(data, arr)
@@ -453,7 +550,9 @@ class Trainer:
         # shape that must match for a resume to replay correctly
         self._fingerprint = {"stream": True, "batch_size": int(bs),
                              "seed": int(cfg.seed),
-                             "epochs": int(cfg.epochs), "sched": 2}
+                             "epochs": int(cfg.epochs),
+                             "param_dtype": cfg.param_dtype or "float32",
+                             "sched": 2}
         resumed = 0
         ckpt = self._checkpointer()
         global_step = 0

@@ -64,8 +64,22 @@ def distributed_init(coordinator_address: str | None = None,
     hosts, so the same Mesh/pjit code spans slices (ICI within a slice, DCN
     between). On TPU pods all arguments are auto-discovered from the
     environment; pass them explicitly for CPU/GPU clusters.
+
+    Arguments left as ``None`` fall back to the ``MMLSPARK_TPU_COORDINATOR``
+    / ``MMLSPARK_TPU_NUM_PROCESSES`` / ``MMLSPARK_TPU_PROCESS_ID``
+    environment variables, which is how ``mmlspark_tpu.tools.launch`` wires
+    the worker processes it spawns; with neither args nor env set, JAX's
+    own TPU-pod auto-discovery applies.
     """
+    import os
+
     import jax
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("MMLSPARK_TPU_COORDINATOR")
+    if num_processes is None:
+        num_processes = _env_int("MMLSPARK_TPU_NUM_PROCESSES")
+    if process_id is None:
+        process_id = _env_int("MMLSPARK_TPU_PROCESS_ID")
     kwargs = {}
     if coordinator_address is not None:
         kwargs["coordinator_address"] = coordinator_address
@@ -74,6 +88,12 @@ def distributed_init(coordinator_address: str | None = None,
     if process_id is not None:
         kwargs["process_id"] = process_id
     jax.distributed.initialize(**kwargs)
+
+
+def _env_int(name: str) -> int | None:
+    import os
+    raw = os.environ.get(name)
+    return int(raw) if raw not in (None, "") else None
 
 
 def topology_summary() -> dict[str, Any]:

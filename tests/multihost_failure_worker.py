@@ -1,0 +1,70 @@
+"""Failure-injection worker for the distributed-training failure e2e.
+
+Trains an MLP through ``Trainer.fit_stream`` with per-step checkpointing
+(``TrainConfig.checkpoint_dir``). When ``MULTIHOST_FAIL_AT_STEP`` is set
+and this process is ``MULTIHOST_FAIL_RANK``, the worker hard-dies
+(``os._exit``) from inside its data stream after that many chunks —
+mid-training, without cleanup, like a preempted pod worker. The launcher
+(mmlspark_tpu.tools.launch) must detect the death and terminate the
+survivor instead of leaving it hung in a collective; re-running the same
+command with no fail env resumes from the last checkpoint
+(SURVEY §5: job-level restart + checkpoint/resume is the recovery story;
+the reference only checks one process exit code,
+cntk-train/src/main/scala/CNTKLearner.scala:147-151).
+"""
+
+import os
+
+import multihost_env  # noqa: F401  (env setup BEFORE jax import)
+
+import jax
+
+multihost_env.pin_platform()
+
+import numpy as np
+
+FAIL_EXIT_CODE = 17
+
+
+def main() -> None:
+    from mmlspark_tpu.utils.env import distributed_init
+    distributed_init()
+    pid = jax.process_index()
+
+    fail_at = int(os.environ.get("MULTIHOST_FAIL_AT_STEP", "0"))
+    fail_rank = int(os.environ.get("MULTIHOST_FAIL_RANK", "1"))
+    ckpt_dir = os.environ["MULTIHOST_CKPT_DIR"]
+
+    from mmlspark_tpu.models.zoo import MLP
+    from mmlspark_tpu.parallel.mesh import MeshSpec, make_mesh
+    from mmlspark_tpu.train import TrainConfig, Trainer
+
+    def source():
+        # deterministic per-process stream: 6 chunks x 8 rows each
+        for c in range(6):
+            if fail_at and pid == fail_rank and c == fail_at:
+                os._exit(FAIL_EXIT_CODE)  # hard mid-training death
+            r = np.random.default_rng(1000 + 10 * pid + c)
+            xs = r.normal(size=(8, 8)).astype(np.float32)
+            ys = ((xs[:, 0] > 0) ^ (xs[:, 1] > 0)).astype(np.int64)
+            yield xs, ys
+
+    mesh = make_mesh(MeshSpec(dp=-1))
+    cfg = TrainConfig(batch_size=8, epochs=1, learning_rate=5e-3,
+                      log_every=1, donate_state=False,
+                      checkpoint_dir=ckpt_dir, checkpoint_every=1,
+                      resume=True,
+                      # sync liveness every step so the failure window is
+                      # deterministic for the test
+                      liveness_sync_every=1)
+    tr = Trainer(MLP(features=(16,), num_outputs=2), cfg, mesh=mesh)
+    tr.fit_stream(source, input_spec=(8,))
+
+    multihost_env.write_result(pid, {
+        "pid": pid, "steps": int(tr.state["step"]),
+        "checksum": multihost_env.params_checksum(tr.params),
+        "losses": tr.history}, prefix="fail_out")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,47 +1,49 @@
-"""Worker script for the 2-process multi-host training test.
+"""Worker script for the multi-process multi-host training tests.
 
-Each process joins the jax.distributed world (2 virtual CPU devices per
-process → a 4-device global mesh), feeds ONLY its own shard of the dataset
-through ``Trainer.fit_arrays``, and prints the loss trajectory + a params
-checksum as one JSON line. Run by tests/test_multihost.py; out-does the
-reference's never-wired multi-node MPI stub
-(cntk-train/src/main/scala/CommandBuilders.scala:95-117).
+Launched via ``mmlspark_tpu.tools.launch`` (the pod-launcher analog of the
+reference's never-wired multi-node MPI stub,
+cntk-train/src/main/scala/CommandBuilders.scala:95-117): coordinator /
+world-size / rank arrive through the ``MMLSPARK_TPU_*`` env vars the
+launcher sets, and ``distributed_init()`` reads them back. Each process
+joins the ``jax.distributed`` world, feeds ONLY its own shard of the
+dataset through ``Trainer.fit_arrays``, and writes the loss trajectory +
+a params checksum into ``$MULTIHOST_OUT_DIR/out_<pid>.json``.
 """
 
-import json
-import os
-import sys
-
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import multihost_env  # noqa: F401  (env setup BEFORE jax import)
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+multihost_env.pin_platform()
 
 import numpy as np
 
 
 def main() -> None:
-    port, pid = sys.argv[1], int(sys.argv[2])
     from mmlspark_tpu.utils.env import distributed_init
-    distributed_init(coordinator_address=f"localhost:{port}",
-                     num_processes=2, process_id=pid)
-    assert jax.process_count() == 2 and jax.device_count() == 4
+    distributed_init()  # env-driven (launcher wiring)
+    pid = jax.process_index()
+    nproc = jax.process_count()
 
     from mmlspark_tpu.models.zoo import MLP
     from mmlspark_tpu.parallel.mesh import MeshSpec, make_mesh
     from mmlspark_tpu.train import TrainConfig, Trainer
 
-    # deterministic dataset; THIS process holds only rows [pid*60, pid*60+60)
+    # deterministic dataset; THIS process holds only its contiguous shard.
+    # With nproc=2 the split is equal (60/60); with nproc=4 the shards are
+    # deliberately unequal (40/30/30/20) to exercise the zero-weight
+    # shard-padding path
     r = np.random.default_rng(0)
     x = r.normal(size=(120, 8)).astype(np.float32)
     y = ((x[:, 0] > 0) ^ (x[:, 1] > 0)).astype(np.int64)
-    x_local, y_local = x[pid * 60:(pid + 1) * 60], y[pid * 60:(pid + 1) * 60]
+    if nproc == 2:
+        bounds = [0, 60, 120]
+    else:
+        bounds = np.concatenate([[0], np.cumsum([40, 30, 30, 20])]).tolist()
+    lo, hi = bounds[pid], bounds[pid + 1]
+    x_local, y_local = x[lo:hi], y[lo:hi]
 
-    mesh = make_mesh(MeshSpec(dp=-1))  # global 4-device mesh
+    mesh = make_mesh(MeshSpec(dp=-1))  # global mesh over all processes
     cfg = TrainConfig(batch_size=40, epochs=4, learning_rate=5e-3,
                       log_every=1, donate_state=False)
     tr = Trainer(MLP(features=(16,), num_outputs=2), cfg, mesh=mesh)
@@ -49,12 +51,12 @@ def main() -> None:
 
     # params are fully replicated after training; checksum must agree
     # across processes (the all-reduce proof)
-    leaves = jax.tree_util.tree_leaves(tr.params)
-    checksum = float(sum(float(np.asarray(l).sum()) for l in leaves))
+    checksum = multihost_env.params_checksum(tr.params)
 
     # ---- streamed training with UNEQUAL per-process batch counts ----
-    # process 0 streams 3 chunks, process 1 streams 5; the liveness sync
-    # must feed zero-weight filler on the short side instead of deadlocking
+    # process 0 streams 3 chunks, later processes stream 5; the liveness
+    # sync must feed zero-weight filler on the short side instead of
+    # deadlocking
     def source():
         n_chunks = 3 if pid == 0 else 5
         for c in range(n_chunks):
@@ -67,14 +69,13 @@ def main() -> None:
                        log_every=1, donate_state=False)
     tr2 = Trainer(MLP(features=(16,), num_outputs=2), cfg2, mesh=mesh)
     tr2.fit_stream(source, input_spec=(8,))
-    leaves2 = jax.tree_util.tree_leaves(tr2.params)
-    checksum2 = float(sum(float(np.asarray(l).sum()) for l in leaves2))
 
-    print(json.dumps({"pid": pid, "losses": tr.history,
-                      "steps": int(tr.state["step"]),
-                      "checksum": checksum,
-                      "stream_steps": int(tr2.state["step"]),
-                      "stream_checksum": checksum2}), flush=True)
+    multihost_env.write_result(pid, {
+        "pid": pid, "nproc": nproc, "losses": tr.history,
+        "steps": int(tr.state["step"]),
+        "checksum": checksum,
+        "stream_steps": int(tr2.state["step"]),
+        "stream_checksum": multihost_env.params_checksum(tr2.params)})
 
 
 if __name__ == "__main__":

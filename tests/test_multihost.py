@@ -1,49 +1,55 @@
-"""Real multi-host training: 2 jax.distributed processes, one global mesh.
+"""Real multi-host training: N jax.distributed processes, one global mesh.
 
 The reference never wired its multi-node path (the MPI hostfile launcher is
 an unused stub, cntk-train/src/main/scala/CommandBuilders.scala:95-117).
-Here two OS processes each hold 2 virtual CPU devices and ONLY HALF the
-dataset; ``Trainer.fit_arrays`` assembles global batches from the local
-shards (``jax.make_array_from_process_local_data``) and XLA all-reduces
-gradients across the 4-device world. Asserts: both processes converge, the
-trained params agree bit-for-bit across processes, and the loss trajectory
-matches a single-process run fed the identically-composed global batches.
+Here the framework's OWN pod launcher (``mmlspark_tpu.tools.launch``)
+starts the worker processes — each holding 2 virtual CPU devices and ONLY
+its shard of the dataset; ``Trainer.fit_arrays`` assembles global batches
+from the local shards (``jax.make_array_from_process_local_data``) and XLA
+all-reduces gradients across the world. Asserts: convergence, bit-identical
+params across processes, loss parity with a single-process run, unequal
+shards/streams handled, and the failure path — a worker hard-killed
+mid-training is detected by the launcher and the job resumes from the last
+checkpoint to the same final state as an uninterrupted run.
 """
 
 import json
 import os
-import socket
-import subprocess
 import sys
+import time
 
 import numpy as np
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "multihost_worker.py")
+FAIL_WORKER = os.path.join(REPO, "tests", "multihost_failure_worker.py")
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        return s.getsockname()[1]
+def _launch(worker: str, nproc: int, out_dir: str, extra_env=None,
+            grace: float = 30.0) -> int:
+    """Run a worker set through the real pod launcher (the deploy path)."""
+    from mmlspark_tpu.tools.launch import launch_local
+    env = {"MULTIHOST_OUT_DIR": out_dir}
+    env.update(extra_env or {})
+    return launch_local([sys.executable, worker], nproc,
+                        cpu_devices=2, grace_seconds=grace, extra_env=env)
+
+
+def _read_outs(out_dir: str, nproc: int, prefix: str = "out"):
+    outs = []
+    for pid in range(nproc):
+        with open(os.path.join(out_dir, f"{prefix}_{pid}.json")) as f:
+            outs.append(json.load(f))
+    return sorted(outs, key=lambda o: o["pid"])
 
 
 @pytest.fixture(scope="module")
-def multihost_result():
-    port = _free_port()
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
-    procs = [subprocess.Popen(
-        [sys.executable, WORKER, str(port), str(pid)],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
-        for pid in (0, 1)]
-    outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=300)
-        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
-        outs.append(json.loads(out.strip().splitlines()[-1]))
-    return sorted(outs, key=lambda o: o["pid"])
+def multihost_result(tmp_path_factory):
+    out_dir = str(tmp_path_factory.mktemp("mh2"))
+    rc = _launch(WORKER, 2, out_dir)
+    assert rc == 0, f"2-process launch failed with rc={rc}"
+    return _read_outs(out_dir, 2)
 
 
 def test_both_processes_trained_full_schedule(multihost_result):
@@ -109,3 +115,73 @@ def test_unequal_stream_shards_do_not_deadlock(multihost_result):
     assert r0["stream_steps"] == r1["stream_steps"] == 20
     assert r0["stream_checksum"] == pytest.approx(r1["stream_checksum"],
                                                   rel=0, abs=0.0)
+
+
+def test_four_process_unequal_shards(tmp_path):
+    """4 launcher-started processes (8 global devices) with deliberately
+    UNEQUAL fit_arrays shards (40/30/30/20 rows): the zero-weight shard
+    padding must keep every process on the same batch walk and produce
+    bit-identical params everywhere."""
+    out_dir = str(tmp_path)
+    rc = _launch(WORKER, 4, out_dir)
+    assert rc == 0, f"4-process launch failed with rc={rc}"
+    outs = _read_outs(out_dir, 4)
+    # shards pad to 40 rows/process → 160 global rows, bs 40 → 4 steps ×
+    # 4 epochs
+    assert [o["steps"] for o in outs] == [16] * 4
+    sums = {o["checksum"] for o in outs}
+    assert len(sums) == 1, f"params diverged across 4 hosts: {sums}"
+    assert outs[0]["losses"][-1] < outs[0]["losses"][0]
+    stream_sums = {o["stream_checksum"] for o in outs}
+    assert len(stream_sums) == 1
+
+
+def test_worker_death_detected_and_resume_matches_uninterrupted(tmp_path):
+    """The failure e2e (SURVEY §5): kill worker 1 mid-fit_stream; the
+    launcher must surface the failure (terminating the survivor, no hang),
+    and re-running the same command must resume from the last checkpoint
+    and reach the same final params as a never-interrupted run."""
+    FAIL_EXIT_CODE = 17  # multihost_failure_worker.FAIL_EXIT_CODE
+
+    # 1) uninterrupted baseline
+    base_dir = str(tmp_path / "base_out")
+    os.makedirs(base_dir)
+    rc = _launch(FAIL_WORKER, 2, base_dir,
+                 {"MULTIHOST_CKPT_DIR": str(tmp_path / "ckpt_base")})
+    assert rc == 0
+    base = _read_outs(base_dir, 2, prefix="fail_out")
+
+    # 2) run that dies: rank 1 hard-exits after 3 chunks (mid-stream)
+    ckpt = str(tmp_path / "ckpt_fail")
+    fail_dir = str(tmp_path / "fail_out")
+    os.makedirs(fail_dir)
+    t0 = time.time()
+    rc = _launch(FAIL_WORKER, 2, fail_dir,
+                 {"MULTIHOST_CKPT_DIR": ckpt,
+                  "MULTIHOST_FAIL_AT_STEP": "3",
+                  "MULTIHOST_FAIL_RANK": "1"}, grace=20.0)
+    elapsed = time.time() - t0
+    assert rc == FAIL_EXIT_CODE, \
+        f"launcher must report the dead worker's exit code, got {rc}"
+    # the survivor was terminated, not left hung in a collective forever
+    assert elapsed < 240, f"failure detection took {elapsed:.0f}s"
+    # some checkpoints landed before the death
+    from mmlspark_tpu.train.checkpoint import TrainCheckpointer
+    saved = TrainCheckpointer(ckpt).latest_step()
+    assert saved is not None and saved >= 1
+
+    # 3) restart the SAME command: resumes from the last checkpoint and
+    # completes the schedule
+    resume_dir = str(tmp_path / "resume_out")
+    os.makedirs(resume_dir)
+    rc = _launch(FAIL_WORKER, 2, resume_dir, {"MULTIHOST_CKPT_DIR": ckpt})
+    assert rc == 0, "restart after failure did not complete"
+    resumed = _read_outs(resume_dir, 2, prefix="fail_out")
+
+    assert resumed[0]["steps"] == base[0]["steps"]
+    assert resumed[0]["checksum"] == pytest.approx(resumed[1]["checksum"],
+                                                   rel=0, abs=0.0)
+    # deterministic schedule + resume replay ⇒ same final params as the
+    # uninterrupted job
+    assert resumed[0]["checksum"] == pytest.approx(base[0]["checksum"],
+                                                   rel=1e-6)

@@ -102,6 +102,67 @@ class TestExecutorContract:
             list(fn(stream_table(bad, 2)))
 
 
+class TestStubEngine:
+    """Drive the REAL ``bridge/spark.py`` wrapper code through a stand-in
+    engine (tests/spark_stub.py): ``limit``/``toPandas`` for the schema
+    probe and ``mapInArrow`` with Spark's exact per-partition
+    RecordBatch-iterator convention. This is the CI coverage for the
+    one-call wrapper; TestRealPySpark stays the engine-level proof."""
+
+    def test_spark_transform_matches_direct_through_stub(self, monkeypatch):
+        import spark_stub
+        spark_stub.install(monkeypatch)
+        from mmlspark_tpu.bridge.spark import spark_transform
+        jm = make_model(minibatch=8)
+        t = vec_table(48, seed=3)
+        df = spark_stub.StubDataFrame.from_pandas(t.to_pandas(),
+                                                  num_partitions=3)
+        scored = spark_transform(df, jm)
+        merged = DataTable.from_arrow(scored.to_arrow())
+        direct = jm.transform(t)
+        np.testing.assert_array_equal(merged["id"], direct["id"])
+        np.testing.assert_allclose(
+            np.stack([np.asarray(v) for v in merged["scores"]]),
+            np.stack(list(direct["scores"])), rtol=1e-5, atol=1e-6)
+        # the wrapper must have inferred the exact scored-output schema
+        # from the driver-side probe and passed it to mapInArrow
+        assert df.applied_schema.arrow_schema == direct.to_arrow().schema
+
+    def test_empty_dataframe_schema_probe_raises(self, monkeypatch):
+        import pandas as pd
+        import spark_stub
+        spark_stub.install(monkeypatch)
+        from mmlspark_tpu.bridge.spark import output_spark_schema
+        jm = make_model()
+        empty = spark_stub.StubDataFrame.from_pandas(
+            pd.DataFrame({"id": np.array([], np.int64), "vec": []}))
+        with pytest.raises(ValueError, match="empty DataFrame"):
+            output_spark_schema(empty, jm)
+
+    def test_missing_pyspark_yields_clear_import_error(self):
+        # without the stub (or real pyspark) installed the wrapper must
+        # fail with the install hint, not an opaque ModuleNotFoundError
+        try:
+            import pyspark  # noqa: F401
+            pytest.skip("real pyspark present")
+        except ImportError:
+            pass
+        from mmlspark_tpu.bridge.spark import spark_transform
+        with pytest.raises(ImportError, match="mmlspark-tpu\\[spark\\]"):
+            spark_transform(object(), make_model())
+
+    def test_scoring_failure_propagates_through_stub_job(self, monkeypatch):
+        import spark_stub
+        spark_stub.install(monkeypatch)
+        from mmlspark_tpu.bridge.spark import spark_transform
+        jm = make_model()
+        t = vec_table(12)
+        bad = t.with_column("vec", [np.zeros(5, np.float32)] * 12)
+        df = spark_stub.StubDataFrame.from_pandas(bad.to_pandas())
+        with pytest.raises(ValueError, match="model expects"):
+            spark_transform(df, jm)
+
+
 class TestRealPySpark:
     """End-to-end through a local SparkSession (runs where pyspark exists)."""
 

@@ -157,3 +157,75 @@ def test_empty_stream_raises():
                  TrainConfig(batch_size=8, epochs=1))
     with pytest.raises(ValueError, match="yielded no data"):
         tr.fit_stream(iter([]))
+
+
+def _rss_mb() -> float:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1]) / 1024.0
+    raise RuntimeError("no VmRSS")
+
+
+class TestScaleBoundedStreaming:
+    """The ImageNet-shard claim (BASELINE config 3): ~50k images flow
+    through stream_images → ImageTransformer → JaxModel.transform_stream
+    with host memory bounded by the chunk size, never the dataset. A
+    materialized pass would hold ≈614 MB of decoded 64×64 pixels (plus
+    scores); the streamed pass must stay far under that."""
+
+    N_IMAGES = 50_000
+
+    @pytest.fixture(scope="class")
+    def big_zip(self, tmp_path_factory):
+        import io
+        import zipfile
+
+        import cv2
+        root = tmp_path_factory.mktemp("bigstream")
+        zpath = str(root / "shard0.zip")
+        r = np.random.default_rng(0)
+        # 64 unique images re-used under distinct names: realistic decode
+        # work per row without 50k encode calls
+        blobs = []
+        for _ in range(64):
+            img = r.integers(0, 255, (64, 64, 3)).astype(np.uint8)
+            ok, enc = cv2.imencode(".png", img)
+            assert ok
+            blobs.append(enc.tobytes())
+        with zipfile.ZipFile(zpath, "w", zipfile.ZIP_STORED) as z:
+            for i in range(self.N_IMAGES):
+                z.writestr(f"img_{i:06d}.png", blobs[i % len(blobs)])
+        return zpath
+
+    def test_50k_images_stream_with_bounded_rss(self, big_zip):
+        from mmlspark_tpu.stages.image import ImageTransformer
+
+        bundle = get_model("ConvNet_CIFAR10", widths=(8, 16),
+                           dense_width=32)
+        jm = JaxModel(model=bundle, input_col="image", output_col="scores",
+                      minibatch_size=1024)
+        tf = ImageTransformer().resize(32, 32)
+
+        chunks = stream_images(big_zip, inspect_zip=True, chunk_rows=512)
+        rows = 0
+        score_sum = 0.0
+        baseline = None
+        peak = 0.0
+        for out in jm.transform_stream(tf.transform(c) for c in chunks):
+            rows += len(out)
+            score_sum += float(np.sum(np.stack(list(out["scores"]))))
+            if baseline is None:
+                # after the first chunk: compile + runtimes are resident
+                baseline = _rss_mb()
+            peak = max(peak, _rss_mb())
+        assert rows == self.N_IMAGES
+        assert np.isfinite(score_sum)
+        growth = peak - baseline
+        # chunk-bounded memory: the bound is RELATIVE to what a
+        # materialized pass would pin (~614 MB of decoded pixels) with
+        # generous slack for allocator-arena/BLAS-pool jitter, since
+        # absolute VmRSS depends on what earlier tests left resident
+        assert growth < 400, (
+            f"streaming RSS grew {growth:.0f} MB over the run — "
+            "memory is scaling with the dataset, not the chunk")
