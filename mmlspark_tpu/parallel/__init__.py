@@ -13,5 +13,17 @@ from mmlspark_tpu.parallel.mesh import (
     default_mesh_spec,
     make_mesh,
 )
+from mmlspark_tpu.parallel.moe import (
+    init_moe_params,
+    moe_apply,
+    moe_param_spec,
+)
+from mmlspark_tpu.parallel.pipeline import (
+    pipeline_apply,
+    pipeline_spec,
+    stack_layer_params,
+)
 
-__all__ = ["MeshSpec", "make_mesh", "default_mesh_spec"]
+__all__ = ["MeshSpec", "make_mesh", "default_mesh_spec",
+           "pipeline_apply", "pipeline_spec", "stack_layer_params",
+           "moe_apply", "moe_param_spec", "init_moe_params"]

@@ -120,8 +120,10 @@ def param_shardings(mesh, params) -> Any:
       (zero-style parameter sharding; XLA all-gathers for the forward and
       reduce-scatters the grads).
     * Leaves with no divisible dim — and everything on a pure-dp mesh —
-      replicate. ``pp``/``ep`` are reserved axes: nothing shards over them
-      yet (pipeline/expert layouts are model-specific).
+      replicate. ``pp``/``ep`` are not handled HERE because their layouts
+      are structural, not per-leaf: pipeline stages shard stacked layer
+      params via :func:`mmlspark_tpu.parallel.pipeline.pipeline_spec` and
+      MoE experts via :func:`mmlspark_tpu.parallel.moe.moe_param_spec`.
     """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P

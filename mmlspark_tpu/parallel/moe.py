@@ -1,0 +1,193 @@
+"""Expert parallelism over the ``ep`` mesh axis — a Switch-style
+mixture-of-experts layer with all-to-all token dispatch.
+
+**Beyond reference parity by design.** The reference has no MoE/expert
+parallelism (SURVEY §2.6: EP "No"). The TPU-native formulation is the
+Mesh-TensorFlow / Switch-Transformer dispatch algebra expressed as one
+``shard_map`` over ``ep``:
+
+* tokens shard over ``ep`` (each shard routes its own slice); expert
+  parameters shard over ``ep`` on the expert axis (each shard OWNS
+  ``E / ep`` experts),
+* top-1 gating with a fixed per-expert **capacity**: each source shard
+  builds a ``[E, C, d]`` dispatch buffer (position-in-expert via cumsum,
+  overflow tokens dropped — they contribute zero and pass through the
+  residual), applies the combine weights on the way back,
+* ``all_to_all`` regroups ``[ep, E_local, C, d]`` so every shard holds
+  ALL source shards' slots for ITS experts, applies its local expert
+  FFNs, and ``all_to_all``s back — the canonical EP traffic pattern,
+  riding ICI,
+* a load-balancing auxiliary loss (mean gate prob × token fraction per
+  expert, Switch §2.2 style) is returned alongside the outputs,
+* everything is differentiable; numerics match a dense (every-expert)
+  reference exactly when capacity is ample (asserted on the CPU mesh).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+
+def init_moe_params(key, num_experts: int, d_model: int, d_hidden: int,
+                    dtype=None) -> dict:
+    """Gate + stacked expert-FFN params (expert axis leading)."""
+    import jax
+    import jax.numpy as jnp
+
+    dtype = dtype or jnp.float32
+    kg, k1, k2 = jax.random.split(key, 3)
+    s1 = 1.0 / np.sqrt(d_model)
+    s2 = 1.0 / np.sqrt(d_hidden)
+    return {
+        "gate": jax.random.normal(kg, (d_model, num_experts), dtype) * s1,
+        "w_in": jax.random.normal(
+            k1, (num_experts, d_model, d_hidden), dtype) * s1,
+        "b_in": jnp.zeros((num_experts, d_hidden), dtype),
+        "w_out": jax.random.normal(
+            k2, (num_experts, d_hidden, d_model), dtype) * s2,
+        "b_out": jnp.zeros((num_experts, d_model), dtype),
+    }
+
+
+def moe_param_spec(mesh, params) -> Any:
+    """Shardings: expert-stacked leaves over ``ep``; the gate replicated."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def one(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name == "gate":
+            return NamedSharding(mesh, P())
+        return NamedSharding(mesh, P("ep"))
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def _expert_ffn(params_e, x):
+    """One expert's FFN on [n, d] tokens; params_e carries that expert's
+    slices (no expert axis)."""
+    import jax.numpy as jnp
+    h = jnp.maximum(x @ params_e["w_in"] + params_e["b_in"], 0.0)
+    return h @ params_e["w_out"] + params_e["b_out"]
+
+
+def moe_apply(params: dict, x: Any, mesh, capacity_factor: float = 2.0
+              ) -> tuple[Any, Any]:
+    """Route ``x`` ``[N, d]`` through expert-parallel top-1 MoE.
+
+    Returns ``(y, aux_loss)`` — ``y[i]`` is ``gate_i · expert(x_i)`` for
+    routed tokens and 0 for capacity-dropped ones (callers add the
+    residual), ``aux_loss`` is the Switch load-balancing scalar.
+
+    ``N`` must divide by the ``dp × fsdp × ep`` extent (tokens shard over
+    the data axes AND ``ep``, so a dp×ep mesh splits work instead of
+    replicating it); the expert count is the leading dim of the stacked
+    expert params and must divide by ``ep``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    ep = mesh.shape["ep"]
+    dp_ext = mesh.shape["dp"] * mesh.shape["fsdp"]
+    E = int(params["w_in"].shape[0])
+    d = int(x.shape[-1])
+    N = int(x.shape[0])
+    if E % ep:
+        raise ValueError(f"{E} experts not divisible by ep={ep}")
+    if N % (ep * dp_ext):
+        raise ValueError(
+            f"{N} tokens not divisible by dp*fsdp*ep = {ep * dp_ext}")
+    n_local = N // (ep * dp_ext)
+    # per-expert slots per SOURCE shard (fixed shape for XLA)
+    C = max(1, int(np.ceil(capacity_factor * n_local / E)))
+    e_local = E // ep
+
+    def shard_fn(p, xs):
+        # xs: [n_local, d] this shard's tokens
+        logits = xs @ p["gate"]                       # [n, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert = jnp.argmax(probs, axis=-1)           # [n] top-1
+        gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+        # routing bookkeeping in int32/f32 REGARDLESS of the token dtype:
+        # a bf16 cumsum saturates at 256, silently aliasing slot positions
+        onehot_i = jax.nn.one_hot(expert, E, dtype=jnp.int32)   # [n, E]
+        # position of each token within its expert's capacity slots
+        pos = (jnp.cumsum(onehot_i, axis=0) - onehot_i) * onehot_i
+        pos = jnp.sum(pos, axis=-1)                              # [n] int32
+        keep = pos < C
+        # dispatch tensor [n, E, C]: one-hot over (expert, slot)
+        onehot = onehot_i.astype(jnp.float32)
+        slot = jax.nn.one_hot(pos, C, dtype=jnp.float32) \
+            * keep[:, None].astype(jnp.float32)
+        dispatch = onehot[:, :, None] * slot[:, None, :]        # [n, E, C]
+        slots = jnp.einsum("nec,nd->ecd", dispatch,
+                           xs.astype(jnp.float32)).astype(xs.dtype)
+        # regroup so THIS shard holds all source shards' slots for its
+        # local experts: [E, C, d] -> [ep, e_local, C, d] -> a2a over ep
+        slots = slots.reshape(ep, e_local, C, d)
+        slots = jax.lax.all_to_all(slots, "ep", split_axis=0,
+                                   concat_axis=0, tiled=False)  # [ep,el,C,d]
+        # apply local experts to their ep*C slots (scan unstacks the
+        # expert axis of params and slots together; reverse-mode safe)
+        slots = slots.transpose(1, 0, 2, 3).reshape(e_local, ep * C, d)
+        stacked_pe = {k: p[k] for k in ("w_in", "b_in", "w_out", "b_out")}
+
+        def one_expert(_, args):
+            pe, slot = args
+            return None, _expert_ffn(pe, slot)
+
+        _, outs = jax.lax.scan(one_expert, None, (stacked_pe, slots))
+        # route back to the source shards
+        outs = outs.reshape(e_local, ep, C, d).transpose(1, 0, 2, 3)
+        outs = jax.lax.all_to_all(outs, "ep", split_axis=0,
+                                  concat_axis=0, tiled=False)
+        outs = outs.reshape(E, C, d)
+        y = (jnp.einsum("nec,ecd->nd", dispatch,
+                        outs.astype(jnp.float32))
+             * gate.astype(jnp.float32)[:, None]).astype(xs.dtype)
+        # Switch load-balance loss: E * sum_e fraction_e * mean-prob_e,
+        # averaged over every token shard via pmean
+        frac = jnp.mean(onehot, axis=0)
+        mean_p = jnp.mean(probs.astype(jnp.float32), axis=0)
+        aux = E * jnp.sum(frac * mean_p)
+        aux = jax.lax.pmean(aux, ("dp", "fsdp", "ep"))
+        return y, aux[None]
+
+    token_axes = ("dp", "fsdp", "ep")
+    y, aux = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(moe_in_specs(), P(token_axes)),
+        out_specs=(P(token_axes), P()),
+        check_vma=False,
+    )(params, x)
+    return y, aux[0]
+
+
+def moe_in_specs() -> Any:
+    from jax.sharding import PartitionSpec as P
+    return {"gate": P(), "w_in": P("ep"), "b_in": P("ep"),
+            "w_out": P("ep"), "b_out": P("ep")}
+
+
+def moe_reference(params: dict, x: Any) -> Any:
+    """Dense oracle: every token through its top-1 expert, no capacity,
+    no parallelism — what :func:`moe_apply` must reproduce when capacity
+    is ample."""
+    import jax
+    import jax.numpy as jnp
+
+    probs = jax.nn.softmax(x @ params["gate"], axis=-1)
+    expert = jnp.argmax(probs, axis=-1)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+    E = params["w_in"].shape[0]
+    outs = []
+    for e in range(E):
+        pe = {k: params[k][e] for k in ("w_in", "b_in", "w_out", "b_out")}
+        outs.append(_expert_ffn(pe, x))
+    dense = jnp.stack(outs, axis=1)                   # [N, E, d]
+    sel = jnp.take_along_axis(
+        dense, expert[:, None, None].repeat(dense.shape[-1], -1), 1)[:, 0]
+    return sel * gate[:, None]
