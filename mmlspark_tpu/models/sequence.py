@@ -70,12 +70,20 @@ class TransformerTagger(nn.Module):
     max_len: int = 2048
     causal: bool = False
     dtype: Any = jnp.float32
+    # > 0 swaps each layer's dense MLP for a Switch-style top-1
+    # mixture-of-experts FFN (parallel/moe param layout). Single-device
+    # it routes densely; pass ``moe_fn`` (e.g. a closure over
+    # parallel.moe.moe_apply and an ep mesh) to run the expert-parallel
+    # all-to-all dispatch with the SAME params. Per-layer load-balance
+    # aux losses are sown under intermediates/"moe_aux"
+    moe_experts: int = 0
 
     OUTPUT_NAMES = ("features", "logits")
 
     @nn.compact
     def __call__(self, tokens, output: str = "logits", train: bool = False,
-                 attention_fn: Callable | None = None, mask=None):
+                 attention_fn: Callable | None = None, mask=None,
+                 moe_fn: Callable | None = None):
         # mask: [B, L] bool (True = real token); pad keys are excluded from
         # attention so logits don't depend on the bucket's padding amount.
         # attention_fn receives (q, k, v, kv_mask, causal) so a
@@ -106,13 +114,44 @@ class TransformerTagger(nn.Module):
             attn = attn.reshape(B, L, self.embed_dim)
             x = x + nn.Dense(self.embed_dim, name=f"proj{i}")(attn)
             h = nn.LayerNorm(name=f"ln_b{i}")(x)
-            h = nn.Dense(self.mlp_dim, name=f"mlp_in{i}")(h)
-            h = nn.gelu(h)
-            x = x + nn.Dense(self.embed_dim, name=f"mlp_out{i}")(h)
+            if self.moe_experts > 0:
+                x = x + self._moe_ffn(h, i, moe_fn, mask)
+            else:
+                h = nn.Dense(self.mlp_dim, name=f"mlp_in{i}")(h)
+                h = nn.gelu(h)
+                x = x + nn.Dense(self.embed_dim, name=f"mlp_out{i}")(h)
         x = nn.LayerNorm(name="ln_f")(x)
         if output == "features":
             return x
         return nn.Dense(self.num_tags, name="head")(x)
+
+    def _moe_ffn(self, h, i: int, moe_fn: Callable | None, mask):
+        """Switch MoE FFN for layer ``i`` — params in the
+        ``parallel/moe`` layout (gate + expert-stacked FFN), routed
+        densely by default or through ``moe_fn`` for expert parallelism.
+        The padding mask rides along so pad tokens never claim capacity
+        slots (the padding invariant: a sentence's logits must not depend
+        on its bucket's pad amount)."""
+        from mmlspark_tpu.parallel.moe import moe_dense
+
+        B, L, D = h.shape
+        E = self.moe_experts
+        dh = self.mlp_dim
+        init = nn.initializers.lecun_normal()
+        params = {
+            "gate": self.param(f"moe{i}_gate", init, (D, E)),
+            "w_in": self.param(f"moe{i}_w_in", init, (E, D, dh)),
+            "b_in": self.param(f"moe{i}_b_in", nn.initializers.zeros,
+                               (E, dh)),
+            "w_out": self.param(f"moe{i}_w_out", init, (E, dh, D)),
+            "b_out": self.param(f"moe{i}_b_out", nn.initializers.zeros,
+                                (E, D)),
+        }
+        flat = h.reshape(B * L, D)
+        flat_mask = None if mask is None else mask.reshape(B * L)
+        y, aux = (moe_fn or moe_dense)(params, flat, flat_mask)
+        self.sow("intermediates", "moe_aux", aux)
+        return y.reshape(B, L, D)
 
 
 # ---- padded/bucketed batching (the 613-token fixed pad, generalized) ----

@@ -52,17 +52,13 @@ def init_moe_params(key, num_experts: int, d_model: int, d_hidden: int,
 
 
 def moe_param_spec(mesh, params) -> Any:
-    """Shardings: expert-stacked leaves over ``ep``; the gate replicated."""
-    import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    """Shardings for the param dict — derived from the SAME layout
+    :func:`moe_in_specs` hands to shard_map, so device placement can
+    never drift from the kernel's expectations."""
+    from jax.sharding import NamedSharding
 
-    def one(path, leaf):
-        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
-        if name == "gate":
-            return NamedSharding(mesh, P())
-        return NamedSharding(mesh, P("ep"))
-
-    return jax.tree_util.tree_map_with_path(one, params)
+    specs = moe_in_specs()
+    return {k: NamedSharding(mesh, specs[k]) for k in params}
 
 
 def _expert_ffn(params_e, x):
@@ -73,13 +69,19 @@ def _expert_ffn(params_e, x):
     return h @ params_e["w_out"] + params_e["b_out"]
 
 
-def moe_apply(params: dict, x: Any, mesh, capacity_factor: float = 2.0
-              ) -> tuple[Any, Any]:
+def moe_apply(params: dict, x: Any, mesh, capacity_factor: float = 2.0,
+              token_mask: Any = None) -> tuple[Any, Any]:
     """Route ``x`` ``[N, d]`` through expert-parallel top-1 MoE.
 
     Returns ``(y, aux_loss)`` — ``y[i]`` is ``gate_i · expert(x_i)`` for
     routed tokens and 0 for capacity-dropped ones (callers add the
     residual), ``aux_loss`` is the Switch load-balancing scalar.
+
+    ``token_mask`` (``[N]``, 1 = real token): masked-out (padding) tokens
+    never claim capacity slots, output exact zeros, and are excluded from
+    the aux statistics — so a sequence's real-token routing does not
+    depend on how much padding its bucket added (the padding invariant
+    the sequence models promise).
 
     ``N`` must divide by the ``dp × fsdp × ep`` extent (tokens shard over
     the data axes AND ``ep``, so a dp×ep mesh splits work instead of
@@ -104,16 +106,23 @@ def moe_apply(params: dict, x: Any, mesh, capacity_factor: float = 2.0
     # per-expert slots per SOURCE shard (fixed shape for XLA)
     C = max(1, int(np.ceil(capacity_factor * n_local / E)))
     e_local = E // ep
+    if token_mask is None:
+        import jax.numpy as _jnp
+        token_mask = _jnp.ones((N,), _jnp.float32)
 
-    def shard_fn(p, xs):
-        # xs: [n_local, d] this shard's tokens
+    def shard_fn(p, xs, m):
+        # xs: [n_local, d] this shard's tokens; m: [n_local] 0/1 mask
+        m = m.astype(jnp.float32)
         logits = xs @ p["gate"]                       # [n, E]
         probs = jax.nn.softmax(logits, axis=-1)
         expert = jnp.argmax(probs, axis=-1)           # [n] top-1
         gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
         # routing bookkeeping in int32/f32 REGARDLESS of the token dtype:
-        # a bf16 cumsum saturates at 256, silently aliasing slot positions
-        onehot_i = jax.nn.one_hot(expert, E, dtype=jnp.int32)   # [n, E]
+        # a bf16 cumsum saturates at 256, silently aliasing slot positions.
+        # Masked tokens zero their one-hot row up front: they claim no
+        # capacity and vanish from dispatch, combine, and aux alike
+        onehot_i = jax.nn.one_hot(expert, E, dtype=jnp.int32) \
+            * m.astype(jnp.int32)[:, None]                      # [n, E]
         # position of each token within its expert's capacity slots
         pos = (jnp.cumsum(onehot_i, axis=0) - onehot_i) * onehot_i
         pos = jnp.sum(pos, axis=-1)                              # [n] int32
@@ -148,21 +157,24 @@ def moe_apply(params: dict, x: Any, mesh, capacity_factor: float = 2.0
         y = (jnp.einsum("nec,ecd->nd", dispatch,
                         outs.astype(jnp.float32))
              * gate.astype(jnp.float32)[:, None]).astype(xs.dtype)
-        # Switch load-balance loss: E * sum_e fraction_e * mean-prob_e,
-        # averaged over every token shard via pmean
-        frac = jnp.mean(onehot, axis=0)
-        mean_p = jnp.mean(probs.astype(jnp.float32), axis=0)
+        # Switch load-balance loss over REAL tokens only: global masked
+        # means via psum of (numerator, count)
+        token_axes = ("dp", "fsdp", "ep")
+        cnt = jnp.maximum(jax.lax.psum(m.sum(), token_axes), 1.0)
+        frac = jax.lax.psum(onehot.sum(axis=0), token_axes) / cnt
+        mean_p = jax.lax.psum(
+            (probs.astype(jnp.float32) * m[:, None]).sum(axis=0),
+            token_axes) / cnt
         aux = E * jnp.sum(frac * mean_p)
-        aux = jax.lax.pmean(aux, ("dp", "fsdp", "ep"))
         return y, aux[None]
 
     token_axes = ("dp", "fsdp", "ep")
     y, aux = jax.shard_map(
         shard_fn, mesh=mesh,
-        in_specs=(moe_in_specs(), P(token_axes)),
+        in_specs=(moe_in_specs(), P(token_axes), P(token_axes)),
         out_specs=(P(token_axes), P()),
         check_vma=False,
-    )(params, x)
+    )(params, x, token_mask)
     return y, aux[0]
 
 
@@ -172,16 +184,22 @@ def moe_in_specs() -> Any:
             "w_out": P("ep"), "b_out": P("ep")}
 
 
-def moe_reference(params: dict, x: Any) -> Any:
-    """Dense oracle: every token through its top-1 expert, no capacity,
-    no parallelism — what :func:`moe_apply` must reproduce when capacity
-    is ample."""
+def moe_dense(params: dict, x: Any, token_mask: Any = None
+              ) -> tuple[Any, Any]:
+    """Dense top-1 MoE: every token through its argmax expert, no
+    capacity, no parallelism. Returns ``(y, aux)`` with the same Switch
+    load-balance aux as :func:`moe_apply` — the single-device execution
+    path for MoE models (and the oracle the parallel path must match
+    when capacity is ample). ``token_mask`` as in :func:`moe_apply`:
+    masked tokens output zero and are excluded from the aux statistics."""
     import jax
     import jax.numpy as jnp
 
+    m = (jnp.ones((x.shape[0],), jnp.float32) if token_mask is None
+         else token_mask.astype(jnp.float32))
     probs = jax.nn.softmax(x @ params["gate"], axis=-1)
     expert = jnp.argmax(probs, axis=-1)
-    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0] * m
     E = params["w_in"].shape[0]
     outs = []
     for e in range(E):
@@ -190,4 +208,14 @@ def moe_reference(params: dict, x: Any) -> Any:
     dense = jnp.stack(outs, axis=1)                   # [N, E, d]
     sel = jnp.take_along_axis(
         dense, expert[:, None, None].repeat(dense.shape[-1], -1), 1)[:, 0]
-    return sel * gate[:, None]
+    cnt = jnp.maximum(m.sum(), 1.0)
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32) * m[:, None]
+    frac = onehot.sum(axis=0) / cnt
+    mean_p = (probs.astype(jnp.float32) * m[:, None]).sum(axis=0) / cnt
+    aux = E * jnp.sum(frac * mean_p)
+    return sel * gate[:, None], aux
+
+
+def moe_reference(params: dict, x: Any) -> Any:
+    """Back-compat oracle wrapper: just the outputs of :func:`moe_dense`."""
+    return moe_dense(params, x)[0]

@@ -43,6 +43,9 @@ class TrainConfig:
     # fine-tuning with SGD/momentum; avoid with adam (its second-moment
     # statistics need f32). None = float32 params (default)
     param_dtype: str | None = None
+    # weight on sown "moe_aux" load-balance losses (MoE models); modules
+    # that sow nothing are unaffected
+    moe_aux_weight: float = 0.01
     seed: int = 0
     mesh_spec: Any = None            # MeshSpec | dict | None (dp over all)
     donate_state: bool = True
@@ -86,8 +89,14 @@ def make_loss(kind: str) -> Callable:
 
     if kind == "softmax_xent":
         def loss(logits, labels):
-            return optax.softmax_cross_entropy_with_integer_labels(
+            per = optax.softmax_cross_entropy_with_integer_labels(
                 logits, labels.astype(jnp.int32))
+            # per-token tasks (logits [B, L, K], labels [B, L]) reduce to
+            # one loss per example, like the other loss kinds — the masked
+            # step weights rows by a [B] vector, so [B, L] would broadcast
+            # wrongly (or only by luck when L == B)
+            return per.reshape(per.shape[0], -1).mean(axis=1) \
+                if per.ndim > 1 else per
     elif kind == "sigmoid_xent":
         def loss(logits, labels):
             z = logits
@@ -188,10 +197,35 @@ def make_train_step(module: Any, cfg: TrainConfig, mesh: Any):
                      "step": state["step"] + 1}
         return new_state, {"loss": loss}
 
+    def _forward(params, x):
+        """Apply with sown-intermediate capture: modules that sow auxiliary
+        losses (e.g. the MoE load-balance term, models/sequence.py) train
+        them through the standard Trainer instead of silently dropping
+        them (flax discards sow() into an immutable collection)."""
+        out, mut = module.apply({"params": params}, x, train=True,
+                                mutable=["intermediates"])
+        from collections.abc import Mapping
+
+        aux = jnp.zeros((), jnp.float32)
+        inter = mut.get("intermediates", {})
+
+        def walk(node):
+            nonlocal aux
+            if isinstance(node, Mapping):  # dict or flax FrozenDict
+                for k, v in node.items():
+                    if k == "moe_aux":
+                        for leaf in jax.tree_util.tree_leaves(v):
+                            aux = aux + jnp.mean(leaf)
+                    else:
+                        walk(v)
+
+        walk(inter)
+        return out, aux
+
     def _step(state, x, y):
         def compute_loss(params):
-            logits = module.apply({"params": params}, x, train=True)
-            return loss_fn(logits, y).mean()
+            logits, aux = _forward(params, x)
+            return loss_fn(logits, y).mean() + cfg.moe_aux_weight * aux
 
         loss, grads = jax.value_and_grad(compute_loss)(state["params"])
         return _update(state, loss, grads)
@@ -202,9 +236,10 @@ def make_train_step(module: Any, cfg: TrainConfig, mesh: Any):
         # clamped denominator makes an all-zero-weight batch (multi-host
         # filler between liveness syncs) an exact no-op instead of 0/0 NaN
         def compute_loss(params):
-            logits = module.apply({"params": params}, x, train=True)
+            logits, aux = _forward(params, x)
             per = loss_fn(logits, y)
-            return (per * w).sum() / jnp.maximum(w.sum(), 1e-6)
+            return ((per * w).sum() / jnp.maximum(w.sum(), 1e-6)
+                    + cfg.moe_aux_weight * aux)
 
         loss, grads = jax.value_and_grad(compute_loss)(state["params"])
         return _update(state, loss, grads)
