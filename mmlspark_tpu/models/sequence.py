@@ -77,6 +77,11 @@ class TransformerTagger(nn.Module):
     # all-to-all dispatch with the SAME params. Per-layer load-balance
     # aux losses are sown under intermediates/"moe_aux"
     moe_experts: int = 0
+    # when set and no explicit mask is passed, tokens equal to this id
+    # are treated as padding (the bucketing helpers pad with 0) — how
+    # padding-awareness reaches callers that can't thread a mask kwarg,
+    # e.g. Trainer.fit_arrays feeding plain (tokens, tags) batches
+    pad_token_id: int | None = None
 
     OUTPUT_NAMES = ("features", "logits")
 
@@ -90,6 +95,8 @@ class TransformerTagger(nn.Module):
         # causal-configured model stays causal on the sequence-parallel
         # path — ring_attention/ulysses_attention take the same kwargs.
         B, L = tokens.shape
+        if mask is None and self.pad_token_id is not None:
+            mask = tokens.astype(jnp.int32) != self.pad_token_id
         x = nn.Embed(self.vocab_size, self.embed_dim, name="embed")(
             tokens.astype(jnp.int32))
         pos = self.param("pos_embed", nn.initializers.normal(0.02),

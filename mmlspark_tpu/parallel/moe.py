@@ -107,8 +107,8 @@ def moe_apply(params: dict, x: Any, mesh, capacity_factor: float = 2.0,
     C = max(1, int(np.ceil(capacity_factor * n_local / E)))
     e_local = E // ep
     if token_mask is None:
-        import jax.numpy as _jnp
-        token_mask = _jnp.ones((N,), _jnp.float32)
+        token_mask = jnp.ones((N,), jnp.float32)
+    token_axes = ("dp", "fsdp", "ep")
 
     def shard_fn(p, xs, m):
         # xs: [n_local, d] this shard's tokens; m: [n_local] 0/1 mask
@@ -159,7 +159,6 @@ def moe_apply(params: dict, x: Any, mesh, capacity_factor: float = 2.0,
              * gate.astype(jnp.float32)[:, None]).astype(xs.dtype)
         # Switch load-balance loss over REAL tokens only: global masked
         # means via psum of (numerator, count)
-        token_axes = ("dp", "fsdp", "ep")
         cnt = jnp.maximum(jax.lax.psum(m.sum(), token_axes), 1.0)
         frac = jax.lax.psum(onehot.sum(axis=0), token_axes) / cnt
         mean_p = jax.lax.psum(
@@ -168,7 +167,6 @@ def moe_apply(params: dict, x: Any, mesh, capacity_factor: float = 2.0,
         aux = E * jnp.sum(frac * mean_p)
         return y, aux[None]
 
-    token_axes = ("dp", "fsdp", "ep")
     y, aux = jax.shard_map(
         shard_fn, mesh=mesh,
         in_specs=(moe_in_specs(), P(token_axes), P(token_axes)),

@@ -199,3 +199,51 @@ def test_padding_tokens_cannot_claim_capacity(setup):
     # aux statistics exclude pads: the masked parallel aux matches the
     # dense aux over only the real tokens
     np.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-5)
+
+
+class TestTrainerIntegration:
+    """The standard Trainer must train MoE models correctly: the sown
+    load-balance aux reaches gradients, and pad-token routing is masked
+    via the model's pad_token_id (Trainer batches carry no mask kwarg)."""
+
+    def _tagger(self, **kw):
+        from mmlspark_tpu.models.sequence import TransformerTagger
+        return TransformerTagger(vocab_size=64, embed_dim=16, num_heads=2,
+                                 num_layers=1, mlp_dim=32, num_tags=4,
+                                 max_len=12, moe_experts=4, **kw)
+
+    def test_aux_loss_reaches_trainer_gradients(self):
+        """moe_aux_weight must change the training walk — if the Trainer's
+        intermediate capture silently broke (flax dict-type drift, sow key
+        rename), the two runs would be identical."""
+        from mmlspark_tpu.train import TrainConfig, Trainer
+        r = np.random.default_rng(0)
+        toks = r.integers(1, 64, (48, 12)).astype(np.int32)
+        tags = (toks % 4).astype(np.int64)
+        hist = {}
+        for w in (0.0, 0.5):
+            tr = Trainer(self._tagger(), TrainConfig(
+                batch_size=16, epochs=2, log_every=1, learning_rate=3e-3,
+                moe_aux_weight=w))
+            tr.fit_arrays(toks, tags)
+            hist[w] = tr.history
+        assert hist[0.0] != hist[0.5], \
+            "aux weight had no effect — the Trainer dropped the sown aux"
+        assert hist[0.5][-1] < hist[0.5][0]
+
+    def test_pad_token_id_masks_routing_through_trainer_path(self):
+        """With pad_token_id set, a padded batch's real-token logits are
+        identical however much padding the bucket added — through plain
+        model.apply with NO mask kwarg (the Trainer calling convention)."""
+        model = self._tagger(pad_token_id=0)
+        r = np.random.default_rng(1)
+        sent = r.integers(1, 64, (4, 6)).astype(np.int32)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.asarray(np.zeros((1, 6), np.int32)))["params"]
+        outs = {}
+        for L in (8, 12):
+            padded = np.zeros((4, L), np.int32)
+            padded[:, :6] = sent
+            lg = model.apply({"params": params}, jnp.asarray(padded))
+            outs[L] = np.asarray(lg)[:, :6]
+        np.testing.assert_allclose(outs[8], outs[12], rtol=1e-5, atol=1e-5)

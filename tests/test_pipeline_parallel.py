@@ -136,3 +136,31 @@ def test_bad_divisibility_raises(setup):
         # host params: pipeline_apply validates L % pp before any commit
         pipeline_apply(block_fn, stacked6, jnp.asarray(x), mesh3,
                        num_microbatches=2)
+
+
+def test_pipelines_real_vit_encoder_blocks():
+    """PP on a real model family: the ViT EncoderBlock (flax module)
+    pipelines over pp with stacked per-layer params and matches the
+    sequential stack — the model-integration proof, same as MoE's."""
+    from mmlspark_tpu.models.vit import EncoderBlock
+
+    block = EncoderBlock(dim=32, heads=4, mlp_dim=64, dtype=jnp.float32)
+    key = jax.random.PRNGKey(0)
+    dummy = jnp.zeros((1, 6, 32))
+    layer_params = [block.init(jax.random.fold_in(key, i), dummy)["params"]
+                    for i in range(4)]
+    stacked = stack_layer_params(layer_params)
+    mesh = make_mesh(MeshSpec(dp=2, pp=4))
+    dev = jax.device_put(stacked, pipeline_spec(mesh, stacked))
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(8, 6, 32))
+                    .astype(np.float32))
+
+    def vit_block(p, h):
+        return block.apply({"params": p}, h)
+
+    out = pipeline_apply(vit_block, dev, x, mesh, num_microbatches=2)
+    ref = x
+    for p in layer_params:
+        ref = block.apply({"params": p}, ref)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
