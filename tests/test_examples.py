@@ -96,6 +96,9 @@ def test_example_202_word2vec():
 def test_example_305_flowers_featurizer(zoo_repo):
     import flowers_featurizer_305 as ex
     out = ex.run("small", repo_dir=zoo_repo)
-    # transfer learning must beat the raw-pixel baseline decisively
-    assert out["deep_accuracy"] > 0.5, out          # chance = 0.2
+    # transfer learning must beat the raw-pixel baseline decisively.
+    # The genuinely-pretrained (digits-rgb32) backbone measures ~0.63
+    # here vs ~0.16 raw pixels; the bar sits below that with margin but
+    # well above what untrained features could pass (chance = 0.2)
+    assert out["deep_accuracy"] > 0.55, out
     assert out["deep_accuracy"] > 2 * out["raw_pixel_accuracy"], out
