@@ -7,12 +7,25 @@ image-featurizer/src/main/scala/ImageFeaturizer.scala:116-140; BASELINE
 config 3 "ResNet-50 ImageFeaturizer"). TPU-first choices:
 
 * NHWC layout, bfloat16 compute, float32 params.
-* **GroupNorm instead of BatchNorm**: batch statistics are mutable state
-  that must all-reduce across every dp replica each step — cross-host sync
-  the functional JAX train step doesn't need. GroupNorm(32) is the standard
-  stateless substitute (same parameter count/shape role) and keeps a model
-  a pure ``params`` pytree end to end (checkpoints, bundles, featurizer
-  cuts all stay trivial).
+* Three norm modes (``norm=``):
+  - ``"group"`` (train default): batch statistics are mutable state that
+    must all-reduce across every dp replica each step — cross-host sync
+    the functional JAX train step doesn't need. GroupNorm(32) is the
+    standard stateless substitute and keeps a model a pure ``params``
+    pytree end to end (checkpoints, bundles, featurizer cuts all stay
+    trivial).
+  - ``"batch"``: classic BatchNorm, matching the reference zoo's
+    pretrained ResNet-50 (a BN network — reference:
+    downloader/src/main/scala/Schema.scala:54-74). Used transiently at
+    bundle-publish time; carries a ``batch_stats`` collection.
+  - ``"none"``: the **folded inference variant** — no norm ops at all;
+    convs carry a bias. :func:`fold_batchnorm` converts a trained
+    ``"batch"`` net into this form algebraically (frozen BN statistics
+    fold into the conv weights: ``W' = W·γ/√(σ²+ε)``,
+    ``b' = β − μγ/√(σ²+ε)``), so frozen-backbone featurization pays
+    **zero** norm HBM traffic — each activation is written once by its
+    conv (bias+ReLU fused into the epilogue by XLA) instead of being
+    re-read for per-sample normalization.
 * Fully convolutional + global average pool, so featurization works at any
   input size the pipeline resizes to.
 
@@ -22,7 +35,11 @@ and ``logits``.
 
 from __future__ import annotations
 
+from collections.abc import Mapping
 from typing import Any, Sequence
+
+import jax
+import numpy as np
 
 import jax.numpy as jnp
 from flax import linen as nn
@@ -59,6 +76,99 @@ def _gn(name: str, groups: int, dtype: Any, impl: str, y, relu: bool = False):
     return nn.relu(y) if relu else y
 
 
+class _NormCtx:
+    """Per-site norm dispatch shared by the stem and the blocks."""
+
+    def __init__(self, norm: str, groups: int, dtype: Any, gn_impl: str,
+                 train: bool):
+        if norm not in ("group", "batch", "none"):
+            raise ValueError(f"unknown norm {norm!r}; one of "
+                             "['group', 'batch', 'none']")
+        self.norm, self.groups, self.dtype = norm, groups, dtype
+        self.gn_impl, self.train = gn_impl, train
+
+    @property
+    def conv_bias(self) -> bool:
+        # folded nets carry the (BN-derived) bias on the conv itself
+        return self.norm == "none"
+
+    def __call__(self, site: str, y, relu: bool = False):
+        """``site`` is the conv name; norm params live at its paired name
+        (conv1→gn1/bn1, proj→gn_proj/bn_proj, conv_stem→gn_stem/bn_stem)."""
+        if self.norm == "none":
+            return nn.relu(y) if relu else y
+        pair = _NORM_PAIRS[site]
+        if self.norm == "batch":
+            y = nn.BatchNorm(use_running_average=not self.train,
+                             momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                             name="bn" + pair)(y)
+            return nn.relu(y) if relu else y
+        groups = min(self.groups, y.shape[-1])
+        return _gn("gn" + pair, groups, self.dtype, self.gn_impl, y, relu)
+
+
+# conv site -> norm-name suffix ("gn"/"bn" + suffix)
+_NORM_PAIRS = {"conv_stem": "_stem", "conv1": "1", "conv2": "2",
+               "conv3": "3", "proj": "_proj"}
+
+
+class _S2DStem(nn.Module):
+    """The 7×7/s2 RGB stem in space-to-depth form — numerically identical,
+    MXU-shaped (the MLPerf-TPU ResNet trick).
+
+    A direct stem conv contracts over just 7·7·3 = 147 taps of 3-channel
+    input — the MXU's 128 input lanes run 3/128 full. Space-to-depth by 2
+    turns the same op into a 4×4 stride-1 conv over a 12-channel grid
+    (contraction 192, lanes 12/128 → 4× denser, half the spatial extent).
+    Parameters keep the standard ``nn.Conv`` layout ((7,7,cin,F) kernel
+    [+ bias]), assembled into block form at trace time, so checkpoints are
+    interchangeable with the direct formulation; zero entries encode taps
+    that fall outside the 7×7 window.
+
+    Derivation: SAME padding for k=7,s=2 on even H pads (2,3), so
+    ``out[i,j] = Σ_{a,b∈[0,7)} in[2i+a−2, 2j+b−2]·W[a,b]``. With the s2d
+    grid ``S[p,q,(u,v,c)] = in[2p+u, 2q+v, c]`` the raw row 2i+a−2 is s2d
+    row ``i+dp, u`` with ``a = 2dp+u+2``, dp ∈ [−1,2] — a 4×4 window at
+    stride 1 with padding (1,2).
+    """
+
+    features: int
+    use_bias: bool
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        cin, F = x.shape[-1], self.features
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (7, 7, cin, F))
+        bias = (self.param("bias", nn.initializers.zeros, (F,))
+                if self.use_bias else None)
+        B, H, W = x.shape[0], x.shape[1], x.shape[2]
+        if H % 2 or W % 2:
+            raise ValueError(f"_S2DStem needs even H/W, got {H}x{W}")
+        k = kernel.astype(self.dtype)
+        wb = jnp.zeros((4, 4, 2, 2, cin, F), self.dtype)
+        for dp in range(-1, 3):
+            for u in range(2):
+                a = 2 * dp + u + 2
+                if not 0 <= a < 7:
+                    continue
+                for dq in range(-1, 3):
+                    for v in range(2):
+                        b = 2 * dq + v + 2
+                        if not 0 <= b < 7:
+                            continue
+                        wb = wb.at[dp + 1, dq + 1, u, v].set(k[a, b])
+        wb = wb.reshape(4, 4, 4 * cin, F)
+        h, w = H // 2, W // 2
+        xs = x.astype(self.dtype).reshape(B, h, 2, w, 2, cin)
+        xs = xs.transpose(0, 1, 3, 2, 4, 5).reshape(B, h, w, 4 * cin)
+        y = jax.lax.conv_general_dilated(
+            xs, wb, window_strides=(1, 1), padding=((1, 2), (1, 2)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return y + bias.astype(self.dtype) if bias is not None else y
+
+
 class BottleneckBlock(nn.Module):
     """1×1 → 3×3 → 1×1 bottleneck with projection shortcut (ResNet v1.5:
     the stride lives on the 3×3)."""
@@ -68,25 +178,28 @@ class BottleneckBlock(nn.Module):
     groups: int = 32
     dtype: Any = jnp.bfloat16
     gn_impl: str = "xla"
+    norm: str = "group"
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, train: bool = False):
+        ctx = _NormCtx(self.norm, self.groups, self.dtype, self.gn_impl,
+                       train)
         residual = x
-        y = nn.Conv(self.filters, (1, 1), use_bias=False,
+        y = nn.Conv(self.filters, (1, 1), use_bias=ctx.conv_bias,
                     dtype=self.dtype, name="conv1")(x)
-        y = _gn("gn1", self.groups, self.dtype, self.gn_impl, y, relu=True)
+        y = ctx("conv1", y, relu=True)
         y = nn.Conv(self.filters, (3, 3), strides=(self.strides,) * 2,
-                    use_bias=False, dtype=self.dtype, name="conv2")(y)
-        y = _gn("gn2", self.groups, self.dtype, self.gn_impl, y, relu=True)
-        y = nn.Conv(4 * self.filters, (1, 1), use_bias=False,
+                    use_bias=ctx.conv_bias, dtype=self.dtype, name="conv2")(y)
+        y = ctx("conv2", y, relu=True)
+        y = nn.Conv(4 * self.filters, (1, 1), use_bias=ctx.conv_bias,
                     dtype=self.dtype, name="conv3")(y)
-        y = _gn("gn3", self.groups, self.dtype, self.gn_impl, y)
+        y = ctx("conv3", y)
         if residual.shape != y.shape:
             residual = nn.Conv(4 * self.filters, (1, 1),
-                               strides=(self.strides,) * 2, use_bias=False,
+                               strides=(self.strides,) * 2,
+                               use_bias=ctx.conv_bias,
                                dtype=self.dtype, name="proj")(x)
-            residual = _gn("gn_proj", self.groups, self.dtype,
-                           self.gn_impl, residual)
+            residual = ctx("proj", residual)
         return nn.relu(y + residual)
 
 
@@ -99,16 +212,24 @@ class ResNet(nn.Module):
     groups: int = 32
     dtype: Any = jnp.bfloat16
     gn_impl: str = "xla"   # "pallas" = fused GN+ReLU kernel (ops/group_norm)
+    norm: str = "group"    # "group" | "batch" (publish) | "none" (folded)
+    stem: str = "direct"   # "direct" | "s2d" (MXU-shaped, same params)
 
     OUTPUT_NAMES = ("features", "logits")
 
     @nn.compact
     def __call__(self, x, output: str = "logits", train: bool = False):
+        ctx = _NormCtx(self.norm, min(self.groups, self.width), self.dtype,
+                       self.gn_impl, train)
         x = x.astype(self.dtype)
-        x = nn.Conv(self.width, (7, 7), strides=(2, 2), use_bias=False,
-                    dtype=self.dtype, name="conv_stem")(x)
-        x = _gn("gn_stem", min(self.groups, self.width), self.dtype,
-                self.gn_impl, x, relu=True)
+        if self.stem == "s2d":
+            x = _S2DStem(self.width, use_bias=ctx.conv_bias,
+                         dtype=self.dtype, name="conv_stem")(x)
+        else:
+            x = nn.Conv(self.width, (7, 7), strides=(2, 2),
+                        use_bias=ctx.conv_bias,
+                        dtype=self.dtype, name="conv_stem")(x)
+        x = ctx("conv_stem", x, relu=True)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for stage, n_blocks in enumerate(self.stage_sizes):
             filters = self.width * (2 ** stage)
@@ -117,8 +238,8 @@ class ResNet(nn.Module):
                 x = BottleneckBlock(
                     filters=filters, strides=strides,
                     groups=min(self.groups, filters),
-                    dtype=self.dtype, gn_impl=self.gn_impl,
-                    name=f"stage{stage}_block{block}")(x)
+                    dtype=self.dtype, gn_impl=self.gn_impl, norm=self.norm,
+                    name=f"stage{stage}_block{block}")(x, train=train)
         x = jnp.mean(x, axis=(1, 2))  # global average pool
         features = x.astype(jnp.float32)
         if output == "features":
@@ -128,13 +249,66 @@ class ResNet(nn.Module):
 
 
 def resnet50(num_classes: int = 1000, dtype: Any = jnp.bfloat16,
-             gn_impl: str = "xla") -> ResNet:
+             gn_impl: str = "xla", norm: str = "group",
+             stem: str = "direct") -> ResNet:
     return ResNet(num_classes=num_classes, stage_sizes=(3, 4, 6, 3),
-                  dtype=dtype, gn_impl=gn_impl)
+                  dtype=dtype, gn_impl=gn_impl, norm=norm, stem=stem)
 
 
 def resnet18_thin(num_classes: int = 10, width: int = 16,
-                  dtype: Any = jnp.bfloat16, gn_impl: str = "xla") -> ResNet:
+                  dtype: Any = jnp.bfloat16, gn_impl: str = "xla",
+                  norm: str = "group", stem: str = "direct") -> ResNet:
     """Small same-shape-family net for tests/CI (bottleneck (2,2) stages)."""
     return ResNet(num_classes=num_classes, stage_sizes=(2, 2), width=width,
-                  groups=8, dtype=dtype, gn_impl=gn_impl)
+                  groups=8, dtype=dtype, gn_impl=gn_impl, norm=norm,
+                  stem=stem)
+
+
+# ---- frozen-BN folding (inference variant) --------------------------------
+
+def fold_batchnorm(variables: Any, eps: float = 1e-5,
+                   param_dtype: Any = None) -> Any:
+    """Fold a trained ``norm="batch"`` ResNet's frozen BN statistics into
+    its conv weights, producing the params tree of the same architecture
+    with ``norm="none"``.
+
+    For conv ``W`` (no bias) followed by BN ``(γ, β, μ, σ²)`` in inference
+    mode::
+
+        y = γ·(Wx − μ)/√(σ²+ε) + β  =  (W·γ/√(σ²+ε))·x + (β − μγ/√(σ²+ε))
+
+    so the folded net computes *identical* math with zero norm ops — the
+    reference's zoo ResNet-50 is exactly such a BN network whose inference
+    cost folds away (reference: downloader/src/main/scala/Schema.scala:54-74,
+    ImageFeaturizer.scala:116-140). ``param_dtype`` optionally casts the
+    folded params (bf16 halves inference HBM weight traffic).
+    """
+    params, stats = variables["params"], variables["batch_stats"]
+
+    def fold(p: dict, s: dict) -> dict:
+        out = {}
+        for key, val in p.items():
+            if key.startswith("bn"):
+                continue  # consumed by its conv
+            bn_key = "bn" + _NORM_PAIRS.get(key, "?") if key in _NORM_PAIRS \
+                else None
+            if bn_key and bn_key in p:
+                bn, st = p[bn_key], s[bn_key]
+                inv = np.asarray(bn["scale"], np.float64) / np.sqrt(
+                    np.asarray(st["var"], np.float64) + eps)
+                kernel = np.asarray(val["kernel"], np.float64) * inv
+                bias = (np.asarray(bn["bias"], np.float64)
+                        - np.asarray(st["mean"], np.float64) * inv)
+                out[key] = {"kernel": jnp.asarray(kernel, jnp.float32),
+                            "bias": jnp.asarray(bias, jnp.float32)}
+            elif isinstance(val, Mapping):
+                out[key] = fold(val, s.get(key, {}))
+            else:
+                out[key] = val
+        return out
+
+    folded = fold(params, stats)
+    if param_dtype is not None:
+        folded = jax.tree_util.tree_map(
+            lambda a: jnp.asarray(a, param_dtype), folded)
+    return folded

@@ -114,6 +114,78 @@ def _train_eval(bundle, xtr, ytr, xte, yte, steps: int = 300,
     return bundle, acc
 
 
+def _train_bn_and_fold(xtr, ytr, xte, yte, steps: int = 200, bs: int = 128,
+                       lr: float = 1e-3):
+    """The reference-parity zoo flow: train a *BatchNorm* ResNet (the
+    reference zoo's ResNet-50 is a BN network, Schema.scala:54-74), then
+    fold the frozen statistics into the conv weights at publish time
+    (models/resnet.py:fold_batchnorm) and publish the norm-free inference
+    bundle. The recorded accuracy is measured on the FOLDED net — the
+    artifact users download."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from mmlspark_tpu.models.bundle import PREPROCESSORS, ModelBundle
+    from mmlspark_tpu.models.resnet import fold_batchnorm, resnet18_thin
+
+    module = resnet18_thin(norm="batch")
+    pre = PREPROCESSORS["imagenet_norm"]
+    variables = module.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 32, 32, 3), jnp.float32))
+    params, stats = variables["params"], variables["batch_stats"]
+    tx = optax.adam(lr)
+    opt = tx.init(params)
+
+    def loss_fn(p, st, xb, yb):
+        logits, new_state = module.apply(
+            {"params": p, "batch_stats": st}, pre(xb), output="logits",
+            train=True, mutable=["batch_stats"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, yb).mean()
+        return loss, new_state["batch_stats"]
+
+    @jax.jit
+    def step(p, st, o, xb, yb):
+        (l, st), g = jax.value_and_grad(loss_fn, has_aux=True)(p, st, xb, yb)
+        up, o = tx.update(g, o)
+        return optax.apply_updates(p, up), st, o, l
+
+    bs = min(bs, len(xtr))
+    r = np.random.default_rng(0)
+    per_epoch = max(1, len(xtr) // bs)
+    order = None
+    first = last = None
+    for i in range(steps):
+        if i % per_epoch == 0:
+            order = r.permutation(len(xtr))
+        s = (i % per_epoch) * bs
+        idx = order[s:s + bs]
+        params, stats, opt, l = step(params, stats, opt, xtr[idx], ytr[idx])
+        first = first if first is not None else float(l)
+        last = float(l)
+
+    folded = fold_batchnorm({"params": params, "batch_stats": stats},
+                            param_dtype=jnp.bfloat16)
+    # publish with the MXU-shaped s2d stem — same param layout (parity
+    # tested in tests/test_models.py::test_s2d_stem_matches_direct_stem)
+    net = resnet18_thin(norm="none", stem="s2d")
+    bundle = ModelBundle(module=net, params=folded, input_spec=(32, 32, 3),
+                         output_names=type(net).OUTPUT_NAMES,
+                         preprocess="imagenet_norm",
+                         name="ResNet_Small_Infer")
+
+    jeval = jax.jit(lambda p, xb: net.apply({"params": p}, pre(xb),
+                                            output="logits"))
+    preds = []
+    for s in range(0, len(xte), 256):
+        preds.append(np.asarray(jeval(folded, xte[s:s + 256])).argmax(-1))
+    acc = float((np.concatenate(preds) == yte).mean())
+    print(f"  ResNet_Small_Infer: loss {first:.3f} -> {last:.3f} "
+          f"({steps} steps), folded held-out accuracy {acc:.3f}")
+    return bundle, acc
+
+
 def _class_blobs(n, shape, n_classes, seed=0):
     """Deterministic learnable image task (kept for the full-size
     stand-ins): class-dependent mean shift."""
@@ -156,6 +228,10 @@ def build(repo_dir: str, scale: str = "small") -> list:
     b = get_model("ResNet_Small", num_classes=10)
     b, acc = _train_eval(b, xtr, ytr, xte, yte)
     publish(b, "digits-rgb32", "ResNet", 18, "accuracy", acc)
+
+    print("ResNet_Small_Infer (publish-time frozen-BN fold) — digits-rgb32")
+    b, acc = _train_bn_and_fold(xtr, ytr, xte, yte)
+    publish(b, "digits-rgb32", "ResNet-folded", 18, "accuracy", acc)
 
     print("ViT_Tiny (CI-scale ViT family) — digits-rgb32")
     b = get_model("ViT_Tiny", num_classes=10)

@@ -283,3 +283,81 @@ def test_make_mesh_explicit_spec_uses_device_prefix():
     assert mesh.devices.size == 1
     mesh2 = make_mesh(MeshSpec(dp=2))
     assert mesh2.devices.size == 2
+
+
+# ---- frozen-BN fold (the ResNet inference variant) ----
+
+def test_fold_batchnorm_numerics_parity():
+    """Folded frozen-BN net must equal the BN net in inference mode —
+    the fold is algebra, not an approximation (models/resnet.py). Stats
+    are perturbed away from the init (mean 0 / var 1) so the fold is
+    non-trivial."""
+    import jax
+    import jax.numpy as jnp
+    from mmlspark_tpu.models.resnet import fold_batchnorm, resnet18_thin
+
+    bn = resnet18_thin(norm="batch", dtype=jnp.float32)
+    r = np.random.default_rng(0)
+    x = jnp.asarray(r.normal(size=(4, 32, 32, 3)).astype(np.float32))
+    variables = bn.init(jax.random.PRNGKey(0), x)
+    rs = np.random.default_rng(1)
+    stats = jax.tree_util.tree_map(
+        lambda a: jnp.abs(a + rs.normal(size=a.shape).astype(np.float32)
+                          * 0.3) + 0.05,
+        variables["batch_stats"])
+    variables = {"params": variables["params"], "batch_stats": stats}
+
+    ref = bn.apply(variables, x, train=False)
+    folded = fold_batchnorm(variables)
+    nf = resnet18_thin(norm="none", dtype=jnp.float32)
+    got = nf.apply({"params": folded}, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_s2d_stem_matches_direct_stem():
+    """The space-to-depth stem is a layout trick: same params, same output
+    as the direct 7x7/s2 conv stem."""
+    import jax
+    import jax.numpy as jnp
+    from mmlspark_tpu.models.resnet import resnet18_thin
+
+    direct = resnet18_thin(norm="none", dtype=jnp.float32, stem="direct")
+    s2d = resnet18_thin(norm="none", dtype=jnp.float32, stem="s2d")
+    r = np.random.default_rng(0)
+    x = jnp.asarray(r.normal(size=(2, 32, 32, 3)).astype(np.float32))
+    variables = direct.init(jax.random.PRNGKey(0), x)
+    out_d = direct.apply(variables, x)
+    out_s = s2d.apply(variables, x)
+    np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_d),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_resnet_infer_zoo_bundle():
+    """The zoo inference variant: bf16 folded params, runnable end to end
+    through the bundle API, feature dim matches the train variant."""
+    import jax
+    import jax.numpy as jnp
+
+    b = get_model("ResNet_Small_Infer")
+    assert all(l.dtype == jnp.bfloat16
+               for l in jax.tree_util.tree_leaves(b.params))
+    out = b.apply(np.zeros((2, 32, 32, 3), np.float32), output="features")
+    assert out.shape == (2, 128)
+    # no norm params anywhere in the folded tree
+    flat = jax.tree_util.tree_flatten_with_path(b.params)[0]
+    names = {"/".join(str(k) for k in path) for path, _ in flat}
+    assert not any("gn" in n or "bn" in n for n in names), names
+
+
+def test_resnet_infer_featurizer_product_path():
+    """ImageFeaturizer with the folded bundle — the BASELINE config-3
+    product path (featurize via the zoo inference variant)."""
+    from mmlspark_tpu.models.image_featurizer import ImageFeaturizer
+
+    feat = ImageFeaturizer(input_col="image", output_col="features")
+    feat.set_model_by_name("ResNet_Small_Infer")
+    out = feat.transform(image_table(6))
+    mat = out.column_matrix("features")
+    assert mat.shape == (6, 128)
+    assert np.isfinite(mat).all()
