@@ -283,7 +283,7 @@ def main() -> None:
             float(fetch(s))
             t.append(time.perf_counter() - t0)
         rtt_ms = round(min(t) * 1e3, 1)
-        mm = jnp.asarray(rng.normal(size=(8192, 8192)).astype(np.float32),
+        mm = jnp.asarray(rng.standard_normal((8192, 8192), np.float32),
                          jnp.bfloat16)
         g = jax.jit(lambda a, b: a @ b)
         mdt = _bench_loop(lambda: g(mm, mm), steps=5)

@@ -260,7 +260,11 @@ class JaxModel(Transformer, HasInputCol, HasOutputCol):
                 out = fn(dev_params, jax.device_put(chunk, data))
                 out.copy_to_host_async()
                 window.append((out, valid))
-                if len(window) > inflight:
+                # drain to inflight-1 so at most max_inflight minibatch
+                # outputs are ever device-resident, matching the Param's
+                # documented HBM bound (advisor round 4: the > test kept
+                # max_inflight + 1)
+                while len(window) >= inflight:
                     o, v = window.popleft()
                     host.append(np.asarray(o)[:v])
             while window:

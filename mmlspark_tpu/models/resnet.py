@@ -222,7 +222,10 @@ class ResNet(nn.Module):
         ctx = _NormCtx(self.norm, min(self.groups, self.width), self.dtype,
                        self.gn_impl, train)
         x = x.astype(self.dtype)
-        if self.stem == "s2d":
+        # the s2d block form needs even H/W; odd inputs fall back to the
+        # direct conv — SAME param layout, so the any-input-size contract
+        # holds for every stem choice
+        if self.stem == "s2d" and x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0:
             x = _S2DStem(self.width, use_bias=ctx.conv_bias,
                          dtype=self.dtype, name="conv_stem")(x)
         else:

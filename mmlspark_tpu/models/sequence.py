@@ -77,6 +77,9 @@ class TransformerTagger(nn.Module):
     # all-to-all dispatch with the SAME params. Per-layer load-balance
     # aux losses are sown under intermediates/"moe_aux"
     moe_experts: int = 0
+    # per-expert capacity headroom for the expert-parallel dispatch
+    # (parallel/moe.py); tokens over capacity pass through the residual
+    moe_capacity_factor: float = 2.0
     # when set and no explicit mask is passed, tokens equal to this id
     # are treated as padding (the bucketing helpers pad with 0) — how
     # padding-awareness reaches callers that can't thread a mask kwarg,
@@ -131,6 +134,49 @@ class TransformerTagger(nn.Module):
         if output == "features":
             return x
         return nn.Dense(self.num_tags, name="head")(x)
+
+    def mesh_hooks(self, mesh) -> dict:
+        """Trainer integration (train/loop.py:resolve_mesh_hooks): on an
+        ``sp > 1`` mesh attention runs as the ring collective; on an
+        ``ep > 1`` mesh (with ``moe_experts > 0``) the MoE FFNs dispatch
+        expert-parallel via all-to-all, expert params sharded over ``ep``.
+        Same params as the single-device paths — parallelism is an
+        execution detail, not a model change."""
+        from jax.sharding import PartitionSpec as P
+
+        kwargs: dict = {}
+        handled: set = set()
+        rules = None
+        if mesh.shape.get("sp", 1) > 1:
+            from mmlspark_tpu.parallel.ring_attention import ring_attention
+
+            def attention_fn(q, k, v, kv_mask, causal, _mesh=mesh):
+                return ring_attention(q, k, v, _mesh, causal=causal,
+                                      kv_mask=kv_mask)
+
+            kwargs["attention_fn"] = attention_fn
+            handled.add("sp")
+        if mesh.shape.get("ep", 1) > 1 and self.moe_experts > 0:
+            from mmlspark_tpu.parallel.moe import moe_apply
+
+            def moe_fn(params, x, token_mask, _mesh=mesh):
+                return moe_apply(params, x, _mesh,
+                                 capacity_factor=self.moe_capacity_factor,
+                                 token_mask=token_mask)
+
+            kwargs["moe_fn"] = moe_fn
+            handled.add("ep")
+
+            def rules(path: str, leaf):
+                # stacked expert FFNs shard over ep on the expert axis;
+                # the gate stays under the generic rules (replicated)
+                name = path.rsplit("/", 1)[-1]
+                if name.startswith("moe") and name.endswith(
+                        ("_w_in", "_b_in", "_w_out", "_b_out")):
+                    return P("ep")
+                return None
+        return {"apply_kwargs": kwargs, "param_rules": rules,
+                "handled": handled}
 
     def _moe_ffn(self, h, i: int, moe_fn: Callable | None, mask):
         """Switch MoE FFN for layer ``i`` — params in the

@@ -101,11 +101,33 @@ class ViT(nn.Module):
     # constraint there; the flag exists for models/batches that OOM
     remat: bool = False
     attn_impl: str = "bhtd"  # see BhtdSelfAttention; "flax" = reference
+    # microbatch count when the encoder stack runs pipelined over a pp
+    # mesh (bubble fraction (pp-1)/(M+pp-1)); batch must divide by
+    # microbatches × dp extent
+    pipeline_microbatches: int = 4
 
     OUTPUT_NAMES = ("features", "logits")
 
+    def mesh_hooks(self, mesh) -> dict:
+        """Trainer integration (train/loop.py:resolve_mesh_hooks): on a
+        ``pp > 1`` mesh the encoder blocks run as the GPipe collective
+        pipeline (parallel/pipeline.py) — same per-block params (and
+        checkpoints) as the sequential stack."""
+        kwargs: dict = {}
+        handled: set = set()
+        if mesh.shape.get("pp", 1) > 1:
+            if self.depth % mesh.shape["pp"]:
+                raise ValueError(
+                    f"ViT depth {self.depth} not divisible by "
+                    f"pp={mesh.shape['pp']}")
+            kwargs["pipeline_mesh"] = mesh
+            handled.add("pp")
+        return {"apply_kwargs": kwargs, "param_rules": None,
+                "handled": handled}
+
     @nn.compact
-    def __call__(self, x, output: str = "logits", train: bool = False):
+    def __call__(self, x, output: str = "logits", train: bool = False,
+                 pipeline_mesh: Any = None):
         B, H, W, _ = x.shape
         if H % self.patch or W % self.patch:
             raise ValueError(
@@ -118,11 +140,15 @@ class ViT(nn.Module):
         pos = self.param("pos_embed", nn.initializers.normal(0.02),
                          (h * w, self.dim))
         x = x + pos[None].astype(self.dtype)
-        block_cls = nn.remat(EncoderBlock) if self.remat else EncoderBlock
-        for i in range(self.depth):
-            x = block_cls(self.dim, self.heads, self.mlp_dim,
-                          dtype=self.dtype, attn_impl=self.attn_impl,
-                          name=f"block{i}")(x)
+        if pipeline_mesh is not None and not self.is_initializing():
+            x = self._pipelined_blocks(x, pipeline_mesh)
+        else:
+            block_cls = (nn.remat(EncoderBlock) if self.remat
+                         else EncoderBlock)
+            for i in range(self.depth):
+                x = block_cls(self.dim, self.heads, self.mlp_dim,
+                              dtype=self.dtype, attn_impl=self.attn_impl,
+                              name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         x = jnp.mean(x, axis=1)  # GAP over patches
         features = x.astype(jnp.float32)
@@ -131,6 +157,39 @@ class ViT(nn.Module):
         logits = nn.Dense(self.num_classes, dtype=self.dtype,
                           name="head")(x)
         return logits.astype(jnp.float32)
+
+    def _pipelined_blocks(self, x, mesh):
+        """Run the encoder stack through the GPipe collective pipeline.
+
+        Params keep the sequential layout (``block{i}`` subtrees — so
+        checkpoints are interchangeable between pipelined and sequential
+        runs, and a pp resume of a dp run just works); they are stacked
+        on a leading layer axis at trace time and handed to
+        :func:`~mmlspark_tpu.parallel.pipeline.pipeline_apply`, which
+        reshards them over ``pp`` inside its shard_map. The re-stack costs
+        one device-local copy of the block params per step — the price of
+        a single param layout across all execution paths. Gradients flow
+        through the stack back to the per-block leaves (exact; the
+        pipeline is collective-differentiable)."""
+        from mmlspark_tpu.parallel.pipeline import (
+            pipeline_apply, stack_layer_params,
+        )
+
+        template = EncoderBlock(self.dim, self.heads, self.mlp_dim,
+                                dtype=self.dtype, attn_impl=self.attn_impl)
+        params = self.variables["params"]
+        stacked = stack_layer_params(
+            [params[f"block{i}"] for i in range(self.depth)])
+
+        def block_fn(p, h):
+            return template.apply({"params": p}, h)
+
+        if self.remat:  # honor the flag on this path too (jax.checkpoint
+            # around each block application inside the pipeline scan)
+            block_fn = jax.checkpoint(block_fn)
+
+        return pipeline_apply(block_fn, stacked, x, mesh,
+                              num_microbatches=self.pipeline_microbatches)
 
 
 def vit_b16(num_classes: int = 1000, dtype: Any = jnp.bfloat16,

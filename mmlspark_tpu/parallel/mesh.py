@@ -108,9 +108,16 @@ def replicated(mesh) -> Any:
     return NamedSharding(mesh, P())
 
 
-def param_shardings(mesh, params) -> Any:
+def param_shardings(mesh, params, rules: Any = None) -> Any:
     """Pytree of shardings for the params.
 
+    * ``rules`` (optional): ``callable(path: str, leaf) -> PartitionSpec |
+      None`` consulted FIRST — how model families place structurally
+      special params (e.g. a TransformerTagger's stacked MoE expert
+      weights over ``ep``; see ``Module.mesh_hooks`` in
+      :mod:`mmlspark_tpu.train.loop`). ``path`` is the ``/``-joined key
+      path of the leaf. Returning None falls through to the generic
+      rules below.
     * ``tp > 1``: every ≥2-D leaf's LAST (output-feature) dim shards over
       the tensor-parallel axis when divisible — column-parallel matmuls;
       GSPMD propagates the activation shardings and inserts the
@@ -120,10 +127,10 @@ def param_shardings(mesh, params) -> Any:
       (zero-style parameter sharding; XLA all-gathers for the forward and
       reduce-scatters the grads).
     * Leaves with no divisible dim — and everything on a pure-dp mesh —
-      replicate. ``pp``/``ep`` are not handled HERE because their layouts
-      are structural, not per-leaf: pipeline stages shard stacked layer
-      params via :func:`mmlspark_tpu.parallel.pipeline.pipeline_spec` and
-      MoE experts via :func:`mmlspark_tpu.parallel.moe.moe_param_spec`.
+      replicate. ``pp`` layouts are structural, not per-leaf: pipeline
+      stages shard stacked layer params via
+      :func:`mmlspark_tpu.parallel.pipeline.pipeline_spec` (the Trainer
+      re-stacks per-block params at trace time instead).
     """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -131,7 +138,12 @@ def param_shardings(mesh, params) -> Any:
     fsdp = mesh.shape["fsdp"]
     tp = mesh.shape["tp"]
 
-    def one(leaf):
+    def one(path, leaf):
+        if rules is not None:
+            spec = rules("/".join(str(getattr(k, "key", k)) for k in path),
+                         leaf)
+            if spec is not None:
+                return NamedSharding(mesh, spec)
         shape = getattr(leaf, "shape", ())
         spec: list = [None] * len(shape)
         if tp > 1 and len(shape) >= 2 and shape[-1] % tp == 0:
@@ -146,4 +158,4 @@ def param_shardings(mesh, params) -> Any:
             return NamedSharding(mesh, P())
         return NamedSharding(mesh, P(*spec))
 
-    return jax.tree_util.tree_map(one, params)
+    return jax.tree_util.tree_map_with_path(one, params)

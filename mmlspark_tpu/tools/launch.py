@@ -68,20 +68,50 @@ def _pump(stream: IO[str], rank: int, out: IO[str], tail: list[str]) -> None:
         out.flush()
 
 
+# coordinator-bind failures that justify retrying on a fresh port: the
+# _free_port() probe closes its socket before worker 0 binds it (TOCTOU —
+# another process can grab it in between, e.g. parallel CI launches)
+_BIND_RETRY_MARKERS = ("already in use", "Failed to bind", "errno 98",
+                       "EADDRINUSE")
+
+
 def launch_local(cmd: Sequence[str], num_processes: int,
                  coordinator: str | None = None,
                  cpu_devices: int | None = None,
                  grace_seconds: float = 10.0,
-                 extra_env: dict[str, str] | None = None) -> int:
+                 extra_env: dict[str, str] | None = None,
+                 port_retries: int = 3) -> int:
     """Start ``num_processes`` copies of ``cmd`` on this host and wait.
 
     Returns the exit code: 0 if every worker succeeded, else the first
     failing worker's code (the rest are terminated). The reference's only
     failure handling was an exit-code check on the single external CNTK
     process (cntk-train/src/main/scala/CNTKLearner.scala:147-151); here the
-    check spans the whole worker set.
-    """
-    coordinator = coordinator or f"localhost:{_free_port()}"
+    check spans the whole worker set. When the coordinator port was
+    auto-picked, a coordinator bind failure retries the whole launch on a
+    fresh port (advisor round 4: the free-port probe is racy)."""
+    auto_port = coordinator is None
+    attempts = port_retries if auto_port else 1
+    for attempt in range(attempts):
+        code, bind_failed = _launch_local_once(
+            cmd, num_processes, coordinator or f"localhost:{_free_port()}",
+            cpu_devices, grace_seconds, extra_env)
+        if code == 0 or not (auto_port and bind_failed):
+            return code
+        if attempt + 1 < attempts:
+            sys.stderr.write(
+                f"coordinator bind failed (attempt {attempt + 1}/"
+                f"{attempts}); retrying on a fresh port\n")
+    return code
+
+
+def _launch_local_once(cmd: Sequence[str], num_processes: int,
+                       coordinator: str,
+                       cpu_devices: int | None = None,
+                       grace_seconds: float = 10.0,
+                       extra_env: dict[str, str] | None = None
+                       ) -> tuple[int, bool]:
+    """One launch attempt; returns (exit_code, coordinator_bind_failed)."""
     procs: list[subprocess.Popen] = []
     tails: list[list[str]] = []
     threads = []
@@ -147,13 +177,15 @@ def launch_local(cmd: Sequence[str], num_processes: int,
         t.join(timeout=2.0)
     if failed_rank is not None and failed_rank >= 0:
         code = procs[failed_rank].returncode
+        tail_text = "".join(tails[failed_rank])
         sys.stderr.write(
             f"worker {failed_rank} exited with code {code}; last output:\n"
             + "".join(f"  {ln}" for ln in tails[failed_rank][-15:]))
-        return code or 1
+        bind_failed = any(m in tail_text for m in _BIND_RETRY_MARKERS)
+        return code or 1, bind_failed
     if failed_rank == -1:
-        return 130
-    return 0
+        return 130, False
+    return 0, False
 
 
 def launch_pod(cmd: Sequence[str], coordinator: str | None,

