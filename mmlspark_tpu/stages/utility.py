@@ -74,14 +74,40 @@ class Repartition(Transformer):
 
 
 class Cacher(Transformer):
-    """Materialization marker. Columnar tables are already host-resident, so
-    caching means forcing any lazy columns to concrete arrays (a no-op today)
-    and is kept for pipeline-structure parity."""
+    """Memoizing materialization point (reference: Cacher.scala:12-38,
+    ``dataset.cache()``).
+
+    Columnar tables are host-resident, so the observable cache semantics
+    here are *memoization*: the first transform snapshots the table (a
+    defensive column copy — later in-place mutation of the input cannot
+    leak through the cache, exactly like Spark's materialized storage),
+    and repeated transforms of the SAME upstream table return the
+    identical cached object without re-copying — the re-execution
+    shield a pipeline puts above an expensive featurization."""
 
     disable = Param(default=False, doc="pass through unchanged", type_=bool)
 
+    def __getstate__(self):
+        d = self.__dict__.copy()
+        d.pop("_cache", None)  # runtime memo, not part of the stage state
+        return d
+
     def transform(self, table: DataTable) -> DataTable:
-        return table
+        if self.disable:
+            return table
+        cached = self.__dict__.get("_cache")
+        # weakref key: the cache must not PIN the upstream table alive
+        # (that would hold two full copies for the stage's lifetime); a
+        # dead referent can't collide with a new table's identity either
+        if cached is not None and cached[0]() is table:
+            return cached[1]
+        import numpy as np
+        snap = DataTable({k: np.copy(table[k]) for k in table.columns},
+                         meta=table.meta)
+        snap.num_partitions = table.num_partitions
+        import weakref
+        self.__dict__["_cache"] = (weakref.ref(table), snap)
+        return snap
 
 
 class CheckpointData(Transformer):

@@ -71,8 +71,8 @@ def _pump(stream: IO[str], rank: int, out: IO[str], tail: list[str]) -> None:
 # coordinator-bind failures that justify retrying on a fresh port: the
 # _free_port() probe closes its socket before worker 0 binds it (TOCTOU —
 # another process can grab it in between, e.g. parallel CI launches)
-_BIND_RETRY_MARKERS = ("already in use", "Failed to bind", "errno 98",
-                       "EADDRINUSE")
+_BIND_RETRY_MARKERS = ("already in use", "failed to bind", "errno 98",
+                       "eaddrinuse")  # matched case-insensitively
 
 
 def launch_local(cmd: Sequence[str], num_processes: int,
@@ -91,7 +91,7 @@ def launch_local(cmd: Sequence[str], num_processes: int,
     auto-picked, a coordinator bind failure retries the whole launch on a
     fresh port (advisor round 4: the free-port probe is racy)."""
     auto_port = coordinator is None
-    attempts = port_retries if auto_port else 1
+    attempts = max(1, port_retries) if auto_port else 1
     for attempt in range(attempts):
         code, bind_failed = _launch_local_once(
             cmd, num_processes, coordinator or f"localhost:{_free_port()}",
@@ -181,7 +181,8 @@ def _launch_local_once(cmd: Sequence[str], num_processes: int,
         sys.stderr.write(
             f"worker {failed_rank} exited with code {code}; last output:\n"
             + "".join(f"  {ln}" for ln in tails[failed_rank][-15:]))
-        bind_failed = any(m in tail_text for m in _BIND_RETRY_MARKERS)
+        low = tail_text.lower()
+        bind_failed = any(m in low for m in _BIND_RETRY_MARKERS)
         return code or 1, bind_failed
     if failed_rank == -1:
         return 130, False

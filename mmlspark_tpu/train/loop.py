@@ -83,9 +83,13 @@ def make_optimizer(cfg: TrainConfig):
 
 def _row_reduce(per, token_mask, jnp):
     """[B, ...] per-position losses → [B] per-example: masked mean over the
-    non-batch positions when a token mask is given, plain mean otherwise."""
+    non-batch positions when the token mask tiles the loss grid exactly
+    (per-token heads — per [B, L] vs mask [B, L]), plain mean otherwise
+    (e.g. a multi-label [B, K] head on a token-matrix input, where the pad
+    mask has nothing to say about the class axis)."""
     per = per.reshape(per.shape[0], -1)
-    if token_mask is not None:
+    if token_mask is not None and int(np.prod(token_mask.shape)) == \
+            int(np.prod(per.shape)):
         tm = token_mask.reshape(per.shape).astype(per.dtype)
         return (per * tm).sum(axis=1) / jnp.maximum(tm.sum(axis=1), 1.0)
     return per.mean(axis=1)

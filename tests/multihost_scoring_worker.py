@@ -1,0 +1,82 @@
+"""Worker script for the multi-host SCORING e2e test.
+
+The reference's *primary* parallelism is data-parallel inference across
+Spark executors (reference: cntk-model/src/main/scala/CNTKModel.scala:
+248-256). The TPU-native topology: each host process joins the
+``jax.distributed`` world, reads ONLY its own shard of the input, and
+scores it on its LOCAL device mesh (``JaxModel._mesh`` — scoring needs no
+cross-host collectives, exactly like executor-side inference). This
+worker scores its shard twice — through ``JaxModel.transform`` and
+through the Arrow offload bridge with overlap workers — and writes both
+score matrices for the launcher-driven test to merge and compare against
+a single-host run.
+"""
+
+import multihost_env  # noqa: F401  (env setup BEFORE jax import)
+
+import jax
+
+multihost_env.pin_platform()
+
+import numpy as np
+
+
+N_ROWS = 96
+
+
+def global_table(lo: int, hi: int):
+    from mmlspark_tpu.core.schema import make_image
+    from mmlspark_tpu.data.table import DataTable
+
+    r = np.random.default_rng(7)
+    imgs = r.integers(0, 255, size=(N_ROWS, 32, 32, 3)).astype(np.uint8)
+    rows = [make_image(f"img{i}", imgs[i]) for i in range(lo, hi)]
+    return DataTable({"image": rows})
+
+
+def scoring_model():
+    from mmlspark_tpu.models.jax_model import JaxModel
+    from mmlspark_tpu.models.zoo import get_model
+
+    # deterministic init (seed 0): every process and the test build the
+    # SAME params, so outputs are directly comparable
+    bundle = get_model("ConvNet_CIFAR10", widths=(8, 16), dense_width=32)
+    return JaxModel(model=bundle, input_col="image", output_col="scores",
+                    minibatch_size=16)
+
+
+def main() -> None:
+    from mmlspark_tpu.utils.env import distributed_init
+    distributed_init()
+    pid = jax.process_index()
+    nproc = jax.process_count()
+
+    lo, hi = pid * N_ROWS // nproc, (pid + 1) * N_ROWS // nproc
+    table = global_table(lo, hi)
+    jm = scoring_model()
+
+    # path 1: direct transform on the local-device DP mesh
+    scores = jm.transform(table).column_matrix("scores")
+
+    # path 2: the Arrow offload bridge (wire format + overlap workers)
+    import pyarrow as pa
+
+    from mmlspark_tpu.bridge import ArrowBatchBridge
+    from mmlspark_tpu.bridge.offload import stream_table
+    from mmlspark_tpu.data.table import DataTable
+
+    bridge = ArrowBatchBridge(jm, workers=2)
+    rbs = list(bridge.process(stream_table(table, 16)))
+    merged = DataTable.from_arrow(pa.Table.from_batches(rbs))
+    bridge_scores = merged.column_matrix("scores")
+
+    multihost_env.write_result(pid, {
+        "pid": pid, "nproc": nproc, "lo": lo, "hi": hi,
+        "n_local_devices": jax.local_device_count(),
+        "scores": np.asarray(scores, np.float64).tolist(),
+        "bridge_scores": np.asarray(bridge_scores, np.float64).tolist(),
+    }, prefix="score_out")
+
+
+if __name__ == "__main__":
+    main()

@@ -185,3 +185,34 @@ def test_worker_death_detected_and_resume_matches_uninterrupted(tmp_path):
     # uninterrupted job
     assert resumed[0]["checksum"] == pytest.approx(base[0]["checksum"],
                                                    rel=1e-6)
+
+
+SCORE_WORKER = os.path.join(REPO, "tests", "multihost_scoring_worker.py")
+
+
+def test_multihost_scoring_matches_single_host(tmp_path):
+    """Multi-host DP scoring e2e (the reference's *primary* parallelism,
+    executor-side inference, CNTKModel.scala:248-256): two launcher-started
+    processes each score only their file shard on their LOCAL device mesh;
+    the rank-order merge must equal a single-host run of the full table —
+    order-preserved, for both JaxModel.transform and the Arrow bridge."""
+    out_dir = str(tmp_path)
+    rc = _launch(SCORE_WORKER, 2, out_dir)
+    assert rc == 0, f"scoring launch failed with rc={rc}"
+    outs = _read_outs(out_dir, 2, prefix="score_out")
+    assert [o["n_local_devices"] for o in outs] == [2, 2]
+    # shards tile the table exactly, in rank order
+    assert [(o["lo"], o["hi"]) for o in outs] == [(0, 48), (48, 96)]
+    merged = np.concatenate([np.asarray(o["scores"]) for o in outs])
+    merged_bridge = np.concatenate(
+        [np.asarray(o["bridge_scores"]) for o in outs])
+
+    # single-host reference on this process's own mesh
+    import multihost_scoring_worker as sw
+    table = sw.global_table(0, sw.N_ROWS)
+    ref = sw.scoring_model().transform(table).column_matrix("scores")
+    assert merged.shape == ref.shape == (96, 10)
+    np.testing.assert_allclose(merged, np.asarray(ref, np.float64),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(merged_bridge, np.asarray(ref, np.float64),
+                               rtol=1e-5, atol=1e-5)

@@ -198,3 +198,96 @@ class TestRealPySpark:
             spark_transform(df, jm)
         assert "model expects" in str(ei.value) or "Py4J" in \
             type(ei.value).__name__
+
+
+class TestPinnedArrowContract:
+    """Version-pinned Arrow-convention contract for ``mapInArrow``.
+
+    pyspark cannot be installed in this environment (no egress; see the
+    README's "Spark integration status" section), so the exact conventions
+    Spark 3.5's ``DataFrame.mapInArrow`` imposes on the UDF are pinned
+    HERE, against the pyspark 3.5 source of truth
+    (python/pyspark/sql/pandas/{map_ops,types}.py):
+
+    1. the UDF receives ``Iterator[pyarrow.RecordBatch]`` and must yield
+       ``pyarrow.RecordBatch`` objects,
+    2. every yielded batch's schema must EQUAL the schema declared to
+       ``mapInArrow`` (Spark validates per batch; a drifting schema is a
+       job failure),
+    3. only Spark-convertible Arrow types may appear (from_arrow_type,
+       types.py): ints/floats/bool/string/binary/date/timestamp/decimal/
+       list/struct — notably NO unsigned ints wider than the signed range
+       mapping, no null-typed columns,
+    4. Python-worker calls are per-partition and independent (no shared
+       mutable state between partitions).
+
+    If a future pyspark changes these conventions, this is the one test to
+    update — and the stub engine (tests/spark_stub.py) mirrors the same
+    rules.
+    """
+
+    # Arrow type predicates Spark 3.5 from_arrow_type accepts (pinned)
+    _SPARK35_OK = (
+        pa.types.is_boolean, pa.types.is_int8, pa.types.is_int16,
+        pa.types.is_int32, pa.types.is_int64, pa.types.is_uint8,
+        pa.types.is_float32, pa.types.is_float64, pa.types.is_string,
+        pa.types.is_binary, pa.types.is_date32, pa.types.is_timestamp,
+        pa.types.is_decimal, pa.types.is_list, pa.types.is_struct,
+    )
+
+    def _assert_spark_convertible(self, typ):
+        if pa.types.is_list(typ):
+            return self._assert_spark_convertible(typ.value_type)
+        if pa.types.is_struct(typ):
+            for f in typ:
+                self._assert_spark_convertible(f.type)
+            return
+        assert any(ok(typ) for ok in self._SPARK35_OK), \
+            f"Arrow type {typ} is not Spark-3.5 convertible"
+
+    def test_yielded_batches_keep_declared_schema_and_types(self):
+        """Contract points 1-3 on the real scoring path, with an image
+        table (the struct wire format) AND a vector table."""
+        jm = make_model()
+        t = vec_table(37)
+        fn = make_map_in_arrow_fn(jm)
+        # the schema a caller would declare (spark_transform's probe path)
+        probe_schema = jm.transform(t.take(np.arange(4))).to_arrow().schema
+        outs = list(fn(stream_table(t, 10)))
+        assert outs and all(isinstance(rb, pa.RecordBatch) for rb in outs)
+        for rb in outs:
+            assert rb.schema.equals(probe_schema), \
+                f"batch schema drifted:\n{rb.schema}\nvs\n{probe_schema}"
+            for field in rb.schema:
+                self._assert_spark_convertible(field.type)
+
+    def test_image_struct_schema_is_spark_convertible(self):
+        from mmlspark_tpu.core.schema import make_image
+
+        r = np.random.default_rng(0)
+        rows = [make_image(f"i{i}", r.integers(0, 255, (8, 8, 3)))
+                for i in range(6)]
+        t = DataTable({"image": rows})
+        arrow = t.to_arrow()
+        for field in arrow.schema:
+            self._assert_spark_convertible(field.type)
+        # the ImageSchema field set is part of the wire contract
+        img = arrow.schema.field("image").type
+        assert {f.name for f in img} == {
+            "path", "height", "width", "channels", "mode", "data"}
+
+    def test_partitions_share_no_state(self):
+        """Contract point 4: scoring partition B must not disturb an
+        in-flight iterator over partition A's results."""
+        jm = make_model()
+        fn = make_map_in_arrow_fn(jm)
+        t = vec_table(24)
+        it_a = fn(stream_table(t.take(np.arange(12)), 6))
+        first_a = next(it_a)
+        outs_b = list(fn(stream_table(t.take(np.arange(12, 24)), 6)))
+        rest_a = list(it_a)
+        got = pa.Table.from_batches([first_a] + rest_a + outs_b)
+        ref = jm.transform(t).to_arrow()
+        np.testing.assert_allclose(
+            np.stack(got.column("scores").to_pylist()),
+            np.stack(ref.column("scores").to_pylist()), rtol=1e-6)

@@ -221,6 +221,26 @@ class TestUtilityStages:
             != 4
         assert len(Cacher().transform(t)) == 10
 
+    def test_cacher_memoizes_and_snapshots(self):
+        """Cacher has real cache semantics (reference Cacher.scala:12-38):
+        repeated transforms of the same table return the identical
+        memoized snapshot, and later in-place mutation of the input does
+        not leak through the cache."""
+        t = make_tabular(10)
+        c = Cacher()
+        out1 = c.transform(t)
+        out2 = c.transform(t)
+        assert out1 is out2 and out1 is not t
+        first_col = t.columns[0]
+        before = np.copy(out1[first_col])
+        t[first_col][:] = -999  # mutate the input AFTER caching
+        np.testing.assert_array_equal(out1[first_col], before)
+        # a different table is a cache miss
+        t2 = make_tabular(10)
+        assert c.transform(t2) is not out1
+        # disable passes through untouched
+        assert Cacher(disable=True).transform(t) is t
+
     def test_checkpoint_data(self, tmp_path):
         pytest.importorskip("pyarrow")
         t = DataTable({"x": np.arange(5).astype(np.float64),
