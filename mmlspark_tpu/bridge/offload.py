@@ -55,14 +55,23 @@ class ArrowBatchBridge:
     """
 
     def __init__(self, transformer: Any, prefetch: int = 4,
-                 workers: int = 1):
+                 workers: int = 2):
         self.transformer = transformer
         self.prefetch = prefetch
         # workers > 1 overlaps host marshalling/Arrow codec of batch i+1
         # with the device round-trip of batch i (the GIL releases during
-        # transfers); output order is preserved by completing futures FIFO
+        # transfers); output order is preserved by completing futures
+        # FIFO. Default 2 (round-5 verdict: overlap ON by default — the
+        # serial path cost a full device round-trip per batch with the
+        # overlap machinery sitting idle)
         self.workers = workers
         self.latencies_ms: list[float] = []
+        # per-batch marshal (Arrow→table + table→Arrow codec) vs score
+        # (transform: coerce + device round-trip) decomposition, so the
+        # p50 self-attributes: through a remote-device tunnel, score_ms
+        # ~= the fetch RTT floor and marshal_ms is the host-side cost
+        self.marshal_ms: list[float] = []
+        self.score_ms: list[float] = []
 
     def _reader(self, source: Iterable, q: "queue.Queue") -> None:
         # a mid-stream source failure must reach the consumer as the original
@@ -79,9 +88,14 @@ class ArrowBatchBridge:
     def _score_one(self, item: Any) -> Any:
         t0 = time.perf_counter()
         table = DataTable.from_arrow(item)
+        t1 = time.perf_counter()
         out = self.transformer.transform(table)
+        t2 = time.perf_counter()
         arrow_out = out.to_arrow()
-        self.latencies_ms.append((time.perf_counter() - t0) * 1e3)
+        t3 = time.perf_counter()
+        self.marshal_ms.append(((t1 - t0) + (t3 - t2)) * 1e3)
+        self.score_ms.append((t2 - t1) * 1e3)
+        self.latencies_ms.append((t3 - t0) * 1e3)
         return arrow_out
 
     def process(self, batches: Iterable) -> Iterator:
@@ -128,9 +142,21 @@ class ArrowBatchBridge:
             return None
         return float(np.percentile(self.latencies_ms, 50))
 
+    def p50_decomposition(self) -> dict[str, float] | None:
+        """p50 split of the per-batch latency: ``marshal_ms`` (Arrow codec
+        both ways) vs ``score_ms`` (transform incl. the device
+        round-trip). Read against the bench's ``fetch_rtt_ms``: when
+        score_ms ≈ RTT the bridge floor is the link, not the code."""
+        if not self.latencies_ms:
+            return None
+        return {
+            "marshal_ms": float(np.percentile(self.marshal_ms, 50)),
+            "score_ms": float(np.percentile(self.score_ms, 50)),
+        }
 
-def make_map_in_arrow_fn(transformer: Any, prefetch: int = 4
-                         ) -> Callable[[Iterator], Iterator]:
+
+def make_map_in_arrow_fn(transformer: Any, prefetch: int = 4,
+                         workers: int = 2) -> Callable[[Iterator], Iterator]:
     """Build the callable for ``df.mapInArrow(fn, schema)``.
 
     Spark calls ``fn(iterator_of_record_batches)`` once per partition inside
@@ -141,7 +167,8 @@ def make_map_in_arrow_fn(transformer: Any, prefetch: int = 4
     """
 
     def fn(batches: Iterator) -> Iterator:
-        bridge = ArrowBatchBridge(transformer, prefetch=prefetch)
+        bridge = ArrowBatchBridge(transformer, prefetch=prefetch,
+                                  workers=workers)
         yield from bridge.process(batches)
 
     return fn

@@ -53,15 +53,18 @@ def output_spark_schema(df: Any, transformer: Any, sample_rows: int = 4):
 
 
 def spark_transform(df: Any, transformer: Any, prefetch: int = 4,
-                    sample_rows: int = 4) -> Any:
+                    sample_rows: int = 4, *, workers: int = 2) -> Any:
     """Score a Spark DataFrame through a fitted stage on the TPU host.
 
     Executors stream Arrow record batches into one bridge per partition;
     each bridge re-batches rows into fixed-shape padded device minibatches
     and merges scores back in row order (the CNTKModel.transform analog as
-    one line of Spark API).
+    one line of Spark API). ``workers=2`` (default) overlaps the host-side
+    Arrow codec of batch i+1 with the device round-trip of batch i —
+    order-preserving; see ``ArrowBatchBridge``.
     """
     _require_pyspark()
     schema = output_spark_schema(df, transformer, sample_rows=sample_rows)
-    return df.mapInArrow(make_map_in_arrow_fn(transformer, prefetch=prefetch),
-                         schema)
+    return df.mapInArrow(
+        make_map_in_arrow_fn(transformer, prefetch=prefetch,
+                             workers=workers), schema)

@@ -7,15 +7,17 @@ CheckpointData.scala:47-113) and ``src/multi-column-adapter/``
 (MultiColumnAdapter.scala:17-134).
 
 Spark-specific semantics (persist storage levels, shuffle repartition) map to
-their host-memory analogs: materialization is a no-op marker or an explicit
-on-disk parquet/npz checkpoint; repartition sets the partition hint used by
-host-parallel stages.
+their host-memory analogs: caching is a memoized snapshot, checkpointing an
+explicit on-disk parquet round-trip; repartition sets the partition hint used
+by host-parallel stages.
 """
 
 from __future__ import annotations
 
+import copy
 import os
 import time
+import weakref
 from typing import Any
 
 import numpy as np
@@ -101,11 +103,17 @@ class Cacher(Transformer):
         # dead referent can't collide with a new table's identity either
         if cached is not None and cached[0]() is table:
             return cached[1]
-        import numpy as np
-        snap = DataTable({k: np.copy(table[k]) for k in table.columns},
+
+        def snap_col(col):
+            # object columns (image dicts, row vectors) hold references —
+            # a shallow np.copy would let in-place row mutation leak
+            # through the cache
+            return (copy.deepcopy(col) if col.dtype == object
+                    else np.copy(col))
+
+        snap = DataTable({k: snap_col(table[k]) for k in table.columns},
                          meta=table.meta)
         snap.num_partitions = table.num_partitions
-        import weakref
         self.__dict__["_cache"] = (weakref.ref(table), snap)
         return snap
 

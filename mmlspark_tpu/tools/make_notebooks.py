@@ -1,0 +1,129 @@
+"""Generate runnable sample notebooks from the examples.
+
+The reference ships its demo surface as notebooks and executes them in CI
+(reference: notebooks/samples/, tools/notebook/tester/
+NotebookTestSuite.py:13-60). Here the single source of truth is
+``examples/*.py`` (CI-executed scripts); this tool derives the notebook
+form deterministically so the two can never drift:
+
+* the module docstring becomes the title/markdown cell,
+* top-level code splits into cells at double-blank-line boundaries (the
+  PEP-8 seam between top-level definitions),
+* the ``if __name__ == "__main__"`` guard stays — notebook kernels run
+  with ``__name__ == "__main__"``, so the notebook executes exactly the
+  script's entry path.
+
+``tests/test_notebooks.py`` regenerates the set to assert freshness and
+executes every notebook through a real kernel (nbclient) in the full CI
+lane; the Docker image COPYs ``notebooks/`` so its jupyter entry opens
+these.
+
+Usage: python -m mmlspark_tpu.tools.make_notebooks [out_dir]
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+EXAMPLE_TITLES = {
+    "tabular_classification_101": "101 - Tabular Classification",
+    "flight_delay_regression_102": "102 - Regression with TrainRegressor",
+    "before_after_103": "103 - Pipelines Before and After",
+    "book_reviews_text_201": "201 - Text Featurization",
+    "book_reviews_word2vec_202": "202 - Word2Vec Embeddings",
+    "cifar_eval_301": "301 - CIFAR-10 CNN Evaluation",
+    "image_transforms_302": "302 - Image Transforms",
+    "transfer_learning_303": "303 - Transfer Learning",
+    "medical_entity_304": "304 - Medical Entity Extraction",
+    "flowers_featurizer_305": "305 - Flowers Featurization",
+}
+
+
+def _split_cells(source: str) -> list[str]:
+    """Split top-level code at 2+ blank-line seams (PEP-8 boundaries),
+    keeping multi-line statements intact (the seam must sit at depth 0)."""
+    lines = source.split("\n")
+    # depth-0 line index set via ast: any line inside a top-level node's
+    # span is not a seam
+    tree = ast.parse(source)
+    covered = set()
+    for node in tree.body:
+        end = getattr(node, "end_lineno", node.lineno)
+        covered.update(range(node.lineno, end + 1))
+    cells: list[list[str]] = [[]]
+    blanks = 0
+    for i, line in enumerate(lines, start=1):
+        if not line.strip() and i not in covered:
+            blanks += 1
+            if blanks >= 2 and cells[-1]:
+                cells.append([])
+                blanks = 0
+            continue
+        if line.strip():
+            blanks = 0
+        cells[-1].append(line)
+    return ["\n".join(c).strip("\n") for c in cells if "".join(c).strip()]
+
+
+def make_notebook(example_path: str):
+    import nbformat
+
+    with open(example_path) as f:
+        source = f.read()
+    tree = ast.parse(source)
+    doc = ast.get_docstring(tree) or ""
+    # strip the docstring node from the code body
+    body_start = 0
+    if (tree.body and isinstance(tree.body[0], ast.Expr)
+            and isinstance(tree.body[0].value, ast.Constant)):
+        body_start = tree.body[0].end_lineno
+    code = "\n".join(source.split("\n")[body_start:]).strip("\n")
+
+    stem = os.path.splitext(os.path.basename(example_path))[0]
+    title = EXAMPLE_TITLES.get(stem, stem)
+    nb = nbformat.v4.new_notebook()
+    nb.metadata["kernelspec"] = {"name": "python3",
+                                 "display_name": "Python 3",
+                                 "language": "python"}
+    md = f"# {title}\n\n" + doc + (
+        f"\n\n*Generated from `examples/{stem}.py` by "
+        "`mmlspark_tpu.tools.make_notebooks` — edit the example, then "
+        "regenerate.*")
+    nb.cells.append(nbformat.v4.new_markdown_cell(md))
+    for cell_src in _split_cells(code):
+        nb.cells.append(nbformat.v4.new_code_cell(cell_src))
+    return stem, title, nb
+
+
+def build(out_dir: str, examples_dir: str | None = None) -> list[str]:
+    import nbformat
+
+    examples_dir = examples_dir or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "examples")
+    os.makedirs(out_dir, exist_ok=True)
+    written = []
+    for fname in sorted(os.listdir(examples_dir)):
+        if not fname.endswith(".py"):
+            continue
+        stem, title, nb = make_notebook(os.path.join(examples_dir, fname))
+        path = os.path.join(out_dir, f"{title}.ipynb")
+        nbformat.write(nb, path)
+        written.append(path)
+    return written
+
+
+def main(argv=None) -> int:
+    args = list(argv if argv is not None else sys.argv[1:])
+    out = args[0] if args else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "notebooks", "samples")
+    for p in build(out):
+        print(p)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -82,17 +82,26 @@ def make_optimizer(cfg: TrainConfig):
 
 
 def _row_reduce(per, token_mask, jnp):
-    """[B, ...] per-position losses → [B] per-example: masked mean over the
-    non-batch positions when the token mask tiles the loss grid exactly
-    (per-token heads — per [B, L] vs mask [B, L]), plain mean otherwise
-    (e.g. a multi-label [B, K] head on a token-matrix input, where the pad
-    mask has nothing to say about the class axis)."""
-    per = per.reshape(per.shape[0], -1)
-    if token_mask is not None and int(np.prod(token_mask.shape)) == \
-            int(np.prod(per.shape)):
-        tm = token_mask.reshape(per.shape).astype(per.dtype)
-        return (per * tm).sum(axis=1) / jnp.maximum(tm.sum(axis=1), 1.0)
-    return per.mean(axis=1)
+    """[B, ...] per-position losses → [B] per-example.
+
+    With a ``token_mask`` ([B, L]): masked mean — the mask must match the
+    loss grid's leading dims exactly and broadcasts over any trailing
+    (class) axes, so a per-token multi-label head ([B, L, K]) masks pad
+    positions across all K classes. A mask that tiles neither way is a
+    loud error, never a silent plain mean."""
+    if token_mask is not None:
+        if token_mask.shape == per.shape[:token_mask.ndim]:
+            tm = token_mask.reshape(
+                token_mask.shape + (1,) * (per.ndim - token_mask.ndim))
+            tm = jnp.broadcast_to(tm, per.shape).astype(per.dtype)
+        else:
+            raise ValueError(
+                f"token_mask shape {tuple(token_mask.shape)} does not "
+                f"tile per-position loss shape {tuple(per.shape)}")
+        per = (per * tm).reshape(per.shape[0], -1)
+        tm = tm.reshape(per.shape)
+        return per.sum(axis=1) / jnp.maximum(tm.sum(axis=1), 1.0)
+    return per.reshape(per.shape[0], -1).mean(axis=1)
 
 
 def make_loss(kind: str) -> Callable:

@@ -232,6 +232,7 @@ def test_graft_entry_single():
     assert out.shape == (8, 10)
 
 
+@pytest.mark.slow  # the driver runs dryrun_multichip separately too
 def test_graft_entry_multichip():
     import __graft_entry__ as ge
     ge.dryrun_multichip(8)

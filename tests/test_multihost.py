@@ -21,6 +21,9 @@ import time
 import numpy as np
 import pytest
 
+pytestmark = [pytest.mark.slow, pytest.mark.multihost]
+
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "multihost_worker.py")
 FAIL_WORKER = os.path.join(REPO, "tests", "multihost_failure_worker.py")

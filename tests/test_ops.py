@@ -78,6 +78,7 @@ class TestVmemGate:
 
 
 class TestResNetWiring:
+    @pytest.mark.slow
     def test_pallas_gn_params_are_checkpoint_compatible(self):
         """gn_impl='pallas' must produce the identical param tree as the
         default, so published bundles load into either variant."""

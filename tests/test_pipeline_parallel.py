@@ -67,6 +67,7 @@ def test_forward_matches_sequential(setup, spec, micro):
                                rtol=1e-6, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_gradients_match_sequential(setup):
     layers, stacked, x = setup
     mesh = make_mesh(MeshSpec(dp=2, pp=4))
@@ -138,6 +139,7 @@ def test_bad_divisibility_raises(setup):
                        num_microbatches=2)
 
 
+@pytest.mark.slow
 def test_pipelines_real_vit_encoder_blocks():
     """PP on a real model family: the ViT EncoderBlock (flax module)
     pipelines over pp with stacked per-layer params and matches the

@@ -32,6 +32,7 @@ def sp_mesh():
 
 
 class TestRingAttention:
+    @pytest.mark.slow
     def test_matches_reference(self, sp_mesh):
         q, k, v = qkv()
         ref = attention_reference(q, k, v)
@@ -39,6 +40,7 @@ class TestRingAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.slow
     def test_causal_matches_reference(self, sp_mesh):
         q, k, v = qkv(seed=1)
         ref = attention_reference(q, k, v, causal=True)
@@ -46,11 +48,14 @@ class TestRingAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.slow
+    @pytest.mark.slow
     def test_output_stays_sequence_sharded(self, sp_mesh):
         q, k, v = qkv()
         out = ring_attention(q, k, v, sp_mesh)
         assert "sp" in str(out.sharding.spec)
 
+    @pytest.mark.slow
     def test_long_sequence(self, sp_mesh):
         q, k, v = qkv(B=1, L=512, H=2, D=8, seed=2)
         ref = attention_reference(q, k, v)
@@ -108,6 +113,7 @@ class TestSequenceModels:
         pred = model.apply({"params": params}, toks).argmax(-1)
         assert (np.asarray(pred) == tags).mean() > 0.95
 
+    @pytest.mark.slow
     def test_transformer_tagger_ring_equals_local(self, sp_mesh):
         # the same fitted params must produce identical outputs whether
         # attention runs locally or sequence-parallel over the mesh
@@ -125,6 +131,7 @@ class TestSequenceModels:
         np.testing.assert_allclose(np.asarray(ring), np.asarray(local),
                                    rtol=2e-4, atol=2e-4)
 
+    @pytest.mark.slow
     def test_causal_model_stays_causal_on_parallel_path(self, sp_mesh):
         # a causal=True tagger must pass causality through attention_fn —
         # the sequence-parallel path must match the local causal output
@@ -152,6 +159,8 @@ class TestSequenceModels:
 
 
 class TestPaddingMasks:
+    @pytest.mark.slow
+    @pytest.mark.slow
     def test_ring_attention_kv_mask_matches_unpadded(self, sp_mesh):
         # attention over a padded sequence with kv_mask must equal attention
         # over the unpadded prefix (for the real query positions)
@@ -233,6 +242,7 @@ class TestBucketing:
         assert batches[0][0].shape == (1, 16)
 
 
+@pytest.mark.slow  # 2k-4k token oracles
 class TestLongContext:
     """Round-3: genuinely long sequences through the SP paths — the
     first-class long-context claim at lengths where a naive [L, L] score

@@ -198,6 +198,7 @@ class TestScaleBoundedStreaming:
                 z.writestr(f"img_{i:06d}.png", blobs[i % len(blobs)])
         return zpath
 
+    @pytest.mark.slow
     def test_50k_images_stream_with_bounded_rss(self, big_zip):
         from mmlspark_tpu.stages.image import ImageTransformer
 

@@ -241,6 +241,18 @@ class TestUtilityStages:
         # disable passes through untouched
         assert Cacher(disable=True).transform(t) is t
 
+    def test_cacher_deep_copies_object_columns(self):
+        """Object columns (image dicts, row vectors) hold references — the
+        snapshot must deep-copy them so in-place row mutation can't leak
+        through the cache."""
+        row = np.arange(4, dtype=np.float32)
+        t = DataTable({"vec": [row, row * 2]})
+        c = Cacher()
+        out = c.transform(t)
+        before = np.copy(out["vec"][0])
+        t["vec"][0][:] = -1  # mutate the cached input's row in place
+        np.testing.assert_array_equal(out["vec"][0], before)
+
     def test_checkpoint_data(self, tmp_path):
         pytest.importorskip("pyarrow")
         t = DataTable({"x": np.arange(5).astype(np.float64),
