@@ -1,0 +1,106 @@
+"""Example 306 — one-call distributed training across mesh axes.
+
+The reference's distributed story is a single flag: ``parallelTrain=true``
+and the launcher does the rest (reference:
+cntk-train/src/main/scala/CommandBuilders.scala:79-93,
+CNTKLearner.scala:140-151 — a single-node MPI data-parallel ring). The
+TPU-native generalization is a **device mesh**: every parallelism
+strategy is an axis of ``TrainConfig.mesh_spec``, the model's
+``mesh_hooks`` activate the right collectives, and XLA lays the
+all-reduces/all-to-alls/ppermutes onto ICI. Same params, same losses —
+parallelism is an execution detail.
+
+This example fine-tunes on the digits data three ways and shows the loss
+trajectories agree:
+
+* ``{'dp': N}``     — pure data parallelism (the reference-parity mode),
+* ``{'dp': …, 'pp': 2}`` — ViT encoder blocks pipelined across stages
+  (GPipe collective pipelining),
+* ``{'dp': …, 'ep': 2}`` — a mixture-of-experts transformer with
+  expert-parallel all-to-all token dispatch.
+
+Run on a TPU pod via ``mmlspark-tpu-launch``; on a dev box the test
+harness provides 8 virtual CPU devices.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def digits_images(n: int = 128):
+    """Real data without egress: sklearn digits upscaled to 16×16 RGB."""
+    from sklearn.datasets import load_digits
+
+    d = load_digits()
+    x8 = d.images.astype(np.float32) * (255.0 / 16.0)
+    x16 = np.kron(x8, np.ones((1, 2, 2), np.float32))
+    x = np.repeat(x16[..., None], 3, axis=-1)[:n]
+    y = d.target.astype(np.int64)[:n]
+    return x, y
+
+
+def fit(module, mesh_spec, x, y):
+    from mmlspark_tpu.train.loop import TrainConfig, Trainer
+
+    cfg = TrainConfig(batch_size=32, epochs=3, optimizer="adam",
+                      learning_rate=1e-3, log_every=1, seed=0,
+                      mesh_spec=mesh_spec)
+    t = Trainer(module, cfg)
+    t.fit_arrays(x, y)
+    return np.asarray(t.history)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.models.sequence import TransformerTagger
+    from mmlspark_tpu.models.vit import ViT
+
+    n_dev = jax.device_count()
+    dp = max(1, n_dev // 2)
+    print(f"devices: {n_dev} ({jax.devices()[0].platform})")
+
+    x, y = digits_images()
+
+    def vit():
+        # depth 4 so it splits across 2 pipeline stages
+        return ViT(num_classes=10, patch=8, dim=32, depth=4, heads=4,
+                   mlp_dim=64, dtype=jnp.float32, pipeline_microbatches=2)
+
+    print("\n-- ViT fine-tune: dp-only vs dp x pp (pipelined blocks) --")
+    ref = fit(vit(), {"dp": dp}, x, y)
+    pp = fit(vit(), {"dp": dp, "pp": 2}, x, y)
+    drift = float(np.max(np.abs(ref - pp)))
+    print(f"dp losses   : {np.round(ref[:4], 4)} ... {ref[-1]:.4f}")
+    print(f"dp x pp     : {np.round(pp[:4], 4)} ... {pp[-1]:.4f}")
+    print(f"max |Δloss| = {drift:.2e} (pipelining is exact)")
+    assert drift < 1e-3
+
+    print("\n-- MoE tagger: dp-only (dense routing) vs dp x ep "
+          "(all-to-all dispatch) --")
+    r = np.random.default_rng(0)
+    toks = r.integers(1, 64, size=(128, 16)).astype(np.int32)
+    tags = (toks % 4).astype(np.int64)  # learnable rule
+
+    def tagger():
+        return TransformerTagger(vocab_size=64, embed_dim=16, num_heads=2,
+                                 num_layers=1, mlp_dim=32, num_tags=4,
+                                 max_len=16, moe_experts=4,
+                                 moe_capacity_factor=8.0, pad_token_id=0,
+                                 dtype=jnp.float32)
+
+    ref = fit(tagger(), {"dp": dp}, toks, tags)
+    ep = fit(tagger(), {"dp": dp, "ep": 2}, toks, tags)
+    drift = float(np.max(np.abs(ref - ep)))
+    print(f"dp losses   : {np.round(ref[:4], 4)} ... {ref[-1]:.4f}")
+    print(f"dp x ep     : {np.round(ep[:4], 4)} ... {ep[-1]:.4f}")
+    print(f"max |Δloss| = {drift:.2e} (ample capacity ⇒ matches dense)")
+    assert drift < 1e-3
+    assert ref[-1] < ref[0], "training did not descend"
+    print("\ndistributed_finetune_306: OK")
+
+
+if __name__ == "__main__":
+    main()

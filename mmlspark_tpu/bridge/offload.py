@@ -65,6 +65,13 @@ class ArrowBatchBridge:
         # serial path cost a full device round-trip per batch with the
         # overlap machinery sitting idle)
         self.workers = workers
+        # serialize the Arrow codec across workers: pyarrow array
+        # construction concurrent with another thread driving a
+        # remote-device tunnel segfaulted intermittently (see
+        # stream_table's note). The lock removes codec↔codec and
+        # codec↔tunnel concurrency while keeping the overlap that pays:
+        # one worker's device round-trip under another's wait
+        self._codec_lock = threading.Lock()
         self.latencies_ms: list[float] = []
         # per-batch marshal (Arrow→table + table→Arrow codec) vs score
         # (transform: coerce + device round-trip) decomposition, so the
@@ -87,11 +94,13 @@ class ArrowBatchBridge:
 
     def _score_one(self, item: Any) -> Any:
         t0 = time.perf_counter()
-        table = DataTable.from_arrow(item)
+        with self._codec_lock:
+            table = DataTable.from_arrow(item)
         t1 = time.perf_counter()
         out = self.transformer.transform(table)
         t2 = time.perf_counter()
-        arrow_out = out.to_arrow()
+        with self._codec_lock:
+            arrow_out = out.to_arrow()
         t3 = time.perf_counter()
         self.marshal_ms.append(((t1 - t0) + (t3 - t2)) * 1e3)
         self.score_ms.append((t2 - t1) * 1e3)

@@ -134,10 +134,12 @@ class JaxModel(Transformer, HasInputCol, HasOutputCol):
             "Minimum 2: a window of 1 would serialize fetch with compute")
 
     def __getstate__(self):
-        # jitted closures and device arrays don't pickle; drop on serialize
+        # jitted closures, device arrays, and locks don't pickle; drop on
+        # serialize
         d = self.__dict__.copy()
         d.pop("_jit_cache", None)
         d.pop("_mesh_cache", None)
+        d.pop("_jit_lock", None)
         return d
 
     def set_model_location(self, path: str) -> "JaxModel":
@@ -176,9 +178,19 @@ class JaxModel(Transformer, HasInputCol, HasOutputCol):
         One entry per (module identity, preprocess, node): the entry pins
         the module + params objects it was built from, and a params
         reassignment refreshes the device copy in place — no id-reuse false
-        hits, no unbounded growth of stale device trees."""
+        hits, no unbounded growth of stale device trees. The lock keeps
+        concurrent first calls (the bridge's default 2-worker overlap)
+        from double-compiling and double-uploading the param tree."""
         import jax
 
+        lock = self.__dict__.get("_jit_lock")
+        if lock is None:
+            import threading
+            lock = self.__dict__.setdefault("_jit_lock", threading.Lock())
+        with lock:
+            return self._compiled_apply_locked(bundle, node, jax)
+
+    def _compiled_apply_locked(self, bundle: ModelBundle, node: str, jax):
         cache = self.__dict__.setdefault("_jit_cache", {})
         key = (id(bundle.module), bundle.preprocess, node)
         entry = cache.get(key)

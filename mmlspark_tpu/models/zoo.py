@@ -228,8 +228,19 @@ def _folded_resnet_bundle(name: str, factory: Any, num_classes: int,
     from mmlspark_tpu.models.resnet import fold_batchnorm
     bn_net = factory(num_classes=num_classes, norm="batch", **kw)
     dummy = jnp.zeros((1, input_size, input_size, 3), jnp.float32)
-    variables = bn_net.init(jax.random.PRNGKey(seed), dummy)
-    params = fold_batchnorm(variables, param_dtype=param_dtype)
+    # init + fold are host-side setup (the fold itself is numpy): pin them
+    # to the CPU backend so bundle construction never pays a remote-device
+    # compile/transfer for a 224² init it immediately folds away. A
+    # JAX_PLATFORMS pin that excludes cpu makes the backend unavailable —
+    # fall back to the default device there
+    import contextlib
+    try:
+        ctx = jax.default_device(jax.devices("cpu")[0])
+    except RuntimeError:
+        ctx = contextlib.nullcontext()
+    with ctx:
+        variables = bn_net.init(jax.random.PRNGKey(seed), dummy)
+        params = fold_batchnorm(variables, param_dtype=param_dtype)
     folded = factory(num_classes=num_classes, norm="none", **kw)
     return ModelBundle(module=folded, params=params,
                        input_spec=(input_size, input_size, 3),

@@ -38,6 +38,7 @@ EXAMPLE_TITLES = {
     "transfer_learning_303": "303 - Transfer Learning",
     "medical_entity_304": "304 - Medical Entity Extraction",
     "flowers_featurizer_305": "305 - Flowers Featurization",
+    "distributed_finetune_306": "306 - Distributed Training",
 }
 
 

@@ -105,3 +105,8 @@ def test_example_305_flowers_featurizer(zoo_repo):
     # well above what untrained features could pass (chance = 0.2)
     assert out["deep_accuracy"] > 0.55, out
     assert out["deep_accuracy"] > 2 * out["raw_pixel_accuracy"], out
+
+
+def test_example_306_distributed_finetune():
+    import distributed_finetune_306 as ex
+    ex.main()  # asserts dp vs dp×pp and dp vs dp×ep loss parity inside

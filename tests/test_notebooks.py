@@ -35,8 +35,8 @@ def test_notebooks_fresh(tmp_path):
 
     regen = build(str(tmp_path))
     committed = committed_notebooks()
-    assert len(committed) == len(regen) == 10, (
-        f"expected 10 notebooks, committed={len(committed)} "
+    assert len(committed) == len(regen) == 11, (
+        f"expected 11 notebooks, committed={len(committed)} "
         f"regenerated={len(regen)} — run python -m "
         "mmlspark_tpu.tools.make_notebooks")
     for new_path in regen:

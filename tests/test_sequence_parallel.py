@@ -49,7 +49,6 @@ class TestRingAttention:
                                    rtol=2e-5, atol=2e-5)
 
     @pytest.mark.slow
-    @pytest.mark.slow
     def test_output_stays_sequence_sharded(self, sp_mesh):
         q, k, v = qkv()
         out = ring_attention(q, k, v, sp_mesh)
@@ -159,7 +158,6 @@ class TestSequenceModels:
 
 
 class TestPaddingMasks:
-    @pytest.mark.slow
     @pytest.mark.slow
     def test_ring_attention_kv_mask_matches_unpadded(self, sp_mesh):
         # attention over a padded sequence with kv_mask must equal attention
