@@ -29,15 +29,12 @@ import numpy as np
 
 
 def digits_images(n: int = 128):
-    """Real data without egress: sklearn digits upscaled to 16×16 RGB."""
-    from sklearn.datasets import load_digits
+    """Real data without egress: the shared digits-rgb32 loader (same
+    deterministic split the model-repo publisher and example 301 use)."""
+    from mmlspark_tpu.tools.build_model_repo import digits_rgb32
 
-    d = load_digits()
-    x8 = d.images.astype(np.float32) * (255.0 / 16.0)
-    x16 = np.kron(x8, np.ones((1, 2, 2), np.float32))
-    x = np.repeat(x16[..., None], 3, axis=-1)[:n]
-    y = d.target.astype(np.int64)[:n]
-    return x, y
+    xtr, ytr, _, _ = digits_rgb32()
+    return xtr[:n], ytr[:n]
 
 
 def fit(module, mesh_spec, x, y):
@@ -65,8 +62,9 @@ def main() -> None:
     x, y = digits_images()
 
     def vit():
-        # depth 4 so it splits across 2 pipeline stages
-        return ViT(num_classes=10, patch=8, dim=32, depth=4, heads=4,
+        # depth 4 so it splits across 2 pipeline stages; patch 16 on the
+        # 32x32 digits keeps the token count CI-small
+        return ViT(num_classes=10, patch=16, dim=32, depth=4, heads=4,
                    mlp_dim=64, dtype=jnp.float32, pipeline_microbatches=2)
 
     print("\n-- ViT fine-tune: dp-only vs dp x pp (pipelined blocks) --")

@@ -95,6 +95,11 @@ def make_notebook(example_path: str):
     nb.cells.append(nbformat.v4.new_markdown_cell(md))
     for cell_src in _split_cells(code):
         nb.cells.append(nbformat.v4.new_code_cell(cell_src))
+    # deterministic cell ids (nbformat defaults to random ones) keep
+    # regeneration byte-stable — adding an example must not churn the
+    # other committed notebooks
+    for i, cell in enumerate(nb.cells):
+        cell["id"] = f"{stem}-{i}"
     return stem, title, nb
 
 
