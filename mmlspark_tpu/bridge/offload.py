@@ -65,10 +65,21 @@ class ArrowBatchBridge:
         # serial path cost a full device round-trip per batch with the
         # overlap machinery sitting idle)
         # overlap chicken-switch for deployments that hit native
-        # instability: MMLSPARK_TPU_BRIDGE_WORKERS=1 forces serial
+        # instability: MMLSPARK_TPU_BRIDGE_WORKERS=1 forces serial. It can
+        # only LOWER the worker count (a fleet-wide cap must not re-widen
+        # the codec/tunnel hazard on call sites that chose serial), and
+        # garbage values are ignored with a warning rather than failing
+        # every Spark python worker
         import os
         env_workers = os.environ.get("MMLSPARK_TPU_BRIDGE_WORKERS")
-        self.workers = int(env_workers) if env_workers else workers
+        self.workers = workers
+        if env_workers:
+            try:
+                self.workers = min(workers, max(1, int(env_workers)))
+            except ValueError:
+                _log.warning(
+                    "ignoring non-integer MMLSPARK_TPU_BRIDGE_WORKERS=%r",
+                    env_workers)
         # serialize the Arrow codec across workers. This removes
         # codec↔codec concurrency and NARROWS (not eliminates) the
         # historical codec↔tunnel hazard window (see stream_table's note):

@@ -134,3 +134,58 @@ def test_vit_pp_depth_divisibility_raises():
                  mlp_dim=64, dtype=jnp.float32)
     with pytest.raises(ValueError, match="not divisible"):
         Trainer(module, TrainConfig(mesh_spec={"dp": 2, "pp": 4}))
+
+
+def test_mesh_hooks_contract_across_model_families():
+    """Protocol contract: every module implementing mesh_hooks must return
+    {apply_kwargs: dict, param_rules: callable|None, handled: set} and
+    claim only axes it was given reason to handle; modules without the
+    method fall back to the dp/fsdp/tp-only baseline (loud error for the
+    rest — covered above)."""
+    from mmlspark_tpu.train.loop import resolve_mesh_hooks
+
+    mesh = make_mesh(MeshSpec(dp=2, sp=2, ep=2))
+    cases = [
+        (TransformerTagger(vocab_size=32, embed_dim=8, num_heads=2,
+                           num_layers=1, mlp_dim=16, num_tags=2,
+                           max_len=8, moe_experts=2), {"sp", "ep"}),
+        (TransformerTagger(vocab_size=32, embed_dim=8, num_heads=2,
+                           num_layers=1, mlp_dim=16, num_tags=2,
+                           max_len=8), {"sp"}),  # no experts -> no ep claim
+        (ConvNetCifar(widths=(4, 8), dense_width=8), set()),
+    ]
+    for module, want in cases:
+        hooks = resolve_mesh_hooks(module, mesh)
+        assert set(hooks) == {"apply_kwargs", "param_rules", "handled"}
+        assert isinstance(hooks["apply_kwargs"], dict)
+        assert hooks["handled"] == want, (type(module).__name__, want)
+
+    pp_mesh = make_mesh(MeshSpec(dp=2, pp=4))
+    vit = ViT(num_classes=2, patch=8, dim=16, depth=4, heads=2, mlp_dim=32)
+    hooks = resolve_mesh_hooks(vit, pp_mesh)
+    assert hooks["handled"] == {"pp"}
+    assert hooks["apply_kwargs"]["pipeline_mesh"] is pp_mesh
+
+
+def test_jax_learner_stage_trains_on_pp_mesh():
+    """The ESTIMATOR tier inherits the one-call mesh UX: JaxLearner (the
+    CNTKLearner analog) with mesh_spec={'dp':2,'pp':4} and a ViT module
+    trains pipeline-parallel through the stage API — the
+    parallelTrain=true flag generalized (CommandBuilders.scala:79-93)."""
+    from mmlspark_tpu.data.table import DataTable
+    from mmlspark_tpu.train.learner import JaxLearner
+
+    r = np.random.default_rng(3)
+    imgs = r.normal(size=(48, 16, 16, 3)).astype(np.float64)
+    labels = r.integers(0, 4, size=48)
+    table = DataTable({"vec": list(imgs.reshape(48, -1)), "label": labels})
+
+    module = ViT(num_classes=4, patch=8, dim=32, depth=4, heads=4,
+                 mlp_dim=64, dtype=jnp.float32, pipeline_microbatches=2)
+    learner = JaxLearner(module=module, label_col="label", input_col="vec",
+                         input_shape=(16, 16, 3), epochs=2, batch_size=16,
+                         mesh_spec={"dp": 2, "pp": 4})
+    fitted = learner.fit(table)
+    assert fitted.final_loss is not None and np.isfinite(fitted.final_loss)
+    scored = fitted.transform(table)
+    assert "scored_labels" in scored.columns or "scores" in scored.columns
