@@ -21,7 +21,8 @@ Usage:
     (or: python -m mmlspark_tpu.tools.build_model_repo <repo_dir>)
 
 ``small`` (default) publishes CI-scale models in under two minutes;
-``full`` also publishes ResNet50 / ViT_B16 at real parameter count.
+``full`` also publishes ResNet50 / ResNet50_Infer (the folded frozen-BN
+serving variant) / ViT_B16 at real parameter count.
 """
 
 from __future__ import annotations
@@ -290,6 +291,11 @@ def build(repo_dir: str, scale: str = "small") -> list:
         b = get_model("ResNet50", num_classes=10, input_size=64)
         b, _ = _train_eval(b, x64, y64, x64, y64, steps=10, bs=32)
         publish(b, "synthetic-standin", "ResNet", 50)
+        print("ResNet50_Infer (full size, folded inference variant)")
+        # the featurization-serving form: frozen-BN folded + bf16 + s2d
+        # stem (models/resnet.py; 0.64 MFU vs 0.39 unfolded, PERF_NOTES)
+        b = get_model("ResNet50_Infer", num_classes=10, input_size=224)
+        publish(b, "synthetic-standin", "ResNet-folded", 50)
         print("ViT_B16 (full size, stand-in weights)")
         x224, y224 = _class_blobs(16, (224, 224, 3), 10, seed=4)
         b = get_model("ViT_B16", num_classes=10)

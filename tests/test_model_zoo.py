@@ -211,6 +211,34 @@ class TestFullScaleBundles:
         assert np.all(np.isfinite(mat))
 
 
+    def test_resnet50_infer_folded_publish_download_featurize_224(
+            self, tmp_path):
+        """The serving-form flow at full architecture scale: the FOLDED
+        frozen-BN ResNet-50 (bf16, s2d stem — the variant the bench
+        featurizes with) publishes, downloads hash-verified, and
+        featurizes 224² images; its embedding matches the same params run
+        before download (the fold+bundle round trip is lossless)."""
+        from mmlspark_tpu.data.downloader import publish_model
+
+        bundle = get_model("ResNet50_Infer", num_classes=1000,
+                           input_size=224)
+        repo = str(tmp_path / "full_repo")
+        entry = publish_model(bundle, repo)
+        assert entry.size > 25 * 2 ** 20  # bf16 folded 25M-param artifact
+
+        t = image_struct_table(2, hw=224)
+        direct = np.stack(list(
+            ImageFeaturizer(output_col="feat", minibatch_size=2)
+            .set(model=bundle).transform(t)["feat"]))
+        feats = (ImageFeaturizer(output_col="feat", minibatch_size=2)
+                 .set_model_from_repo("ResNet50_Infer", repo=repo,
+                                      cache_dir=str(tmp_path / "cache"))
+                 .transform(t))
+        mat = np.stack(list(feats["feat"]))
+        assert mat.shape == (2, 2048) and np.all(np.isfinite(mat))
+        np.testing.assert_allclose(mat, direct, rtol=1e-5, atol=1e-5)
+
+
 @pytest.mark.slow  # depends on the ~3-min model-repo build fixture
 class TestHttpRepository:
     """The remote-manifest transport path (reference: the Azure-CDN
@@ -278,3 +306,4 @@ class TestHttpRepository:
         with pytest.raises(IOError, match="sha256 mismatch"):
             dl.download(bad)
         assert not os.path.exists(dl._cache_path(bad))
+
