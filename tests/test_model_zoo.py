@@ -306,4 +306,3 @@ class TestHttpRepository:
         with pytest.raises(IOError, match="sha256 mismatch"):
             dl.download(bad)
         assert not os.path.exists(dl._cache_path(bad))
-
