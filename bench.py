@@ -86,6 +86,10 @@ def _bench_loop(run_once, passes: int = 5, steps: int = 15) -> float:
     import jax
     import jax.numpy as jnp
     fetch = jax.jit(lambda a: jnp.sum(a.astype(jnp.float32)))
+    # warm the fetch OUTSIDE the timed windows: it is a fresh jit per
+    # _bench_loop call, and its first execution (trace+compile+round-trip)
+    # inside pass 1's short window would bias that pass's difference
+    float(fetch(run_once()))
 
     def window(n: int) -> float:
         t0 = time.perf_counter()

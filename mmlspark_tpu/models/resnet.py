@@ -293,7 +293,7 @@ def fold_batchnorm(variables: Any, eps: float = 1e-5,
         for key, val in p.items():
             if key.startswith("bn"):
                 continue  # consumed by its conv
-            bn_key = "bn" + _NORM_PAIRS.get(key, "?") if key in _NORM_PAIRS \
+            bn_key = ("bn" + _NORM_PAIRS[key]) if key in _NORM_PAIRS \
                 else None
             if bn_key and bn_key in p:
                 bn, st = p[bn_key], s[bn_key]
