@@ -400,6 +400,61 @@ def main() -> None:
     except Exception as e:  # bridge metric is best-effort in the bench
         bridge_p50 = f"error: {e}"
 
+    # fused-vs-unfused pipeline execution (round 6): the canonical 3-stage
+    # image pipeline (resize → unroll → score) through the pipeline planner
+    # (ONE compiled program, one H2D upload of the raw uint8 batch + one
+    # async fetch per minibatch) against the stage-by-stage host path. The
+    # crossing counts make the fusion visible independently of link drift.
+    pipe_rows_s = None
+    pipe_rows_s_unfused = None
+    pipe_crossings = None
+    try:
+        if jm is None:
+            raise RuntimeError("inference setup failed, pipeline skipped")
+        from mmlspark_tpu.core import plan as plan_lib
+        from mmlspark_tpu.core.pipeline import PipelineModel
+        from mmlspark_tpu.core.schema import make_image
+        from mmlspark_tpu.data.table import DataTable
+        from mmlspark_tpu.stages.image import ImageTransformer, UnrollImage
+
+        n_pipe = 2048
+        src = rng.integers(0, 255, size=(n_pipe, 48, 48, 3)).astype(np.uint8)
+        ptable = DataTable({"image": [make_image(f"i{k}", src[k])
+                                      for k in range(n_pipe)]})
+        stages = [
+            ImageTransformer().resize(32, 32),
+            UnrollImage(input_col="image", output_col="image_vec"),
+            JaxModel(model=jm.model, input_col="image_vec",
+                     output_col="scores", minibatch_size=1024),
+        ]
+        pm = PipelineModel(stages)
+        # warm both paths at the SAME minibatch shape so the timed passes
+        # never compile (1024 rows → one full-size minibatch)
+        warm = ptable.take(np.arange(1024))
+        pm.transform(warm)
+        cur = warm
+        for s in stages:
+            cur = s.transform(cur)
+        with plan_lib.count_crossings() as cnt:
+            t0 = time.perf_counter()
+            pm.transform(ptable)
+            fused_dt = time.perf_counter() - t0
+        pipe_crossings = {"fused_h2d": cnt.uploads, "fused_d2h": cnt.fetches,
+                          "fused_h2d_mb": round(cnt.upload_bytes / 2**20, 2)}
+        with plan_lib.count_crossings() as cnt:
+            t0 = time.perf_counter()
+            cur = ptable
+            for s in stages:
+                cur = s.transform(cur)
+            unfused_dt = time.perf_counter() - t0
+        pipe_crossings["unfused_h2d"] = cnt.uploads
+        pipe_crossings["unfused_d2h"] = cnt.fetches
+        pipe_crossings["unfused_h2d_mb"] = round(cnt.upload_bytes / 2**20, 2)
+        pipe_rows_s = round(n_pipe / fused_dt, 1)
+        pipe_rows_s_unfused = round(n_pipe / unfused_dt, 1)
+    except Exception as e:  # best-effort metric; label failures accurately
+        pipe_rows_s = f"error: {e}"
+
     # BASELINE configs 3-5 (flagship models); skip with BENCH_FAST=1
     import os
     extra: dict = {}
@@ -418,6 +473,9 @@ def main() -> None:
         "bridge_rows_per_s": bridge_rows_s,
         "inference_images_per_s_per_chip": infer_ips,
         "inference_compute_images_per_s_per_chip": infer_compute_ips,
+        "pipeline_rows_per_s": pipe_rows_s,
+        "pipeline_rows_per_s_unfused": pipe_rows_s_unfused,
+        "pipeline_crossings": pipe_crossings,
         "tunnel_upload_mb_s": tunnel_mb_s,
         "mxu_matmul_tf_s": mxu_tf_s,
         "fetch_rtt_ms": rtt_ms,

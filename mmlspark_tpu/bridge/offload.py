@@ -19,6 +19,14 @@ TPU host process, which
 ``DataFrame.mapInArrow`` expects, so the Spark-side integration is one
 line; without Spark the same callable runs over any iterator of pyarrow
 RecordBatches (the wire protocol is the contract, not the engine).
+
+When ``transformer`` is a multi-stage ``PipelineModel`` (or any planner-
+routed model), each chunk's transform goes through the pipeline planner
+(core/plan.py): adjacent device-capable stages execute as ONE compiled
+program per chunk — a single H2D upload and one async-windowed fetch per
+minibatch instead of a device round-trip per stage — and the compiled
+segment + device-resident params are cached on the transformer across
+chunks, so streaming pays compile/upload once.
 """
 
 from __future__ import annotations

@@ -54,7 +54,16 @@ class Pipeline(Estimator):
 
 
 class PipelineModel(Transformer):
-    """A fitted :class:`Pipeline`: applies each transformer in order."""
+    """A fitted :class:`Pipeline`: applies each transformer in order.
+
+    Execution goes through the pipeline planner
+    (:mod:`mmlspark_tpu.core.plan`): maximal runs of device-capable stages
+    (``DeviceStage``) fuse into one jitted program with a single H2D upload
+    and one async-windowed D2H fetch per minibatch; everything else runs
+    its host ``transform`` exactly as before. The compiled-segment cache
+    lives on this instance, so streaming callers (the Arrow bridge) pay
+    compile + param upload once across chunks.
+    """
 
     stages = Param(default=None, doc="ordered list of fitted transformers",
                    is_complex=True)
@@ -65,8 +74,15 @@ class PipelineModel(Transformer):
         if stages is not None:
             self.set(stages=list(stages))
 
+    def __getstate__(self):
+        # compiled fused segments (jitted closures, device arrays, locks)
+        # don't pickle; drop on serialize — rebuilt on first transform
+        d = self.__dict__.copy()
+        d.pop("_plan_cache", None)
+        d.pop("_plan_lock", None)
+        return d
+
     def transform(self, table: DataTable) -> DataTable:
-        current = table
-        for stage in self.stages or []:
-            current = stage.transform(current)
-        return current
+        from mmlspark_tpu.core import plan
+        return plan.execute_stages(list(self.stages or []), table,
+                                   cache_host=self)

@@ -1,0 +1,491 @@
+"""Pipeline execution planner — fuse adjacent device stages into one program.
+
+The host pipeline walks stages one at a time, so a device-heavy chain
+(image transform → featurize → score) pays a host↔device round-trip per
+stage — and through the driver's tunnel each crossing costs a ~50–110 ms
+RTT plus the ~45–53 MB/s incompressible-upload floor (PERF_NOTES), making
+crossings the dominant cost. The planner partitions a stage list into
+maximal runs of :class:`~mmlspark_tpu.core.stage.DeviceStage`-capable
+stages and compiles each run into ONE jitted composite: a single H2D
+upload per minibatch, one fused XLA program, and one async-windowed D2H
+fetch round (the ``copy_to_host_async``/``max_inflight`` software pipeline
+lifted out of ``JaxModel.transform`` into :func:`pipeline_minibatches`).
+
+Fallback rules (also documented in docs/device_stages.md):
+
+* a stage that is not a ``DeviceStage``, or whose ``device_fn`` declines
+  the incoming :class:`~mmlspark_tpu.core.stage.ArrayMeta`, runs on host;
+* a segment needs ≥ 2 consecutive device-capable stages — a lone device
+  stage keeps its own (already-optimized) ``transform`` path;
+* entry coercion is strict: rows must be non-missing and share one
+  shape/dtype, else the whole segment falls back to the host path;
+* every column a fused run writes is materialized from the same composite
+  program (tuple outputs, fetched in the same async window), so the fused
+  table is column-for-column identical to the stage-by-stage result.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import weakref
+from collections import deque
+from typing import Any, Callable, Iterator
+
+import numpy as np
+
+from mmlspark_tpu.core import config
+from mmlspark_tpu.core.logging_utils import get_logger, timed
+from mmlspark_tpu.core.schema import is_image_column
+from mmlspark_tpu.core.stage import ArrayMeta, DeviceOp, DeviceStage
+from mmlspark_tpu.data.table import DataTable
+
+_log = get_logger(__name__)
+
+
+# ---- fixed-shape minibatching (moved here from models.jax_model so the
+#      bridge, JaxModel, and fused segments share one definition) ----
+
+def minibatches(batch: np.ndarray, size: int
+                ) -> Iterator[tuple[np.ndarray, int]]:
+    """Yield fixed-shape minibatches; the tail is zero-padded to ``size``.
+
+    Fixed shapes mean XLA compiles one program total — the analog of the
+    reference's re-batching iterator (CNTKModel.scala:51-88) designed for
+    the compilation model instead of JNI marshalling.
+    """
+    n = len(batch)
+    for start in range(0, n, size):
+        chunk = batch[start:start + size]
+        valid = len(chunk)
+        if valid < size:
+            pad = np.zeros((size - valid,) + chunk.shape[1:], chunk.dtype)
+            chunk = np.concatenate([chunk, pad])
+        yield chunk, valid
+
+
+# ---- the H2D / D2H crossing points. Every device entry and exit of the
+#      minibatch pipeline goes through these two functions, so crossing
+#      counts are observable (tools/perf_smoke.py monkeypatches them) ----
+
+def _upload(chunk: np.ndarray, target: Any) -> Any:
+    """ONE host→device transfer of one minibatch."""
+    import jax
+    return jax.device_put(chunk, target)
+
+
+def _issue_fetch(outs: tuple) -> None:
+    """ONE async device→host fetch round for one minibatch's outputs."""
+    for o in outs:
+        o.copy_to_host_async()
+
+
+class CrossingCounter:
+    """Tally of device crossings observed by :func:`count_crossings`."""
+
+    def __init__(self) -> None:
+        self.uploads = 0        # H2D transfers (one per minibatch)
+        self.fetches = 0        # D2H fetch rounds (one per minibatch)
+        self.upload_bytes = 0   # total H2D payload — fusion ships the
+        #                         thinnest (entry) form, e.g. uint8 pixels
+        #                         instead of f32 features
+
+
+@contextlib.contextmanager
+def count_crossings():
+    """Count H2D uploads and D2H fetch rounds issued by the minibatch
+    pipeline — the observability hook behind tools/perf_smoke.py and the
+    bench's crossing metrics. Patches this module's ``_upload`` /
+    ``_issue_fetch`` seams, so it sees JaxModel's own path and fused
+    segments alike. Not thread-safe; use from single-threaded callers."""
+    global _upload, _issue_fetch
+    counter = CrossingCounter()
+    orig_upload, orig_fetch = _upload, _issue_fetch
+
+    def counting_upload(chunk, target):
+        counter.uploads += 1
+        counter.upload_bytes += int(getattr(chunk, "nbytes", 0))
+        return orig_upload(chunk, target)
+
+    def counting_fetch(outs):
+        counter.fetches += 1
+        return orig_fetch(outs)
+
+    _upload, _issue_fetch = counting_upload, counting_fetch
+    try:
+        yield counter
+    finally:
+        _upload, _issue_fetch = orig_upload, orig_fetch
+
+
+def pipeline_minibatches(fn: Callable, dev_params: Any, batch: np.ndarray,
+                         size: int, target: Any, max_inflight: int
+                         ) -> list[np.ndarray]:
+    """Run ``fn(dev_params, minibatch)`` over ``batch`` with the three-stage
+    software pipeline: upload of batch i+1 and device→host copy of batch
+    i-1 both overlap compute of batch i (async dispatch +
+    ``copy_to_host_async``), so wall clock ≈ max(H2D, compute, D2H), not
+    their sum. The deque caps device-resident outputs at ``max_inflight``
+    minibatches, bounding HBM on very large tables.
+
+    ``fn`` may return one array or a tuple (a fused segment materializes
+    every column its stages write). Returns one trimmed, concatenated host
+    array per output.
+    """
+    window: deque = deque()
+    parts: list[list[np.ndarray]] | None = None
+    inflight = max(2, int(max_inflight))
+
+    def drain_one() -> None:
+        outs, valid = window.popleft()
+        for k, o in enumerate(outs):
+            parts[k].append(np.asarray(o)[:valid])
+
+    for chunk, valid in minibatches(batch, size):
+        outs = fn(dev_params, _upload(chunk, target))
+        if not isinstance(outs, tuple):
+            outs = (outs,)
+        if parts is None:
+            parts = [[] for _ in outs]
+        _issue_fetch(outs)
+        window.append((outs, valid))
+        # drain to inflight-1 so at most max_inflight minibatch outputs are
+        # ever device-resident (the documented HBM bound)
+        while len(window) >= inflight:
+            drain_one()
+    while window:
+        drain_one()
+    return [np.concatenate(p) if len(p) > 1 else p[0] for p in parts or []]
+
+
+# ---- segment entry: host column → one stacked device-ready array ----
+
+def stack_image_column(col: np.ndarray
+                       ) -> tuple[np.ndarray, list[str]] | None:
+    """Stack an image-struct column into one ``[N,H,W,C]`` uint8 batch via a
+    single bulk copy; returns ``(batch, paths)`` or None when rows are
+    missing, ragged, or not uint8 (host fallback)."""
+    datas, paths = [], []
+    for v in col:
+        if not isinstance(v, dict):
+            return None
+        d = np.asarray(v["data"])
+        if d.ndim == 2:
+            d = d[:, :, None]
+        datas.append(d)
+        paths.append(v.get("path", ""))
+    if not datas:
+        return None
+    shape, dtype = datas[0].shape, datas[0].dtype
+    if dtype != np.uint8 or any(
+            d.shape != shape or d.dtype != dtype for d in datas):
+        return None
+    return np.stack(datas), paths
+
+
+def _entry_meta(table: DataTable, col: str) -> ArrayMeta | None:
+    """Cheap first-row probe used at planning time; the full (validated)
+    coercion happens in :func:`_coerce_entry` at execution time."""
+    if col not in table or len(table) == 0:
+        return None
+    if is_image_column(table, col):
+        v = table[col][0]
+        if not isinstance(v, dict):
+            return None
+        d = np.asarray(v["data"])
+        if d.dtype != np.uint8:
+            return None
+        shape = d.shape if d.ndim == 3 else d.shape + (1,)
+        return ArrayMeta(tuple(shape), "uint8", is_image=True)
+    arr = table[col]
+    if arr.dtype == object:
+        first = arr[0]
+        if first is None:
+            return None
+        f = np.asarray(first)
+        if not np.issubdtype(f.dtype, np.number):
+            return None
+        dt = "uint8" if f.dtype == np.uint8 else "float32"
+        return ArrayMeta((int(f.size),), dt)
+    if not np.issubdtype(arr.dtype, np.number):
+        return None
+    return ArrayMeta((1,), "float32")
+
+
+def _coerce_entry(table: DataTable, col: str, meta: ArrayMeta
+                  ) -> tuple[np.ndarray, dict] | None:
+    """Materialize the segment's entry column as one contiguous array
+    matching ``meta``; None on any mismatch (segment falls back to host)."""
+    if meta.is_image:
+        stacked = stack_image_column(table[col])
+        if stacked is None:
+            return None
+        batch, paths = stacked
+        if batch.shape[1:] != tuple(meta.shape):
+            return None
+        return batch, {"paths": paths}
+    try:
+        batch = table.column_matrix(col, dtype=np.dtype(meta.dtype))
+    except (TypeError, ValueError):
+        return None
+    if batch.shape[1:] != tuple(meta.shape):
+        return None
+    return batch, {}
+
+
+# ---- planning: greedy maximal runs of device-capable stages ----
+
+# device_fn results memoized per stage (a WeakKeyDictionary so nothing
+# lands in stage __dict__s, keeping pickling untouched): planning runs on
+# every transform call, and a model stage's device_fn traces the forward
+# with jax.eval_shape — per-chunk streaming must not re-trace when the
+# stage config and incoming meta are unchanged
+_DEVICE_FN_MEMO: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _stage_device_fn(s: DeviceStage, meta: ArrayMeta) -> DeviceOp | None:
+    token = s.device_cache_token()
+    hit = _DEVICE_FN_MEMO.get(s)
+    if hit is not None and hit[0] == token and hit[1] == meta:
+        return hit[2]
+    op = s.device_fn(meta)
+    _DEVICE_FN_MEMO[s] = (token, meta, op)
+    return op
+
+class _Segment:
+    """A maximal run of device-capable stages rooted at ``stages[start]``."""
+
+    def __init__(self, start: int, stages: list, entry_col: str,
+                 entry_meta: ArrayMeta, metas_in: list[ArrayMeta],
+                 out_cols: list[str], emitters: dict[str, int],
+                 out_metas: dict[str, ArrayMeta]):
+        self.start = start
+        self.stages = stages
+        self.entry_col = entry_col
+        self.entry_meta = entry_meta
+        self.metas_in = metas_in          # per-stage input meta
+        self.out_cols = out_cols          # first-write order
+        self.emitters = emitters          # out col → index of last writer
+        self.out_metas = out_metas        # out col → final meta
+
+    @property
+    def end(self) -> int:
+        return self.start + len(self.stages)
+
+
+def _collect_segment(stages: list, i: int, table: DataTable
+                     ) -> _Segment | None:
+    s0 = stages[i]
+    if not isinstance(s0, DeviceStage):
+        return None
+    entry_col = s0.device_input_col()
+    if entry_col is None:
+        return None
+    entry_meta = _entry_meta(table, entry_col)
+    if entry_meta is None:
+        return None
+    env: dict[str, ArrayMeta] = {entry_col: entry_meta}
+    seg_stages: list = []
+    metas_in: list[ArrayMeta] = []
+    out_cols: list[str] = []
+    emitters: dict[str, int] = {}
+    out_metas: dict[str, ArrayMeta] = {}
+    j = i
+    while j < len(stages):
+        s = stages[j]
+        if not isinstance(s, DeviceStage):
+            break
+        in_col = s.device_input_col()
+        out_col = s.device_output_col()
+        if in_col is None or out_col is None or in_col not in env:
+            break
+        op = _stage_device_fn(s, env[in_col])
+        if op is None:
+            break
+        metas_in.append(env[in_col])
+        seg_stages.append(s)
+        env[out_col] = op.out_meta
+        if out_col not in emitters:
+            out_cols.append(out_col)
+        emitters[out_col] = j - i
+        out_metas[out_col] = op.out_meta
+        j += 1
+    if len(seg_stages) < 2:
+        return None
+    return _Segment(i, seg_stages, entry_col, entry_meta, metas_in,
+                    out_cols, emitters, out_metas)
+
+
+def describe_plan(stages: list, table: DataTable) -> list[tuple[str, list]]:
+    """The segment structure the executor would use on ``table``:
+    ``[("device"|"host", [stage, ...]), ...]``. Purely for introspection
+    (tests, bench reporting) — segments whose entry depends on a not-yet-run
+    host stage show as host here but may still fuse at execution time."""
+    out: list[tuple[str, list]] = []
+    i = 0
+    while i < len(stages):
+        seg = _collect_segment(stages, i, table)
+        if seg is None:
+            out.append(("host", [stages[i]]))
+            i += 1
+        else:
+            out.append(("device", list(seg.stages)))
+            i = seg.end
+    return out
+
+
+# ---- compilation + execution ----
+
+def _segment_tokens(seg: _Segment) -> tuple:
+    return tuple(s.device_cache_token() for s in seg.stages)
+
+
+def _segment_mesh(seg: _Segment):
+    """The fused run's inference mesh: the first explicit ``mesh_spec``
+    among the segment's stages wins, else DP over every local device —
+    the same default JaxModel uses standalone, so routing a pipeline
+    through the planner never narrows its data parallelism."""
+    import jax
+
+    from mmlspark_tpu.parallel import mesh as mesh_lib
+
+    spec = next((s.mesh_spec for s in seg.stages
+                 if getattr(s, "mesh_spec", None)), None)
+    return mesh_lib.make_mesh(spec or mesh_lib.MeshSpec(dp=-1),
+                              jax.local_devices())
+
+
+def _compile_segment(seg: _Segment) -> tuple:
+    """(jitted composite, device params, transfer target, dp extent). The
+    composite threads the entry array through every stage op and returns a
+    tuple with one array per materialized column, so fusion never changes
+    which columns exist — only how many device crossings they cost.
+    Params upload once (replicated over the mesh) and live
+    device-resident; minibatches commit batch-sharded over the data axes
+    (single-device meshes take the plain-placement fast path — sharded
+    transfers cost a round-trip per shard through remote-device
+    tunnels, PERF_NOTES round 2)."""
+    import jax
+
+    from mmlspark_tpu.parallel import mesh as mesh_lib
+
+    ops: list[DeviceOp] = []
+    for s, meta_in in zip(seg.stages, seg.metas_in):
+        op = _stage_device_fn(s, meta_in)
+        if op is None:  # config changed between planning and compile
+            raise RuntimeError(
+                f"{type(s).__name__}.device_fn declined at compile time")
+        ops.append(op)
+
+    in_cols = [s.device_input_col() for s in seg.stages]
+    out_cols_per_stage = [s.device_output_col() for s in seg.stages]
+
+    def composite(all_params: tuple, x: Any) -> tuple:
+        vals = {seg.entry_col: x}
+        for k, op in enumerate(ops):
+            vals[out_cols_per_stage[k]] = op.fn(all_params[k],
+                                                vals[in_cols[k]])
+        return tuple(vals[c] for c in seg.out_cols)
+
+    params_tuple = tuple(op.params for op in ops)
+    mesh = _segment_mesh(seg)
+    if mesh.devices.size == 1:
+        target = mesh.devices.reshape(-1)[0]
+        dev_params = jax.device_put(params_tuple, target)
+        return jax.jit(composite), dev_params, target, 1
+
+    repl = mesh_lib.replicated(mesh)
+    data = mesh_lib.batch_sharding(mesh)
+    dev_params = jax.device_put(params_tuple, repl)
+    fn = jax.jit(composite, in_shardings=(repl, data), out_shardings=data)
+    dp = mesh.shape["dp"] * mesh.shape["fsdp"]
+    return fn, dev_params, data, dp
+
+
+def _segment_minibatch(seg: _Segment) -> tuple[int, int]:
+    """(minibatch size, max_inflight) for a fused run: the smallest explicit
+    stage setting wins (it is a memory bound), else the config default."""
+    sizes = [int(s.minibatch_size) for s in seg.stages
+             if getattr(s, "minibatch_size", None)]
+    size = min(sizes) if sizes else int(config.get("default_minibatch_size"))
+    inflights = [int(s.max_inflight) for s in seg.stages
+                 if getattr(s, "max_inflight", None)]
+    return size, (min(inflights) if inflights else 8)
+
+
+# compiled segments kept per cache_host; LRU-capped so streaming sources
+# with many distinct entry shapes cannot pin an unbounded number of
+# device-resident param copies (each evicted entry releases its device
+# tree — the bound _compiled_apply enforces by refreshing in place)
+_PLAN_CACHE_MAX = 8
+
+
+def _run_segment(seg: _Segment, table: DataTable,
+                 cache_host: Any) -> DataTable | None:
+    """Execute a fused segment; None if entry coercion fails (host path)."""
+    coerced = _coerce_entry(table, seg.entry_col, seg.entry_meta)
+    if coerced is None:
+        return None
+    batch, ctx = coerced
+    size, max_inflight = _segment_minibatch(seg)
+
+    key = (tuple(id(s) for s in seg.stages), seg.entry_col, seg.entry_meta)
+    if cache_host is not None:
+        lock = cache_host.__dict__.setdefault("_plan_lock", threading.Lock())
+        with lock:
+            store = cache_host.__dict__.setdefault("_plan_cache", {})
+            entry = store.get(key)
+            tokens = _segment_tokens(seg)
+            if entry is not None and entry[0] != tokens:
+                entry = None  # stage config changed: recompile
+            if entry is None:
+                # pin the stage objects so id() keys cannot be reused
+                entry = (tokens, _compile_segment(seg), tuple(seg.stages))
+            else:
+                del store[key]  # re-insert: LRU order = insertion order
+            store[key] = entry
+            while len(store) > _PLAN_CACHE_MAX:
+                store.pop(next(iter(store)))
+        fn, dev_params, target, dp = entry[1]
+    else:
+        fn, dev_params, target, dp = _compile_segment(seg)
+
+    # minibatch must divide over the data axes: round UP to a dp multiple
+    # (padding covers the excess) so every chip gets rows
+    size = -(-min(size, len(batch)) // dp) * dp
+
+    names = "→".join(type(s).__name__ for s in seg.stages)
+    with timed(f"FusedSegment[{names}]", _log, len(table)):
+        outs = pipeline_minibatches(fn, dev_params, batch, size, target,
+                                    max_inflight)
+    for col, values in zip(seg.out_cols, outs):
+        emitter = seg.stages[seg.emitters[col]]
+        table = emitter.device_emit(table, values, seg.out_metas[col], ctx)
+    return table
+
+
+def execute_stages(stages: list, table: DataTable,
+                   cache_host: Any = None) -> DataTable:
+    """Run a fitted-transformer list over ``table``, fusing maximal runs of
+    device-capable stages (the :class:`PipelineModel` execution engine).
+
+    ``cache_host`` (typically the owning PipelineModel) carries the
+    compiled-segment cache across calls, so streaming callers (the Arrow
+    bridge, ``transform_stream``) pay compile + param upload once.
+    """
+    i = 0
+    while i < len(stages):
+        seg = None
+        if len(table):
+            seg = _collect_segment(stages, i, table)
+        if seg is not None:
+            fused = _run_segment(seg, table, cache_host)
+            if fused is not None:
+                table = fused
+                i = seg.end
+                continue
+            _log.info("fused segment at stage %d fell back to host "
+                      "(entry coercion failed)", i)
+        table = stages[i].transform(table)
+        i += 1
+    return table

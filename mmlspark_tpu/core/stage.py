@@ -11,6 +11,7 @@ jar-reflection powers the reference's ``Fuzzing.scala`` and codegen
 
 from __future__ import annotations
 
+import dataclasses
 import itertools
 from typing import Any, Callable
 
@@ -129,6 +130,87 @@ class UnaryTransformer(Transformer, HasInputCol, HasOutputCol):
     def transform(self, table: DataTable) -> DataTable:
         out = self._transform_column(table[self.input_col], table)
         return table.with_column(self.output_col, out)
+
+
+# ---- device-resident execution capability (the pipeline-fusion protocol) --
+
+@dataclasses.dataclass(frozen=True)
+class ArrayMeta:
+    """Shape/dtype contract for one column batched as a device array.
+
+    ``shape`` is the per-row shape (the batch axis is implicit), ``dtype``
+    a numpy dtype string, and ``is_image`` marks stacked HWC image structs
+    (whose host form is a column of image dicts). This is what a
+    :class:`DeviceStage` sees when asked whether it can run on device.
+    """
+
+    shape: tuple
+    dtype: str
+    is_image: bool = False
+
+
+@dataclasses.dataclass
+class DeviceOp:
+    """A stage's columnwise device computation.
+
+    ``fn(params, x)`` must be a *pure* jax function mapping a
+    ``[B, *in_meta.shape]`` array to ``[B, *out_meta.shape]`` — the planner
+    composes adjacent ops into ONE jitted program, so fn must not perform
+    host transfers, I/O, or Python-side mutation. ``params`` is a pytree of
+    host arrays uploaded once per compiled segment and kept device-resident
+    (the broadcast-once analog); stateless ops use the default ``()``.
+    """
+
+    fn: Callable
+    out_meta: ArrayMeta
+    params: Any = ()
+
+
+class DeviceStage:
+    """Capability mixin: a stage that can describe its computation as a pure
+    columnwise array→array jax function, letting the pipeline planner
+    (:mod:`mmlspark_tpu.core.plan`) keep data device-resident across stage
+    boundaries instead of paying a host round-trip per stage.
+
+    Opting in is best-effort: ``device_fn`` returning ``None`` (for an
+    unsupported op list, dtype, or shape) falls back to the stage's host
+    ``transform`` with identical semantics. Implementations must keep the
+    device math equivalent to the host path — the parity suite
+    (tests/test_plan.py) holds fused output to the documented tolerance.
+    """
+
+    def device_input_col(self) -> str | None:
+        """The single column the device computation consumes (None = this
+        stage cannot run on device for the current configuration)."""
+        return getattr(self, "input_col", None)
+
+    def device_output_col(self) -> str | None:
+        """The column the device computation produces."""
+        return getattr(self, "output_col", None)
+
+    def device_cache_token(self) -> Any:
+        """A cheap fingerprint of the configuration the device computation
+        depends on; a changed token invalidates the planner's compiled
+        segment. The default covers stages fully described by their simple
+        params; stages with complex params (models, fitted plans) must
+        override to include their identity."""
+        vals = self._simple_param_values() if hasattr(
+            self, "_simple_param_values") else {}
+        return tuple(sorted((k, repr(v)) for k, v in vals.items()))
+
+    def device_fn(self, meta: ArrayMeta) -> DeviceOp | None:
+        """Describe this stage's computation on a column of ``meta`` layout,
+        or ``None`` to decline (host fallback)."""
+        return None
+
+    def device_emit(self, table: DataTable, values: Any,
+                    meta: ArrayMeta, ctx: dict) -> DataTable:
+        """Write the fused computation's host-fetched output (``values``,
+        shaped ``[N, *meta.shape]``) into the table the way this stage's
+        host ``transform`` would. ``ctx`` carries segment-entry context
+        (e.g. image paths captured during coercion)."""
+        out = values if values.ndim == 1 else list(values)
+        return table.with_column(self.device_output_col(), out)
 
 
 class LambdaTransformer(Transformer):

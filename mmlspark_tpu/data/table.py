@@ -338,7 +338,9 @@ class DataTable:
                   ) -> "DataTable":
         if not rows:
             return DataTable()
-        names = list(rows[0])
+        # union of all row keys in first-encounter order — keys absent from
+        # the first row must not be silently dropped; missing cells are None
+        names = list(dict.fromkeys(k for r in rows for k in r))
         return DataTable({n: [r.get(n) for r in rows] for n in names}, meta)
 
     @staticmethod

@@ -136,7 +136,8 @@ def pipeline_apply(block_fn: Callable, stacked_params: Any, x: Any,
         return jax.lax.psum(out, "pp")
 
     data_axes = ("dp", "fsdp")
-    out = jax.shard_map(
+    from mmlspark_tpu.parallel.mesh import shard_map
+    out = shard_map(
         stage_fn, mesh=mesh,
         in_specs=(P("pp"), P(None, data_axes)),
         out_specs=P(None, data_axes),

@@ -87,7 +87,8 @@ def _run_sharded(body, mesh, axis, batch_axis, q, k, v, kv_mask):
     b_axis = _resolve_batch_axis(mesh, batch_axis)
     spec = P(b_axis, axis, None, None)
     mask_spec = P(b_axis, axis)
-    fn = jax.shard_map(body, mesh=mesh,
+    from mmlspark_tpu.parallel.mesh import shard_map
+    fn = shard_map(body, mesh=mesh,
                        in_specs=(spec, spec, spec, mask_spec),
                        out_specs=spec, check_vma=False)
     if kv_mask is None:

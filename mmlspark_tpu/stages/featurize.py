@@ -40,7 +40,9 @@ from mmlspark_tpu.core.pipeline import Pipeline, PipelineModel
 from mmlspark_tpu.core.schema import (
     SchemaConstants, get_categorical_levels, is_image_column,
 )
-from mmlspark_tpu.core.stage import Estimator, HasFeaturesCol, Transformer
+from mmlspark_tpu.core.stage import (
+    ArrayMeta, DeviceOp, DeviceStage, Estimator, HasFeaturesCol, Transformer,
+)
 from mmlspark_tpu.data.table import DataTable, is_missing
 from mmlspark_tpu.stages.text import Tokenizer, hash_term
 
@@ -177,7 +179,7 @@ class AssembleFeatures(Estimator, HasFeaturesCol):
             selected_slots=selected_slots)
 
 
-class AssembleFeaturesModel(Transformer, HasFeaturesCol):
+class AssembleFeaturesModel(Transformer, DeviceStage, HasFeaturesCol):
     """Fitted :class:`AssembleFeatures`: applies the per-column featurization
     plan and assembles one features vector (reference:
     featurize/src/main/scala/AssembleFeatures.scala:338-459)."""
@@ -290,6 +292,53 @@ class AssembleFeaturesModel(Transformer, HasFeaturesCol):
         return out.with_meta(
             self.features_col,
             **{SchemaConstants.K_VECTOR_SIZE: int(features.shape[1])})
+
+    # ---- DeviceStage protocol: the numeric image assembly as a fused op.
+    #      Only the single-image-column plan qualifies — it is the one
+    #      assembly whose math is integer-exact (uint8 pixels represent
+    #      exactly in f32) and whose na.drop mask is statically empty (the
+    #      planner's entry coercion already rejects missing rows), so the
+    #      fused output is bit-for-bit the host output. Mixed plans (NaN
+    #      row-dropping, hashing, one-hot) keep the host path. ----
+
+    def device_input_col(self) -> str | None:
+        plan = self.plan or []
+        if len(plan) == 1 and plan[0]["kind"] == _KIND_IMAGE:
+            return plan[0]["col"]
+        return None
+
+    def device_output_col(self) -> str | None:
+        return self.features_col
+
+    def device_cache_token(self) -> Any:
+        return (id(self.plan), id(self.selected_slots),
+                self.number_of_features, self.features_col)
+
+    def device_fn(self, meta: ArrayMeta) -> DeviceOp | None:
+        if self.device_input_col() is None or not meta.is_image \
+                or len(meta.shape) != 3:
+            return None
+        h, w, c = meta.shape
+
+        def fn(params, x):
+            import jax.numpy as jnp
+            # [height, width, HWC pixel values] — the transform() image
+            # row layout, batched (f64→f32 of uint8 is exact, so computing
+            # in f32 directly matches the host's f64-then-f32 cast)
+            flat = x.astype(jnp.float32).reshape(x.shape[0], h * w * c)
+            hw = jnp.broadcast_to(
+                jnp.asarray([float(h), float(w)], jnp.float32),
+                (x.shape[0], 2))
+            return jnp.concatenate([hw, flat], axis=1)
+
+        return DeviceOp(fn, ArrayMeta((2 + h * w * c,), "float32"))
+
+    def device_emit(self, table: DataTable, values: Any, meta: ArrayMeta,
+                    ctx: dict) -> DataTable:
+        out = table.with_column(self.features_col, values)
+        return out.with_meta(
+            self.features_col,
+            **{SchemaConstants.K_VECTOR_SIZE: int(values.shape[1])})
 
 
 class Featurize(Estimator):

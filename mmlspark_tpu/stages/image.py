@@ -25,7 +25,9 @@ from mmlspark_tpu.core.params import Param
 from mmlspark_tpu.core.schema import (
     is_image_column, make_image, mark_image_column,
 )
-from mmlspark_tpu.core.stage import HasInputCol, HasOutputCol, Transformer
+from mmlspark_tpu.core.stage import (
+    ArrayMeta, DeviceOp, DeviceStage, HasInputCol, HasOutputCol, Transformer,
+)
 from mmlspark_tpu.data.table import DataTable
 from mmlspark_tpu.native import imgops
 
@@ -102,7 +104,67 @@ OPS: dict[str, Callable[[np.ndarray, Mapping], np.ndarray]] = {
 }
 
 
-class ImageTransformer(Transformer, HasInputCol, HasOutputCol):
+# ---- device-side op builders (the DeviceStage path): each mirrors the
+#      host op's math exactly so fused output matches the per-row path ----
+
+# ops with a device implementation; any other op in the list declines
+# device_fn and the whole stage runs on host
+DEVICE_OPS = frozenset({"resize", "crop", "flip"})
+
+
+def _device_resize_step(h: int, w: int, oh: int, ow: int):
+    """Batched align-corners bilinear resize matching imgops.cpp
+    ``img_resize_bilinear`` tap-for-tap: same f32 coordinate math, same
+    left-associated blend order, same +0.5 truncating uint8 round — so
+    device output tracks the native host path to within ±1 count (the only
+    slack is compiler fma/rounding on knife-edge halves)."""
+    # f32/f32 division, matching the C++'s float arithmetic exactly
+    # (a python-double division rounded to f32 can differ by one ulp)
+    sy = (np.float32(h - 1) / np.float32(oh - 1)) if oh > 1 else np.float32(0)
+    sx = (np.float32(w - 1) / np.float32(ow - 1)) if ow > 1 else np.float32(0)
+    fy = np.arange(oh, dtype=np.float32) * sy
+    fx = np.arange(ow, dtype=np.float32) * sx
+    y0 = fy.astype(np.int32)
+    x0 = fx.astype(np.int32)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = (fy - y0).reshape(1, oh, 1, 1)
+    wx = (fx - x0).reshape(1, 1, ow, 1)
+
+    def step(img):
+        import jax.numpy as jnp
+        rows0 = jnp.take(img, y0, axis=1)
+        rows1 = jnp.take(img, y1, axis=1)
+        v00 = jnp.take(rows0, x0, axis=2).astype(jnp.float32)
+        v01 = jnp.take(rows0, x1, axis=2).astype(jnp.float32)
+        v10 = jnp.take(rows1, x0, axis=2).astype(jnp.float32)
+        v11 = jnp.take(rows1, x1, axis=2).astype(jnp.float32)
+        v = (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+             + v10 * wy * (1 - wx) + v11 * wy * wx)
+        return (v + np.float32(0.5)).astype(jnp.uint8)
+
+    return step
+
+
+def _device_flip_step(code: int):
+    def step(img):
+        if code == 1:
+            return img[:, :, ::-1]
+        if code == 0:
+            return img[:, ::-1]
+        return img[:, ::-1, ::-1]
+
+    return step
+
+
+def _device_crop_step(x: int, y: int, ch: int, cw: int):
+    def step(img):
+        return img[:, y:y + ch, x:x + cw]
+
+    return step
+
+
+class ImageTransformer(Transformer, DeviceStage, HasInputCol, HasOutputCol):
     """Applies an ordered list of image ops per row.
 
     Ops are dicts: ``{"op": "resize", "height": 32, "width": 32}``.
@@ -183,8 +245,52 @@ class ImageTransformer(Transformer, HasInputCol, HasOutputCol):
         table = table.with_column(self.output_col, out)
         return mark_image_column(table, self.output_col)
 
+    # ---- DeviceStage protocol ----
 
-class UnrollImage(Transformer, HasInputCol, HasOutputCol):
+    def device_fn(self, meta: ArrayMeta) -> DeviceOp | None:
+        """Batched device variant of the op list. Only uint8 HWC stacks and
+        the pure-indexing/arithmetic ops (resize/crop/flip) qualify — the
+        OpenCV-backed ops (color_format/blur/threshold/gaussian) keep the
+        host path. A crop outside the image also declines, so the host path
+        raises its canonical per-row error."""
+        if not meta.is_image or meta.dtype != "uint8" or len(meta.shape) != 3:
+            return None
+        h, w, c = meta.shape
+        steps = []
+        for op in self.ops or []:
+            kind = op.get("op")
+            if kind not in DEVICE_OPS:
+                return None
+            if kind == "resize":
+                oh, ow = int(op["height"]), int(op["width"])
+                steps.append(_device_resize_step(h, w, oh, ow))
+                h, w = oh, ow
+            elif kind == "crop":
+                x, y = int(op.get("x", 0)), int(op.get("y", 0))
+                ch, cw = int(op["height"]), int(op["width"])
+                if y + ch > h or x + cw > w:
+                    return None
+                steps.append(_device_crop_step(x, y, ch, cw))
+                h, w = ch, cw
+            else:  # flip
+                steps.append(_device_flip_step(int(op.get("flip_code", 1))))
+
+        def fn(params, img):
+            for step in steps:
+                img = step(img)
+            return img
+
+        return DeviceOp(fn, ArrayMeta((h, w, c), "uint8", is_image=True))
+
+    def device_emit(self, table: DataTable, values: Any, meta: ArrayMeta,
+                    ctx: dict) -> DataTable:
+        paths = ctx.get("paths") or [""] * len(values)
+        out = [make_image(p, v) for p, v in zip(paths, values)]
+        table = table.with_column(self.output_col, out)
+        return mark_image_column(table, self.output_col)
+
+
+class UnrollImage(Transformer, DeviceStage, HasInputCol, HasOutputCol):
     """Image struct → flat CHW float vector (native C++ pack).
 
     Reference: UnrollImage.scala:18-42 loops per pixel in Scala to build a
@@ -227,6 +333,28 @@ class UnrollImage(Transformer, HasInputCol, HasOutputCol):
             else:
                 vecs = [one(d) for d in datas]
         return table.with_column(self.output_col, vecs)
+
+    # ---- DeviceStage protocol ----
+
+    def device_fn(self, meta: ArrayMeta) -> DeviceOp | None:
+        """Device unroll: the exact per-pixel ``float(px) * scale + offset``
+        of imgops.cpp ``img_unroll`` on the transposed CHW view, batched."""
+        if not meta.is_image or len(meta.shape) != 3:
+            return None
+        h, w, c = meta.shape
+        scale = np.float32(self.scale)
+        offset = np.float32(self.offset)
+        to_rgb = bool(self.to_rgb) and c == 3
+
+        def fn(params, x):
+            import jax.numpy as jnp
+            xf = x.astype(jnp.float32)
+            if to_rgb:
+                xf = xf[..., ::-1]
+            chw = jnp.transpose(xf, (0, 3, 1, 2))
+            return (chw * scale + offset).reshape(x.shape[0], c * h * w)
+
+        return DeviceOp(fn, ArrayMeta((c * h * w,), "float32"))
 
 
 class ImageSetAugmenter(Transformer, HasInputCol, HasOutputCol):

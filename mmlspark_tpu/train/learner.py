@@ -55,10 +55,25 @@ class JaxLearnerModel(Transformer):
     final_loss = Param(default=None, doc="last recorded training loss",
                        type_=float)
 
+    def __getstate__(self):
+        d = self.__dict__.copy()
+        d.pop("_plan_cache", None)
+        d.pop("_plan_lock", None)
+        return d
+
     def transform(self, table: DataTable) -> DataTable:
-        t = (self.featurize_model.transform(table)
-             if self.featurize_model is not None else table)
-        return self.jax_model.transform(t)
+        if self.featurize_model is None:
+            return self.jax_model.transform(table)
+        # featurize + forward as ONE planned stage list: when the fitted
+        # featurization is device-capable (e.g. the single-image-column
+        # assembly), the planner fuses it with the model forward into one
+        # compiled program — a single H2D upload of the raw uint8 batch per
+        # minibatch instead of featurize-on-host + upload-f32-features
+        from mmlspark_tpu.core import plan
+        feat_stages = list(getattr(self.featurize_model, "stages", None)
+                           or [self.featurize_model])
+        return plan.execute_stages(feat_stages + [self.jax_model], table,
+                                   cache_host=self)
 
 
 class JaxLearner(Estimator, HasLabelCol):

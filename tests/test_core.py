@@ -97,6 +97,18 @@ def test_table_basic_ops():
     assert len(t.filter(lambda r: r["a"] > 1)) == 2
 
 
+def test_from_rows_takes_union_of_row_keys():
+    # keys absent from the FIRST row must not be dropped (regression:
+    # from_rows used to take the schema from rows[0] alone)
+    rows = [{"a": 1}, {"a": 2, "b": "x"}, {"c": 3.0}]
+    t = DataTable.from_rows(rows)
+    assert t.columns == ["a", "b", "c"]
+    assert t["a"].tolist() == [1, 2, None]
+    assert t["b"].tolist() == [None, "x", None]
+    assert t["c"].tolist() == [None, None, 3.0]
+    assert len(t) == 3
+
+
 def test_table_mismatched_lengths():
     with pytest.raises(ValueError):
         DataTable({"a": [1, 2], "b": [1]})

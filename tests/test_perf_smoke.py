@@ -1,0 +1,17 @@
+"""Tier-1 wiring of tools/perf_smoke.py: the planner must fuse the
+canonical image pipeline into exactly one H2D upload and one async D2H
+fetch round per minibatch (counted at the planner's crossing seams)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from perf_smoke import check_fused_crossings  # noqa: E402
+
+
+def test_canonical_image_pipeline_fuses_to_one_round_trip():
+    result = check_fused_crossings()
+    assert result["h2d_uploads"] == result["minibatches"]
+    assert result["d2h_fetch_rounds"] == result["minibatches"]
+    assert result["segments"] == [("device", 3)]

@@ -1,0 +1,285 @@
+"""Planner parity suite: fused pipeline execution must match the
+stage-by-stage host path — bit-for-bit for integer/indexing ops and the
+uint8 image assembly, to documented float tolerance where compiler
+rewrites (fma, fusion) may legally perturb the last ulp:
+
+* resize: device mirrors the native align-corners bilinear tap-for-tap;
+  the +0.5 truncating round leaves at most ±1 count on knife-edge halves;
+* model forwards / unroll affine: same math, compared at 1e-5.
+
+Also covers the fallback rules: host stages interleaved with fused runs,
+empty tables, tail padding, and ragged images (entry coercion declines →
+host path, identical output).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from mmlspark_tpu.core import plan
+from mmlspark_tpu.core.pipeline import PipelineModel
+from mmlspark_tpu.core.schema import make_image
+from mmlspark_tpu.core.stage import LambdaTransformer
+from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.models.bundle import ModelBundle
+from mmlspark_tpu.models.jax_model import JaxModel
+from mmlspark_tpu.models.zoo import MLP
+from mmlspark_tpu.stages.featurize import AssembleFeatures
+from mmlspark_tpu.stages.image import ImageTransformer, UnrollImage
+
+
+def image_table(n=10, h=24, w=18, seed=0):
+    r = np.random.default_rng(seed)
+    return DataTable({"image": [
+        make_image(f"p{k}", r.integers(0, 255, (h, w, 3)))
+        for k in range(n)]})
+
+
+def mlp_bundle(in_dim, out_dim=4, seed=0):
+    module = MLP(features=(8,), num_outputs=out_dim)
+    params = module.init(jax.random.PRNGKey(seed),
+                         np.zeros((1, in_dim), np.float32))["params"]
+    return ModelBundle(
+        module=module,
+        params=jax.tree_util.tree_map(np.asarray, params),
+        input_spec=(in_dim,),
+        output_names=getattr(type(module), "OUTPUT_NAMES", ("logits",)))
+
+
+def host_reference(stages, table):
+    """The unfused stage-by-stage result."""
+    for s in stages:
+        table = s.transform(table)
+    return table
+
+
+def assert_images_equal(a_col, b_col, atol=0):
+    for a, b in zip(a_col, b_col):
+        diff = np.abs(a["data"].astype(int) - b["data"].astype(int)).max()
+        assert diff <= atol, f"image diff {diff} > {atol}"
+        assert a["path"] == b["path"]
+        assert (a["height"], a["width"], a["channels"]) == \
+               (b["height"], b["width"], b["channels"])
+
+
+# ---- image pipelines ----
+
+def test_crop_flip_unroll_bit_for_bit():
+    table = image_table()
+    stages = [ImageTransformer().crop(2, 3, 16, 12).flip(-1),
+              UnrollImage(scale=1.0, offset=0.0)]
+    ref = host_reference(stages, table)
+    fused = PipelineModel(stages).transform(table)
+    assert [(k, len(ss)) for k, ss in plan.describe_plan(stages, table)] \
+        == [("device", 2)]
+    assert_images_equal(fused["image"], ref["image"], atol=0)
+    np.testing.assert_array_equal(np.stack(list(fused["features"])),
+                                  np.stack(list(ref["features"])))
+
+
+def test_resize_parity_within_one_count():
+    table = image_table(h=29, w=23)
+    stages = [ImageTransformer().resize(16, 12), UnrollImage()]
+    ref = host_reference(stages, table)
+    fused = PipelineModel(stages).transform(table)
+    assert_images_equal(fused["image"], ref["image"], atol=1)
+    f = np.stack(list(fused["features"]))
+    r = np.stack(list(ref["features"]))
+    assert np.abs(f - r).max() <= 1.0
+
+
+def test_unroll_affine_and_rgb_swap_parity():
+    table = image_table()
+    stages = [ImageTransformer().flip(1),
+              UnrollImage(scale=1 / 255.0, offset=-0.5, to_rgb=True)]
+    ref = host_reference(stages, table)
+    fused = PipelineModel(stages).transform(table)
+    np.testing.assert_allclose(np.stack(list(fused["features"])),
+                               np.stack(list(ref["features"])),
+                               rtol=0, atol=1e-5)
+
+
+def test_three_stage_image_pipeline_with_model_and_tail_padding():
+    # 10 rows at minibatch 4 → two full minibatches + a padded tail
+    table = image_table(n=10, h=12, w=10)
+    afm = AssembleFeatures(columns_to_featurize=["image"],
+                           allow_images=True,
+                           features_col="features").fit(table)
+    # dp=1 pins both paths to one device: minibatch stays 4 (no rounding
+    # to the test mesh's 8 virtual devices) and parity is exact
+    jm = JaxModel(model=mlp_bundle(2 + 12 * 10 * 3), input_col="features",
+                  output_col="scores", minibatch_size=4,
+                  mesh_spec={"dp": 1})
+    stages = [ImageTransformer().flip(0), afm, jm]
+    ref = host_reference(stages, table)
+    pm = PipelineModel(stages)
+    with plan.count_crossings() as c:
+        fused = pm.transform(table)
+    assert c.uploads == 3 and c.fetches == 3  # ceil(10/4) minibatches
+    assert fused.columns == ref.columns
+    assert_images_equal(fused["image"], ref["image"], atol=0)
+    # image assembly is integer-exact in f32 → features bit-for-bit
+    np.testing.assert_array_equal(np.stack(list(fused["features"])),
+                                  np.stack(list(ref["features"])))
+    np.testing.assert_allclose(np.stack(list(fused["scores"])),
+                               np.stack(list(ref["scores"])),
+                               rtol=0, atol=1e-5)
+    assert fused.column_meta("features") == ref.column_meta("features")
+
+
+# ---- vector pipelines ----
+
+def test_chained_models_fuse_on_vector_column():
+    r = np.random.default_rng(3)
+    table = DataTable({"x": list(r.normal(size=(9, 6)).astype(np.float32))})
+    jm1 = JaxModel(model=mlp_bundle(6, out_dim=5, seed=1), input_col="x",
+                   output_col="h", minibatch_size=4)
+    jm2 = JaxModel(model=mlp_bundle(5, out_dim=3, seed=2), input_col="h",
+                   output_col="scores", minibatch_size=4)
+    stages = [jm1, jm2]
+    assert [(k, len(ss)) for k, ss in plan.describe_plan(stages, table)] \
+        == [("device", 2)]
+    ref = host_reference(stages, table)
+    fused = PipelineModel(stages).transform(table)
+    np.testing.assert_allclose(np.stack(list(fused["h"])),
+                               np.stack(list(ref["h"])), rtol=0, atol=1e-5)
+    np.testing.assert_allclose(np.stack(list(fused["scores"])),
+                               np.stack(list(ref["scores"])),
+                               rtol=0, atol=1e-5)
+
+
+# ---- mixed host/device, fallback, and edge cases ----
+
+def test_mixed_host_device_pipeline():
+    table = image_table(n=6)
+    tag = LambdaTransformer(fn=lambda t: t.with_column(
+        "tag", [1] * len(t)))
+    renorm = LambdaTransformer(fn=lambda t: t.with_column(
+        "features", [v * 2.0 for v in t["features"]]))
+    stages = [tag, ImageTransformer().flip(1), UnrollImage(), renorm]
+    ref = host_reference(stages, table)
+    fused = PipelineModel(stages).transform(table)
+    segs = [(k, len(ss)) for k, ss in plan.describe_plan(stages, table)]
+    assert segs == [("host", 1), ("device", 2), ("host", 1)]
+    assert fused.columns == ref.columns
+    np.testing.assert_array_equal(np.stack(list(fused["features"])),
+                                  np.stack(list(ref["features"])))
+    np.testing.assert_array_equal(fused["tag"], ref["tag"])
+
+
+def test_single_device_stage_keeps_its_own_path():
+    # a lone device-capable stage must NOT go through segment fusion
+    table = image_table(n=4)
+    stages = [ImageTransformer().flip(1)]
+    assert plan.describe_plan(stages, table)[0][0] == "host"
+    out = PipelineModel(stages).transform(table)
+    ref = stages[0].transform(table)
+    assert_images_equal(out["image"], ref["image"], atol=0)
+
+
+def test_empty_table_runs_host_path():
+    table = DataTable({"image": []})
+    stages = [ImageTransformer().flip(1), UnrollImage()]
+    ref = host_reference(stages, table)
+    fused = PipelineModel(stages).transform(table)
+    assert len(fused) == 0
+    assert fused.columns == ref.columns
+
+
+def test_ragged_images_fall_back_to_host():
+    r = np.random.default_rng(5)
+    rows = [make_image(f"p{k}", r.integers(0, 255, (10 + k, 8, 3)))
+            for k in range(5)]
+    table = DataTable({"image": rows})
+    stages = [ImageTransformer().flip(1), UnrollImage()]
+    ref = host_reference(stages, table)
+    with plan.count_crossings() as c:
+        fused = PipelineModel(stages).transform(table)
+    assert c.uploads == 0  # coercion declined → pure host execution
+    for a, b in zip(fused["features"], ref["features"]):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_unsupported_op_falls_back_to_host():
+    table = image_table(n=4)
+    stages = [ImageTransformer().blur(3, 3), UnrollImage()]
+    segs = plan.describe_plan(stages, table)
+    assert segs[0][0] == "host"  # blur has no device impl
+    ref = host_reference(stages, table)
+    fused = PipelineModel(stages).transform(table)
+    np.testing.assert_array_equal(np.stack(list(fused["features"])),
+                                  np.stack(list(ref["features"])))
+
+
+def test_segment_cache_reused_and_invalidated():
+    table = image_table(n=6)
+    it = ImageTransformer().flip(1)
+    stages = [it, UnrollImage()]
+    pm = PipelineModel(stages)
+    pm.transform(table)
+    cache = pm.__dict__["_plan_cache"]
+    assert len(cache) == 1
+    entry_before = next(iter(cache.values()))
+    pm.transform(table)
+    assert next(iter(cache.values())) is entry_before  # cache hit
+    # changing a stage's config invalidates via the cache token
+    it.set(ops=list(it.ops) + [{"op": "flip", "flip_code": 0}])
+    fused = pm.transform(table)
+    assert next(iter(cache.values())) is not entry_before
+    ref = host_reference(stages, table)
+    np.testing.assert_array_equal(np.stack(list(fused["features"])),
+                                  np.stack(list(ref["features"])))
+
+
+def test_pipeline_model_survives_save_load_after_fusion(tmp_path):
+    table = image_table(n=4)
+    pm = PipelineModel([ImageTransformer().flip(1), UnrollImage()])
+    before = pm.transform(table)  # populates the compiled-segment cache
+    path = str(tmp_path / "pm")
+    pm.save(path)
+    loaded = PipelineModel.load(path)
+    after = loaded.transform(table)
+    np.testing.assert_array_equal(np.stack(list(before["features"])),
+                                  np.stack(list(after["features"])))
+
+
+# ---- bridge integration: fused pipeline behind the Arrow offload ----
+
+def test_fused_pipeline_through_arrow_bridge():
+    from mmlspark_tpu.bridge import ArrowBatchBridge
+    from mmlspark_tpu.bridge.offload import stream_table
+
+    table = image_table(n=24, h=12, w=10)
+    afm = AssembleFeatures(columns_to_featurize=["image"],
+                           allow_images=True,
+                           features_col="features").fit(table)
+    jm = JaxModel(model=mlp_bundle(2 + 12 * 10 * 3), input_col="features",
+                  output_col="scores", minibatch_size=8)
+    pm = PipelineModel([ImageTransformer().flip(1), afm, jm])
+    ref = pm.transform(table)
+
+    bridge = ArrowBatchBridge(pm, workers=2)
+    chunks = [DataTable.from_arrow(rb)
+              for rb in bridge.process(stream_table(table, 6))]
+    got = chunks[0]
+    for c in chunks[1:]:
+        got = got.concat(c)
+    assert len(got) == len(ref)
+    np.testing.assert_allclose(np.stack(list(got["scores"])),
+                               np.stack(list(ref["scores"])),
+                               rtol=0, atol=1e-5)
+    # the compiled segment was cached across chunks on the PipelineModel
+    assert len(pm.__dict__["_plan_cache"]) == 1
+
+
+# ---- the shared minibatch pipeline helper ----
+
+def test_pipeline_minibatches_trims_padding_and_orders_outputs():
+    import jax.numpy as jnp
+    dev = jax.local_devices()[0]
+    batch = np.arange(10, dtype=np.float32).reshape(10, 1)
+    fn = jax.jit(lambda p, x: (x + p, x * 2))
+    outs = plan.pipeline_minibatches(fn, jnp.float32(1.0), batch, 4, dev, 2)
+    np.testing.assert_array_equal(outs[0], batch + 1)
+    np.testing.assert_array_equal(outs[1], batch * 2)

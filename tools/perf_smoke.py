@@ -1,0 +1,88 @@
+"""perf_smoke — fast, CPU-safe check that pipeline fusion actually fuses.
+
+Asserts the planner executes the canonical image pipeline
+(resize → unroll → score) as ONE device segment costing exactly one H2D
+upload and one async D2H fetch round per minibatch, by counting crossings
+through the planner's ``_upload``/``_issue_fetch`` seams
+(:func:`mmlspark_tpu.core.plan.count_crossings`). The same check runs in
+tier-1 as tests/test_perf_smoke.py; this entry point is the
+``BENCH_FAST=1``-style standalone for CI wiring:
+
+    JAX_PLATFORMS=cpu python tools/perf_smoke.py
+
+Prints one JSON line and exits non-zero on any fusion regression.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def check_fused_crossings() -> dict:
+    """Run the canonical pipeline; raise AssertionError on regression."""
+    from mmlspark_tpu.core import plan
+    from mmlspark_tpu.core.pipeline import PipelineModel
+    from mmlspark_tpu.core.schema import make_image
+    from mmlspark_tpu.data.table import DataTable
+    from mmlspark_tpu.models.jax_model import JaxModel
+    from mmlspark_tpu.models.zoo import get_model
+    from mmlspark_tpu.stages.image import ImageTransformer, UnrollImage
+
+    n, minibatch = 48, 16
+    rng = np.random.default_rng(0)
+    table = DataTable({"image": [
+        make_image(f"i{k}", rng.integers(0, 255, (40, 40, 3)))
+        for k in range(n)]})
+
+    stages = [
+        ImageTransformer().resize(32, 32),
+        UnrollImage(input_col="image", output_col="image_vec"),
+        JaxModel(model=get_model("ConvNet_CIFAR10"), input_col="image_vec",
+                 output_col="scores", minibatch_size=minibatch),
+    ]
+    pm = PipelineModel(stages)
+
+    segments = plan.describe_plan(stages, table)
+    kinds = [(kind, len(ss)) for kind, ss in segments]
+    assert kinds == [("device", 3)], (
+        f"canonical image pipeline did not plan as one 3-stage device "
+        f"segment: {kinds}")
+
+    with plan.count_crossings() as c:
+        out = pm.transform(table)
+    n_minibatches = -(-n // minibatch)
+    assert c.uploads == n_minibatches, (
+        f"{c.uploads} H2D uploads for {n_minibatches} minibatches — "
+        "fusion must cost exactly one upload per minibatch")
+    assert c.fetches == n_minibatches, (
+        f"{c.fetches} D2H fetch rounds for {n_minibatches} minibatches — "
+        "fusion must cost exactly one async fetch round per minibatch")
+    assert len(out) == n and "scores" in out
+
+    return {
+        "segments": kinds,
+        "minibatches": n_minibatches,
+        "h2d_uploads": c.uploads,
+        "d2h_fetch_rounds": c.fetches,
+        "rows": n,
+    }
+
+
+def main() -> int:
+    try:
+        result = check_fused_crossings()
+    except AssertionError as e:
+        print(json.dumps({"perf_smoke": "FAIL", "reason": str(e)}))
+        return 1
+    print(json.dumps({"perf_smoke": "OK", **result}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
