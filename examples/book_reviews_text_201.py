@@ -38,6 +38,23 @@ def make_reviews(n: int, seed: int = 11) -> DataTable:
     return DataTable({"text": texts, "rating": np.asarray(ratings)})
 
 
+def make_stages():
+    """The featurize→train stage pair (single construction point shared by
+    run() and the static-analysis smoke test)."""
+    return (TextFeaturizer(input_col="text", output_col="features",
+                           use_stop_words_remover=True, use_ngram=False,
+                           use_idf=True, num_features=1 << 12),
+            TrainClassifier(label_col="rating",
+                            feature_columns=["features"]))
+
+
+def build_pipeline():
+    from mmlspark_tpu.analysis import TableSchema
+    from mmlspark_tpu.core.pipeline import Pipeline
+    return (Pipeline(list(make_stages())),
+            TableSchema.from_table(make_reviews(32)))
+
+
 def run(scale: str = "small") -> dict:
     n = 1500 if scale == "small" else 20000
     table = make_reviews(n)
@@ -45,12 +62,9 @@ def run(scale: str = "small") -> dict:
     train = table.take(np.arange(split))
     test = table.take(np.arange(split, len(table)))
 
-    featurizer = TextFeaturizer(
-        input_col="text", output_col="features", use_stop_words_remover=True,
-        use_ngram=False, use_idf=True, num_features=1 << 12).fit(train)
-    model = TrainClassifier(
-        label_col="rating", feature_columns=["features"]).fit(
-        featurizer.transform(train))
+    text_featurizer, trainer = make_stages()
+    featurizer = text_featurizer.fit(train)
+    model = trainer.fit(featurizer.transform(train))
 
     scored = model.transform(featurizer.transform(test))
     metrics = dict(ComputeModelStatistics().transform(scored).to_rows()[0])

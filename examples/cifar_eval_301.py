@@ -34,6 +34,25 @@ def ensure_repo(repo_dir: str | None = None) -> str:
     return repo_dir
 
 
+def make_model() -> JaxModel:
+    """The scoring stage (single construction point; run() attaches the
+    downloaded bundle, the smoke test a zoo-initialized one)."""
+    return JaxModel(input_col="image", output_col="scores",
+                    minibatch_size=256)
+
+
+def build_pipeline():
+    """Stage graph + input schema for the static-analysis smoke test: the
+    same architecture the repo publishes, over the flat uint8 row layout
+    run() feeds (32*32*3 = 3072 values per row)."""
+    from mmlspark_tpu.analysis import TableSchema
+    from mmlspark_tpu.models.zoo import get_model
+    model = make_model()
+    model.set(model=get_model("ConvNet_CIFAR10"))
+    return [model], TableSchema.from_spec(
+        {"image": {"kind": "vector", "shape": [3072], "dtype": "uint8"}})
+
+
 def run(scale: str = "small", repo_dir: str | None = None) -> dict:
     # `scale` kept for CLI symmetry with the other examples; the eval set
     # is the fixed digits-rgb32 held-out split either way (real data, and
@@ -43,9 +62,7 @@ def run(scale: str = "small", repo_dir: str | None = None) -> dict:
     repo = ensure_repo(repo_dir)
 
     path = ModelDownloader(repo).download_by_name("ConvNet_CIFAR10")
-    model = (JaxModel(input_col="image", output_col="scores",
-                      minibatch_size=256)
-             .set_model_location(path))
+    model = make_model().set_model_location(path)
 
     # evaluate on REAL data: the held-out split of the dataset the zoo
     # model was trained on (the manifest records the publisher's own

@@ -40,6 +40,14 @@ def make_flights_like(n: int, seed: int = 3) -> DataTable:
     })
 
 
+def build_pipeline():
+    """Stage graph + input schema for the static-analysis smoke test."""
+    from mmlspark_tpu.analysis import TableSchema
+    from mmlspark_tpu.core.pipeline import Pipeline
+    return (Pipeline([TrainRegressor(label_col="delay_minutes")]),
+            TableSchema.from_table(make_flights_like(64)))
+
+
 def run(scale: str = "small") -> dict:
     n = 2000 if scale == "small" else 50000
     table = make_flights_like(n)

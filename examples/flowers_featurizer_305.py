@@ -50,6 +50,27 @@ def make_flowers(n: int, seed: int = 13) -> DataTable:
     return mark_image_column(t, "image")
 
 
+def make_featurizer() -> ImageFeaturizer:
+    """The backbone featurization stage (single construction point; run()
+    attaches the downloaded pretrained bundle, the smoke test a
+    zoo-initialized one of the same architecture)."""
+    return ImageFeaturizer(output_col="features", cut_output_layers=1,
+                           minibatch_size=64)
+
+
+def build_pipeline():
+    """Stage graph + input schema for the static-analysis smoke test."""
+    from mmlspark_tpu.analysis import TableSchema
+    from mmlspark_tpu.core.pipeline import Pipeline
+    from mmlspark_tpu.models.zoo import get_model
+    featurizer = make_featurizer()
+    featurizer.set(model=get_model("ResNet_Small"))
+    return (Pipeline([featurizer,
+                      TrainClassifier(label_col="label",
+                                      feature_columns=["features"])]),
+            TableSchema.from_table(make_flowers(8)))
+
+
 def run(scale: str = "small", repo_dir: str | None = None) -> dict:
     n = 300 if scale == "small" else 6000
     repo = ensure_repo(repo_dir)
@@ -59,9 +80,8 @@ def run(scale: str = "small", repo_dir: str | None = None) -> dict:
     test = table.take(np.arange(split, n))
 
     # transfer learning: pretrained backbone embeddings
-    featurizer = (ImageFeaturizer(output_col="features", cut_output_layers=1,
-                                  minibatch_size=64)
-                  .set_model_from_repo("ResNet_Small", repo=repo))
+    featurizer = make_featurizer().set_model_from_repo("ResNet_Small",
+                                                       repo=repo)
     deep_model = TrainClassifier(
         label_col="label", feature_columns=["features"]).fit(
         featurizer.transform(train))

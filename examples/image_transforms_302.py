@@ -36,11 +36,11 @@ def ensure_images(n: int, root: str | None = None) -> str:
     return root
 
 
-def run(scale: str = "small") -> dict:
-    n = 48 if scale == "small" else 2048
-    root = ensure_images(n)
-    table = read_images(root)
-
+def build_pipeline():
+    """The stage graph this example runs, plus its abstract input schema —
+    the static-analysis smoke test (tests/test_examples.py) validates this
+    without executing the example, so drift is caught pre-flight."""
+    from mmlspark_tpu.analysis import TableSchema
     pipeline = Pipeline(stages=[
         ImageTransformer().resize(height=60, width=60)
                           .crop(x=0, y=0, height=48, width=48)
@@ -48,6 +48,18 @@ def run(scale: str = "small") -> dict:
         UnrollImage(input_col="image", output_col="features",
                     scale=1 / 255.0),
     ])
+    # source images are ragged in height (64..95) but fixed-width BGR
+    schema = TableSchema.from_spec(
+        {"image": {"kind": "image", "shape": [None, 96, 3]}})
+    return pipeline, schema
+
+
+def run(scale: str = "small") -> dict:
+    n = 48 if scale == "small" else 2048
+    root = ensure_images(n)
+    table = read_images(root)
+
+    pipeline, _ = build_pipeline()
     out = pipeline.fit(table).transform(table)
 
     feats = np.stack(list(out["features"]))

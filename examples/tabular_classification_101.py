@@ -44,6 +44,14 @@ def make_census_like(n: int, seed: int = 7) -> DataTable:
     })
 
 
+def build_pipeline():
+    """Stage graph + input schema for the static-analysis smoke test."""
+    from mmlspark_tpu.analysis import TableSchema
+    from mmlspark_tpu.core.pipeline import Pipeline
+    return (Pipeline([TrainClassifier(label_col="income")]),
+            TableSchema.from_table(make_census_like(64)))
+
+
 def run(scale: str = "small") -> dict:
     n = 2000 if scale == "small" else 30000
     table = make_census_like(n)

@@ -22,6 +22,7 @@ from mmlspark_tpu.core.params import Param, Params
 from mmlspark_tpu.core.stage import Transformer, Estimator, PipelineStage
 from mmlspark_tpu.core.pipeline import Pipeline, PipelineModel
 from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.analysis import TableSchema, analyze
 
 __all__ = [
     "Param",
@@ -32,5 +33,7 @@ __all__ = [
     "Pipeline",
     "PipelineModel",
     "DataTable",
+    "TableSchema",
+    "analyze",
     "__version__",
 ]

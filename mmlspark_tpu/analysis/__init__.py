@@ -1,0 +1,33 @@
+"""Pre-flight static analysis of pipelines — prove a pipeline well-formed
+and predict its device plan before any data moves.
+
+* :func:`analyze` — transformSchema-style abstract interpretation of a
+  Pipeline/PipelineModel over a :class:`TableSchema`, with typed
+  stage-indexed diagnostics and a device-plan audit (fusion boundaries,
+  predicted H2D/D2H crossings, recompile hazards).
+* :class:`TableSchema` / :class:`ColumnInfo` — the abstract table values.
+* ``tools/analyze.py`` is the CLI entry point; ``tools/lint_jax.py`` is
+  the companion AST lint for JAX anti-patterns in the codebase itself.
+"""
+
+from mmlspark_tpu.analysis.analyzer import (  # noqa: F401
+    AnalysisReport, Diagnostic, analyze, check_stage_kinds,
+)
+from mmlspark_tpu.analysis.audit import (  # noqa: F401
+    PlanAudit, PlanSegmentReport,
+)
+from mmlspark_tpu.analysis.info import (  # noqa: F401
+    ColumnInfo, SchemaError, TableSchema,
+)
+
+__all__ = [
+    "AnalysisReport",
+    "ColumnInfo",
+    "Diagnostic",
+    "PlanAudit",
+    "PlanSegmentReport",
+    "SchemaError",
+    "TableSchema",
+    "analyze",
+    "check_stage_kinds",
+]

@@ -1,0 +1,282 @@
+"""The pre-flight pipeline analyzer — transformSchema-style validation.
+
+``analyze`` abstractly interprets a ``Pipeline``/``PipelineModel`` (or a
+bare stage list) over a :class:`~mmlspark_tpu.analysis.info.TableSchema`:
+each stage's ``infer_schema`` hook maps the incoming abstract schema to
+its output schema, contract violations surface as stage-indexed
+:class:`Diagnostic`\\ s instead of deep-in-XLA shape errors, and the
+device-plan audit replays the pipeline planner's segmentation symbolically
+(fusion boundaries, predicted H2D/D2H crossings against the
+one-per-minibatch contract, recompile hazards). No ``DataTable`` is built
+and no device transfer or compilation happens — the only tracing is
+``jax.eval_shape`` inside model stages' own hooks, and the only jax
+runtime touch is device *enumeration* (``jax.local_devices``) for the
+audit's dp arithmetic; pre-flight callers on shared accelerator hosts
+should pin ``JAX_PLATFORMS=cpu`` (the CLI does).
+
+The reference's analog is SparkML ``transformSchema`` chained through
+``Pipeline.fit`` (reference: core/schema SparkSchema/SchemaConstants);
+here the walk additionally predicts the device plan PR 1's executor would
+choose, because on TPU the expensive mistake is not a late type error but
+an unplanned host round-trip or recompile.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from mmlspark_tpu.analysis.audit import (
+    PlanAudit, PlanSegmentReport, standalone_crossings,
+)
+from mmlspark_tpu.analysis.info import (
+    KIND_IMAGE, KIND_UNKNOWN, KIND_VECTOR, ColumnInfo, SchemaError,
+    TableSchema,
+)
+
+_SEVERITIES = ("error", "warning", "info")
+
+
+@dataclasses.dataclass
+class Diagnostic:
+    """One typed finding, anchored to the stage that caused it."""
+
+    severity: str            # "error" | "warning" | "info"
+    code: str                # stable kebab-case identifier
+    message: str
+    stage_index: int | None = None
+    stage: str = ""          # stage type name
+
+    def __str__(self) -> str:
+        where = (f" stage {self.stage_index} ({self.stage})"
+                 if self.stage_index is not None else "")
+        return f"[{self.severity}]{where}: {self.message} ({self.code})"
+
+
+@dataclasses.dataclass
+class AnalysisReport:
+    """Everything ``analyze`` proves about a pipeline."""
+
+    diagnostics: list
+    schema: TableSchema          # predicted output schema
+    plan: PlanAudit | None = None
+
+    @property
+    def errors(self) -> list:
+        return [d for d in self.diagnostics if d.severity == "error"]
+
+    @property
+    def warnings(self) -> list:
+        return [d for d in self.diagnostics if d.severity == "warning"]
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors
+
+    def format(self) -> str:
+        lines = []
+        order = {s: k for k, s in enumerate(_SEVERITIES)}
+        for d in sorted(self.diagnostics,
+                        key=lambda d: (order.get(d.severity, 9),
+                                       d.stage_index or 0)):
+            lines.append(str(d))
+        if not self.diagnostics:
+            lines.append("no findings: pipeline is well-formed")
+        lines.append("")
+        lines.append("predicted output schema:")
+        for name, info in self.schema.columns.items():
+            shape = "" if info.shape is None else f" {list(info.shape)}"
+            lines.append(f"  {name}: {info.kind}"
+                         f"{'' if info.dtype is None else ' ' + info.dtype}"
+                         f"{shape}")
+        if self.plan is not None:
+            lines.append("")
+            lines.append("device plan:")
+            lines.extend("  " + ln for ln in self.plan.format().splitlines())
+        return "\n".join(lines)
+
+
+def _stages_of(pipeline: Any) -> list:
+    """Accept a Pipeline, PipelineModel, stage list, or single stage."""
+    from mmlspark_tpu.core.pipeline import Pipeline, PipelineModel
+    if isinstance(pipeline, (Pipeline, PipelineModel)):
+        return list(pipeline.stages or [])
+    if isinstance(pipeline, (list, tuple)):
+        return list(pipeline)
+    return [pipeline]
+
+
+def check_stage_kinds(stages: Any) -> list:
+    """Diagnostics for entries that are not pipeline stages at all — the
+    pre-validation ``Pipeline.fit`` runs so a mis-wired list fails with the
+    offending index/type instead of an opaque error mid-fit."""
+    from mmlspark_tpu.core.stage import Estimator, Transformer
+    out = []
+    for i, s in enumerate(_stages_of(stages)):
+        if not isinstance(s, (Transformer, Estimator)):
+            out.append(Diagnostic(
+                "error", "not-a-pipeline-stage",
+                f"stage {i} ({type(s).__name__}) is neither Transformer "
+                f"nor Estimator — every pipeline stage must be one; "
+                f"got {s!r:.120}", i, type(s).__name__))
+    return out
+
+
+def _drain_pending(schema: TableSchema, diags: list, idx: int,
+                   name: str) -> None:
+    for severity, code, message in schema.pending:
+        diags.append(Diagnostic(severity, code, message, idx, name))
+    schema.pending = []
+
+
+def _advance(stage: Any, idx: int, schema: TableSchema, rows: int | None,
+             diags: list) -> tuple[TableSchema, int | None]:
+    """Apply one stage's schema inference, degrading gracefully on errors."""
+    name = type(stage).__name__
+    new_rows = rows
+    try:
+        new_schema, new_rows = stage._infer_state(schema, rows)
+        _drain_pending(new_schema, diags, idx, name)
+    except SchemaError as e:
+        diags.append(Diagnostic("error", e.code, e.message, idx, name))
+        # recover: outputs exist but nothing is known about them, so one
+        # mis-wired stage yields one diagnostic, not a cascade
+        new_schema = schema.copy()
+        for col in getattr(stage, "_declared_output_columns", list)() or []:
+            new_schema.columns[col] = ColumnInfo.unknown()
+    except Exception as e:  # a buggy hook must not kill the analysis
+        diags.append(Diagnostic(
+            "warning", "schema-inference-failed",
+            f"infer_schema raised {type(e).__name__}: {e}", idx, name))
+        new_schema = schema.as_inexact()
+        new_rows = None
+    # shadowing: overwriting a column with a *different* kind is the classic
+    # image-vs-vector confusion source — flag it at the write site
+    for col, info in new_schema.columns.items():
+        old = schema.get(col)
+        if (old is not None and old.kind != KIND_UNKNOWN
+                and info.kind != KIND_UNKNOWN and old.kind != info.kind):
+            diags.append(Diagnostic(
+                "warning", "column-shadowed",
+                f"column {col!r} ({old.kind}) overwritten as {info.kind}; "
+                "stages downstream that expect the original layout will "
+                "misread it", idx, name))
+    return new_schema, new_rows
+
+
+def _purpose_collisions(schema: TableSchema) -> list:
+    """Two columns stamped with the same (purpose, model_uid) — evaluators
+    resolving by purpose would pick one arbitrarily."""
+    from mmlspark_tpu.core.schema import SchemaConstants
+    seen: dict[tuple, list[str]] = {}
+    for col, info in schema.columns.items():
+        purpose = info.meta.get(SchemaConstants.K_COLUMN_PURPOSE)
+        if purpose is None:
+            continue
+        uid = info.meta.get(SchemaConstants.K_MODEL_UID)
+        seen.setdefault((purpose, uid), []).append(col)
+    out = []
+    for (purpose, uid), cols in seen.items():
+        if len(cols) > 1:
+            out.append(Diagnostic(
+                "warning", "score-purpose-collision",
+                f"columns {cols} all claim purpose {purpose!r} for model "
+                f"{uid!r}; find_score_column will return {cols[0]!r} "
+                "arbitrarily"))
+    return out
+
+
+def analyze(pipeline: Any, schema: TableSchema, n_rows: int | None = None,
+            device_audit: bool = True) -> AnalysisReport:
+    """Statically validate a pipeline over an abstract input schema.
+
+    ``n_rows``, when given, turns the device-plan audit's crossing
+    prediction concrete (minibatch counts); without it the audit still
+    reports segmentation and hazards. Set ``device_audit=False`` to skip
+    the plan replay (pure schema checking).
+    """
+    from mmlspark_tpu.core import plan
+    from mmlspark_tpu.core.stage import DeviceStage
+
+    stages = _stages_of(pipeline)
+    diags = list(check_stage_kinds(stages))
+    bad = {d.stage_index for d in diags}
+    schema = schema.copy()
+    audit = PlanAudit() if device_audit else None
+    uploads = 0
+    crossings_exact = True
+    rows = n_rows
+
+    i = 0
+    while i < len(stages):
+        stage = stages[i]
+        if i in bad:
+            i += 1
+            continue
+        seg = None
+        explain: list = []
+        if device_audit and rows != 0:
+            try:
+                seg = plan.collect_segment(stages, i, schema.entry_meta,
+                                           explain=explain)
+            except Exception as e:
+                diags.append(Diagnostic(
+                    "warning", "plan-audit-failed",
+                    f"device-plan replay raised {type(e).__name__}: {e}",
+                    i, type(stage).__name__))
+        if seg is not None:
+            m = None
+            if rows is not None:
+                try:
+                    m = plan.predict_segment_minibatches(seg, rows)
+                except Exception:
+                    m = None
+            if m is None:
+                crossings_exact = False
+            else:
+                uploads += m
+            audit.segments.append(PlanSegmentReport(
+                "device", seg.start, seg.end,
+                [type(s).__name__ for s in seg.stages],
+                entry_col=seg.entry_col, minibatches=m))
+            for j in range(seg.start, seg.end):
+                schema, rows = _advance(stages[j], j, schema, rows, diags)
+            i = seg.end
+            continue
+
+        # host step
+        if device_audit:
+            if isinstance(stage, DeviceStage) and rows != 0:
+                in_col = stage.device_input_col()
+                info = schema.get(in_col) if in_col else None
+                if (info is not None
+                        and info.kind in (KIND_IMAGE, KIND_VECTOR)
+                        and info.concrete_shape is None
+                        and not info.has_missing):
+                    diags.append(Diagnostic(
+                        "warning", "shape-polymorphic-entry",
+                        f"column {in_col!r} feeds device-capable stage "
+                        f"{type(stage).__name__} with a per-row shape that "
+                        "is not statically fixed: each distinct shape "
+                        "compiles a fresh program (recompile hazard) or "
+                        "falls back to host", i, type(stage).__name__))
+            m = None
+            try:
+                m = standalone_crossings(stage, schema, rows)
+            except Exception:
+                m = None
+            if m is None:
+                crossings_exact = False
+            else:
+                uploads += m
+            audit.segments.append(PlanSegmentReport(
+                "host", i, i + 1, [type(stage).__name__],
+                minibatches=m, notes=list(explain)))
+        schema, rows = _advance(stage, i, schema, rows, diags)
+        i += 1
+
+    diags.extend(_purpose_collisions(schema))
+    if audit is not None:
+        audit.uploads = uploads if crossings_exact else None
+        audit.fetches = audit.uploads
+    return AnalysisReport(diagnostics=diags, schema=schema, plan=audit)

@@ -1,0 +1,109 @@
+"""Device-plan audit structures — the symbolic replay of the pipeline
+planner's segmentation.
+
+The audit answers, before any data moves: which stage runs will fuse into
+one compiled program, where fusion breaks (and why), and how many
+H2D uploads / D2H fetch rounds a transform over N rows will cost against
+the one-per-minibatch contract. It reuses the planner's own segmentation
+(``core/plan.collect_segment``) with the abstract
+:meth:`~mmlspark_tpu.analysis.info.TableSchema.entry_meta` probe standing
+in for the concrete table, so the predicted plan is the executed plan by
+construction. Crossing arithmetic goes through
+``core/plan.predict_segment_minibatches`` (the executor's dp-rounded
+minibatch sizing) — nothing here compiles, uploads, or fetches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+
+@dataclasses.dataclass
+class PlanSegmentReport:
+    """One executor step: a fused device run or a single host stage."""
+
+    kind: str                      # "device" | "host"
+    start: int                     # first stage index (inclusive)
+    end: int                       # last stage index (exclusive)
+    stages: list                   # stage type names
+    entry_col: str | None = None   # fused runs: the one uploaded column
+    minibatches: int | None = None  # crossing rounds (None = not predictable)
+    notes: list = dataclasses.field(default_factory=list)
+
+    def describe(self) -> str:
+        names = "→".join(self.stages)
+        head = f"[{self.start}:{self.end}] {self.kind}: {names}"
+        if self.kind == "device":
+            head += f" (entry {self.entry_col!r}"
+            if self.minibatches is not None:
+                head += f", {self.minibatches} minibatch round(s)"
+            head += ")"
+        elif self.minibatches:
+            head += f" ({self.minibatches} minibatch round(s) on its own path)"
+        return head
+
+
+@dataclasses.dataclass
+class PlanAudit:
+    """The predicted execution plan of one transform call.
+
+    ``uploads``/``fetches`` are the predicted H2D / D2H crossing totals per
+    transform over the audited row count — ``None`` when device work exists
+    but the row count (or a stage's row effect) is unknown. A pipeline with
+    no device work predicts 0 exactly, whatever the row count.
+    """
+
+    segments: list[PlanSegmentReport] = dataclasses.field(
+        default_factory=list)
+    uploads: int | None = 0
+    fetches: int | None = 0
+
+    @property
+    def device_segments(self) -> list[PlanSegmentReport]:
+        return [s for s in self.segments if s.kind == "device"]
+
+    def structure(self) -> list[tuple[str, int]]:
+        """``[(kind, n_stages), ...]`` — comparable to
+        ``core/plan.describe_plan`` output shapes."""
+        return [(s.kind, s.end - s.start) for s in self.segments]
+
+    def format(self) -> str:
+        lines = [s.describe() for s in self.segments]
+        if self.uploads is None:
+            lines.append("crossings: not statically predictable "
+                         "(unknown row count or row-changing stage)")
+        else:
+            lines.append(f"crossings: {self.uploads} H2D upload(s), "
+                         f"{self.fetches} D2H fetch round(s) predicted")
+        return "\n".join(lines)
+
+
+def standalone_crossings(stage: Any, schema: Any, n_rows: int | None
+                         ) -> int | None:
+    """Crossing rounds a stage costs when it runs OUTSIDE a fused segment
+    (the host walk). Most host stages cost zero; a lone ``JaxModel`` runs
+    its own minibatch pipeline, and an ``ImageFeaturizer`` executes its
+    internal resize→forward plan. Returns None when the stage does device
+    work but the count is not predictable."""
+    from mmlspark_tpu.models.image_featurizer import ImageFeaturizer
+    from mmlspark_tpu.models.jax_model import JaxModel
+
+    if isinstance(stage, ImageFeaturizer):
+        if stage.model is None:
+            return 0
+        from mmlspark_tpu.analysis.analyzer import analyze
+        report = analyze(stage._stages(), schema, n_rows=n_rows)
+        return report.plan.uploads if report.plan is not None else None
+    if isinstance(stage, JaxModel):
+        if stage.model is None or n_rows == 0:
+            return 0
+        if n_rows is None:
+            return None
+        from mmlspark_tpu.core import config, plan
+        size = int(stage.minibatch_size
+                   or config.get("default_minibatch_size"))
+        size = plan.dp_rounded_minibatch(
+            size, plan.mesh_dp(stage._mesh()), n_rows)
+        return -(-n_rows // size)
+    return 0

@@ -1,0 +1,399 @@
+"""Abstract column/table values for the pre-flight pipeline analyzer.
+
+The reference rejects broken pipelines before any data moves by running
+``transformSchema`` over a ``StructType`` (reference: every stage's
+``transformSchema``, core/schema SparkSchema/SchemaConstants). The analog
+here is a :class:`TableSchema`: an ordered map of column name →
+:class:`ColumnInfo` abstract value (kind, dtype, per-row shape, sidecar
+metadata) that stages transform via their ``infer_schema`` hook with **no
+data and no device execution**. The image-struct and categorical contracts
+from :mod:`mmlspark_tpu.core.schema` are first-class kinds, and
+:meth:`TableSchema.entry_meta` mirrors the pipeline planner's concrete
+entry probe (``core/plan._entry_meta``) so the device-plan audit predicts
+exactly what the executor would do.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterable, Mapping
+
+import numpy as np
+
+from mmlspark_tpu.core.stage import ArrayMeta
+
+# column kinds — the abstract analog of the host table's cell types
+KIND_SCALAR = "scalar"      # one number per row (numeric numpy column)
+KIND_VECTOR = "vector"      # fixed-or-ragged numeric vector per row
+KIND_IMAGE = "image"        # image-struct dicts (HWC data + dims + path)
+KIND_TEXT = "text"          # one string per row
+KIND_TOKENS = "tokens"      # list-of-str per row (pre-tokenized text)
+KIND_DATE = "date"          # datetime cells
+KIND_OBJECT = "object"      # other python objects (bytes, dicts, ...)
+KIND_UNKNOWN = "unknown"    # nothing provable (e.g. behind an opaque UDF)
+
+
+class SchemaError(Exception):
+    """A pipeline-contract violation found by schema inference.
+
+    Raised by a stage's ``infer_schema`` when the incoming schema cannot
+    legally feed the stage (missing column, image where a vector is
+    required, size mismatch into a model, ...). The analyzer converts it
+    into a stage-indexed diagnostic and continues with a degraded schema.
+    """
+
+    def __init__(self, code: str, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+@dataclasses.dataclass
+class ColumnInfo:
+    """What is statically known about one column.
+
+    ``shape`` is the per-row shape; entries may be ``None`` for dims that
+    vary or are unknown (a ragged image column is ``kind=image`` with a
+    partial shape). ``meta`` carries the sidecar schema (categorical
+    levels, score roles, the image flag) exactly as
+    ``DataTable.meta[col]`` would at runtime.
+    """
+
+    kind: str = KIND_UNKNOWN
+    dtype: str | None = None
+    shape: tuple | None = None
+    has_missing: bool = False
+    meta: dict = dataclasses.field(default_factory=dict)
+
+    # -- constructors --
+
+    @staticmethod
+    def scalar(dtype: str = "float64", **kw: Any) -> "ColumnInfo":
+        return ColumnInfo(KIND_SCALAR, dtype, (), **kw)
+
+    @staticmethod
+    def vector(size: int | None, dtype: str = "float32",
+               **kw: Any) -> "ColumnInfo":
+        return ColumnInfo(KIND_VECTOR, dtype, (size,), **kw)
+
+    @staticmethod
+    def image(height: int | None, width: int | None, channels: int | None = 3,
+              dtype: str = "uint8", **kw: Any) -> "ColumnInfo":
+        from mmlspark_tpu.core.schema import SchemaConstants
+        info = ColumnInfo(KIND_IMAGE, dtype, (height, width, channels), **kw)
+        info.meta.setdefault(SchemaConstants.K_IMAGE, True)
+        return info
+
+    @staticmethod
+    def text(**kw: Any) -> "ColumnInfo":
+        return ColumnInfo(KIND_TEXT, "str", (), **kw)
+
+    @staticmethod
+    def tokens(**kw: Any) -> "ColumnInfo":
+        return ColumnInfo(KIND_TOKENS, "str", None, **kw)
+
+    @staticmethod
+    def unknown(**kw: Any) -> "ColumnInfo":
+        return ColumnInfo(KIND_UNKNOWN, **kw)
+
+    # -- derived properties --
+
+    @property
+    def concrete_shape(self) -> tuple | None:
+        """The per-row shape when fully known, else None."""
+        if self.shape is None or any(d is None for d in self.shape):
+            return None
+        return tuple(int(d) for d in self.shape)
+
+    @property
+    def row_size(self) -> int | None:
+        """Number of scalar values per row when provable (vector length,
+        image h*w*c, 1 for scalars), else None."""
+        s = self.concrete_shape
+        if s is None:
+            return None
+        return int(np.prod(s)) if s else 1
+
+    def copy(self) -> "ColumnInfo":
+        return dataclasses.replace(self, shape=self.shape,
+                                   meta=dict(self.meta))
+
+    def summary(self) -> tuple:
+        """(kind, dtype, shape) — the comparison form used by tests that
+        hold predictions against observed execution."""
+        return (self.kind, self.dtype,
+                None if self.shape is None else tuple(self.shape))
+
+
+def require_image_input(schema: "TableSchema", col: str, stage_name: str
+                        ) -> ColumnInfo:
+    """Shared ``infer_schema`` preamble for image-consuming stages: the
+    column must exist (unknown is tolerated when the schema is inexact)
+    and must not be a provably non-image kind — the image-vs-vector
+    confusion check, defined once so the acceptance set cannot drift
+    between stages. Returns the column's info (or unknown)."""
+    info = schema.get(col)
+    if info is None:
+        if schema.exact:
+            raise SchemaError(
+                "missing-input-column",
+                f"{stage_name} reads missing column {col!r}; "
+                f"available: {list(schema)}")
+        return ColumnInfo.unknown()
+    # image structs or raw encoded bytes both qualify; only a provably
+    # different kind is a contract violation
+    if info.kind not in (KIND_IMAGE, KIND_OBJECT, KIND_UNKNOWN):
+        raise SchemaError(
+            "image-column-expected",
+            f"{stage_name} input {col!r} is a {info.kind} column; "
+            "it needs an image-struct (or encoded bytes) column")
+    return info
+
+
+def _info_from_cells(cells: Iterable[Any], meta: Mapping[str, Any]
+                     ) -> ColumnInfo:
+    """Classify an object column's cells (the concrete→abstract direction,
+    used by :meth:`TableSchema.from_table`)."""
+    from datetime import datetime
+
+    from mmlspark_tpu.core.schema import SchemaConstants
+    from mmlspark_tpu.data.table import IMAGE_FIELDS, is_missing
+
+    has_missing = False
+    first = None
+    shapes: set[tuple] = set()
+    dtypes: set[str] = set()
+    kind = None
+    for v in cells:
+        if is_missing(v):
+            has_missing = True
+            continue
+        if first is None:
+            first = v
+        if isinstance(v, dict) and set(IMAGE_FIELDS).issubset(v.keys()):
+            kind = kind or KIND_IMAGE
+            if kind == KIND_IMAGE:
+                d = np.asarray(v["data"])
+                shape = d.shape if d.ndim == 3 else d.shape + (1,)
+                shapes.add(tuple(int(x) for x in shape))
+                dtypes.add(str(d.dtype))
+            continue
+        if isinstance(v, str):
+            kind = kind if kind not in (None, KIND_TEXT) else KIND_TEXT
+            continue
+        if isinstance(v, datetime):
+            kind = kind if kind not in (None, KIND_DATE) else KIND_DATE
+            continue
+        if isinstance(v, (np.ndarray, list, tuple)):
+            seq_kind = (KIND_TOKENS if len(v) and isinstance(v[0], str)
+                        else KIND_VECTOR)
+            kind = kind if kind not in (None, seq_kind) else seq_kind
+            if kind == KIND_VECTOR:
+                a = np.asarray(v)
+                shapes.add((int(a.size),))
+                dtypes.add(str(a.dtype))
+            continue
+        if isinstance(v, (bool, int, float, np.number, np.bool_)):
+            kind = kind if kind not in (None, KIND_SCALAR) else KIND_SCALAR
+            shapes.add(())
+            dtypes.add(str(np.asarray(v).dtype))
+            continue
+        kind = KIND_OBJECT
+    if first is None:
+        return ColumnInfo(KIND_UNKNOWN, has_missing=has_missing,
+                          meta=dict(meta))
+    if meta.get(SchemaConstants.K_IMAGE) and kind is None:
+        kind = KIND_IMAGE
+    kind = kind or KIND_OBJECT
+    shape = shapes.pop() if len(shapes) == 1 else None
+    dtype = dtypes.pop() if len(dtypes) == 1 else None
+    if kind in (KIND_TEXT, KIND_DATE):
+        shape, dtype = (), ("str" if kind == KIND_TEXT else "datetime")
+    elif kind in (KIND_TOKENS, KIND_OBJECT):
+        shape, dtype = None, None
+    return ColumnInfo(kind, dtype, shape, has_missing=has_missing,
+                      meta=dict(meta))
+
+
+class TableSchema:
+    """Ordered column-name → :class:`ColumnInfo` map — the abstract table.
+
+    ``exact`` is True while the column set is provably complete; an opaque
+    stage the analyzer cannot interpret flips it to False, after which
+    missing-input findings downgrade to warnings (the column may exist).
+    Stages' ``infer_schema`` hooks treat schemas as immutable: derive with
+    :meth:`copy` / :meth:`with_column` / :meth:`drop`.
+    """
+
+    def __init__(self, columns: Mapping[str, ColumnInfo] | None = None,
+                 exact: bool = True):
+        self.columns: dict[str, ColumnInfo] = dict(columns or {})
+        self.exact = exact
+        # non-fatal findings attached by infer_schema hooks; the analyzer
+        # drains these into stage-indexed diagnostics after each stage
+        self.pending: list[tuple[str, str, str]] = []
+
+    # -- mapping surface --
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.columns
+
+    def __iter__(self):
+        return iter(self.columns)
+
+    def column(self, name: str) -> ColumnInfo:
+        if name not in self.columns:
+            raise SchemaError(
+                "missing-input-column",
+                f"no column {name!r}; available: {list(self.columns)}")
+        return self.columns[name]
+
+    def get(self, name: str) -> ColumnInfo | None:
+        return self.columns.get(name)
+
+    # -- functional updates --
+
+    def copy(self) -> "TableSchema":
+        out = TableSchema({k: v.copy() for k, v in self.columns.items()},
+                          exact=self.exact)
+        # pending findings ride along so nested folds (Pipeline inside
+        # Pipeline) surface inner-stage warnings at the outer walk
+        out.pending = list(self.pending)
+        return out
+
+    def with_column(self, name: str, info: ColumnInfo) -> "TableSchema":
+        out = self.copy()
+        out.columns[name] = info
+        return out
+
+    def drop(self, *names: str) -> "TableSchema":
+        out = self.copy()
+        for n in names:
+            out.columns.pop(n, None)
+        return out
+
+    def as_inexact(self) -> "TableSchema":
+        out = self.copy()
+        out.exact = False
+        return out
+
+    def warn(self, code: str, message: str, severity: str = "warning"
+             ) -> None:
+        """Attach a non-fatal finding for the analyzer to collect."""
+        self.pending.append((severity, code, message))
+
+    # -- construction --
+
+    @staticmethod
+    def from_table(table: Any) -> "TableSchema":
+        """Derive the abstract schema of a concrete DataTable (scans cells
+        once on host; no device interaction). The observed-schema direction
+        used to validate predictions against real execution."""
+        cols: dict[str, ColumnInfo] = {}
+        for name in table.columns:
+            arr = table[name]
+            meta = dict(table.column_meta(name))
+            if arr.dtype != object:
+                if np.issubdtype(arr.dtype, np.str_):
+                    cols[name] = ColumnInfo(KIND_TEXT, "str", (), meta=meta)
+                elif arr.ndim == 1:
+                    has_nan = bool(
+                        np.issubdtype(arr.dtype, np.floating)
+                        and np.isnan(arr).any())
+                    cols[name] = ColumnInfo(
+                        KIND_SCALAR, str(arr.dtype), (),
+                        has_missing=has_nan, meta=meta)
+                else:
+                    cols[name] = ColumnInfo(KIND_VECTOR, str(arr.dtype),
+                                            (int(arr.shape[1]),), meta=meta)
+            else:
+                cols[name] = _info_from_cells(arr, meta)
+        return TableSchema(cols)
+
+    @staticmethod
+    def from_spec(spec: Mapping[str, Any]) -> "TableSchema":
+        """Build a schema from a JSON-friendly dict (the CLI input form)::
+
+            {"image": {"kind": "image", "shape": [32, 32, 3]},
+             "age":   {"kind": "scalar", "dtype": "float64"},
+             "text":  "text"}
+
+        A bare string value is shorthand for ``{"kind": <value>}``.
+        """
+        cols: dict[str, ColumnInfo] = {}
+        for name, entry in spec.items():
+            if isinstance(entry, str):
+                entry = {"kind": entry}
+            kind = entry.get("kind", KIND_UNKNOWN)
+            shape = entry.get("shape")
+            if shape is not None:
+                shape = tuple(None if d is None else int(d) for d in shape)
+            elif kind in (KIND_SCALAR, KIND_TEXT, KIND_DATE):
+                shape = ()
+            dtype = entry.get("dtype")
+            if dtype is None:
+                dtype = {KIND_IMAGE: "uint8", KIND_VECTOR: "float32",
+                         KIND_SCALAR: "float64", KIND_TEXT: "str",
+                         KIND_DATE: "datetime"}.get(kind)
+            info = ColumnInfo(kind, dtype, shape,
+                              has_missing=bool(entry.get("has_missing")),
+                              meta=dict(entry.get("meta") or {}))
+            if kind == KIND_IMAGE:
+                from mmlspark_tpu.core.schema import SchemaConstants
+                info.meta.setdefault(SchemaConstants.K_IMAGE, True)
+            cols[name] = info
+        return TableSchema(cols)
+
+    # -- the planner-facing view --
+
+    def entry_meta(self, name: str) -> ArrayMeta | None:
+        """The :class:`ArrayMeta` the pipeline planner's entry coercion
+        would produce for this column, or None when coercion would decline
+        (mirrors ``core/plan._entry_meta`` + the strict `_coerce_entry`
+        rules: missing rows, ragged shapes, and non-numeric data all fall
+        back to the host path)."""
+        info = self.columns.get(name)
+        if info is None or info.has_missing:
+            return None
+        if info.kind == KIND_IMAGE:
+            shape = info.concrete_shape
+            if info.dtype != "uint8" or shape is None or len(shape) != 3:
+                return None
+            return ArrayMeta(shape, "uint8", is_image=True)
+        if info.kind == KIND_VECTOR:
+            size = info.row_size
+            if size is None:
+                return None
+            dt = "uint8" if info.dtype == "uint8" else "float32"
+            return ArrayMeta((size,), dt)
+        if info.kind == KIND_SCALAR and info.dtype is not None:
+            if not np.issubdtype(np.dtype(info.dtype), np.number):
+                return None
+            return ArrayMeta((1,), "float32")
+        return None
+
+    # -- presentation --
+
+    def summary(self) -> dict[str, tuple]:
+        return {k: v.summary() for k, v in self.columns.items()}
+
+    def empty_table(self) -> Any:
+        """A 0-row DataTable realizing this schema — the probe the analyzer
+        feeds to opaque UDF stages (LambdaTransformer) so their column
+        effects are observed without touching real data."""
+        from mmlspark_tpu.data.table import DataTable
+        cols = {}
+        for name, info in self.columns.items():
+            if info.kind == KIND_SCALAR and info.dtype not in (None, "str",
+                                                               "datetime"):
+                cols[name] = np.empty(0, dtype=np.dtype(info.dtype))
+            else:
+                cols[name] = np.empty(0, dtype=object)
+        return DataTable(cols, {k: dict(v.meta)
+                                for k, v in self.columns.items() if v.meta})
+
+    def __repr__(self) -> str:
+        cols = ", ".join(
+            f"{k}:{v.kind}" + (f"{list(v.shape)}" if v.shape else "")
+            for k, v in self.columns.items())
+        return f"TableSchema[{cols}]{'' if self.exact else ' (inexact)'}"

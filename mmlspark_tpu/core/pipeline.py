@@ -15,6 +15,17 @@ from mmlspark_tpu.core.stage import Estimator, PipelineStage, Transformer
 from mmlspark_tpu.data.table import DataTable
 
 
+def _fold_state(stages: Sequence[PipelineStage] | None, schema: Any,
+                n: int | None) -> tuple[Any, int | None]:
+    """Chain the stages' (schema, rows) inference in ONE pass (the SparkML
+    transformSchema fold) — shared by Pipeline and PipelineModel so fitted
+    and unfitted analysis cannot diverge, and so each inner stage's
+    inference (including UDF probes) runs exactly once per walk."""
+    for stage in stages or []:
+        schema, n = stage._infer_state(schema, n)
+    return schema, n
+
+
 class Pipeline(Estimator):
     """Ordered composition of stages fit as one estimator.
 
@@ -35,22 +46,37 @@ class Pipeline(Estimator):
         fitted: list[Transformer] = []
         current = table
         stages = self.stages or []
+        # pre-flight: reject mis-wired stage lists with every offending
+        # index/type up front (the analyzer's check), not a bare TypeError
+        # from whichever stage happens to break first
+        from mmlspark_tpu.analysis.analyzer import check_stage_kinds
+        bad = check_stage_kinds(stages)
+        if bad:
+            raise TypeError(
+                "Pipeline has invalid stages:\n  "
+                + "\n  ".join(d.message for d in bad))
         last_est = max((i for i, s in enumerate(stages)
                         if isinstance(s, Estimator)), default=-1)
         for i, stage in enumerate(stages):
             if isinstance(stage, Estimator):
                 model = stage.fit(current)
-            elif isinstance(stage, Transformer):
-                model = stage
             else:
-                raise TypeError(
-                    f"stage {i} ({type(stage).__name__}) is neither "
-                    "Transformer nor Estimator")
+                model = stage
             # only transform while a later estimator still needs the table
             if i < last_est:
                 current = model.transform(current)
             fitted.append(model)
         return PipelineModel(stages=fitted)
+
+    def infer_schema(self, schema: Any) -> Any:
+        return _fold_state(self.stages, schema, None)[0]
+
+    def infer_rows(self, n: int | None, schema: Any) -> int | None:
+        return _fold_state(self.stages, schema, n)[1]
+
+    def _infer_state(self, schema: Any, n: int | None
+                     ) -> tuple[Any, int | None]:
+        return _fold_state(self.stages, schema, n)
 
 
 class PipelineModel(Transformer):
@@ -86,3 +112,13 @@ class PipelineModel(Transformer):
         from mmlspark_tpu.core import plan
         return plan.execute_stages(list(self.stages or []), table,
                                    cache_host=self)
+
+    def infer_schema(self, schema: Any) -> Any:
+        return _fold_state(self.stages, schema, None)[0]
+
+    def infer_rows(self, n: int | None, schema: Any) -> int | None:
+        return _fold_state(self.stages, schema, n)[1]
+
+    def _infer_state(self, schema: Any, n: int | None
+                     ) -> tuple[Any, int | None]:
+        return _fold_state(self.stages, schema, n)

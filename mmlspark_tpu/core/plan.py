@@ -273,16 +273,34 @@ class _Segment:
         return self.start + len(self.stages)
 
 
-def _collect_segment(stages: list, i: int, table: DataTable
-                     ) -> _Segment | None:
+def collect_segment(stages: list, i: int,
+                    meta_of: Callable[[str], ArrayMeta | None],
+                    explain: list | None = None) -> _Segment | None:
+    """Root a maximal device segment at ``stages[i]``, resolving the entry
+    column's layout through ``meta_of`` (a concrete-table probe at execution
+    time; an abstract :class:`~mmlspark_tpu.analysis.info.TableSchema`
+    lookup when the pre-flight analyzer replays this exact logic with no
+    data). ``explain``, when given, collects human-readable reasons the
+    segment broke or never formed — the device-plan audit's trace."""
+
+    def note(msg: str) -> None:
+        if explain is not None:
+            explain.append(msg)
+
     s0 = stages[i]
     if not isinstance(s0, DeviceStage):
+        note(f"stage {i} ({type(s0).__name__}) is not a DeviceStage")
         return None
     entry_col = s0.device_input_col()
     if entry_col is None:
+        note(f"stage {i} ({type(s0).__name__}) declines device execution "
+             "for its current configuration (no device input column)")
         return None
-    entry_meta = _entry_meta(table, entry_col)
+    entry_meta = meta_of(entry_col)
     if entry_meta is None:
+        note(f"stage {i} ({type(s0).__name__}): entry column "
+             f"{entry_col!r} has no device-coercible layout "
+             "(missing, ragged, non-numeric, or unknown shape)")
         return None
     env: dict[str, ArrayMeta] = {entry_col: entry_meta}
     seg_stages: list = []
@@ -294,13 +312,25 @@ def _collect_segment(stages: list, i: int, table: DataTable
     while j < len(stages):
         s = stages[j]
         if not isinstance(s, DeviceStage):
+            note(f"segment breaks at stage {j}: {type(s).__name__} "
+                 "is not a DeviceStage")
             break
         in_col = s.device_input_col()
         out_col = s.device_output_col()
-        if in_col is None or out_col is None or in_col not in env:
+        if in_col is None or out_col is None:
+            note(f"segment breaks at stage {j}: {type(s).__name__} "
+                 "declines device execution (no device input/output column)")
+            break
+        if in_col not in env:
+            note(f"segment breaks at stage {j}: input column {in_col!r} "
+                 "is not device-resident (host-produced columns are never "
+                 "re-uploaded mid-run)")
             break
         op = _stage_device_fn(s, env[in_col])
         if op is None:
+            note(f"segment breaks at stage {j}: "
+                 f"{type(s).__name__}.device_fn declined the incoming "
+                 f"layout {env[in_col]}")
             break
         metas_in.append(env[in_col])
         seg_stages.append(s)
@@ -311,9 +341,17 @@ def _collect_segment(stages: list, i: int, table: DataTable
         out_metas[out_col] = op.out_meta
         j += 1
     if len(seg_stages) < 2:
+        if len(seg_stages) == 1:
+            note(f"stage {i} ({type(s0).__name__}) is a lone device stage "
+                 "(a segment needs >= 2): it keeps its own transform path")
         return None
     return _Segment(i, seg_stages, entry_col, entry_meta, metas_in,
                     out_cols, emitters, out_metas)
+
+
+def _collect_segment(stages: list, i: int, table: DataTable
+                     ) -> _Segment | None:
+    return collect_segment(stages, i, lambda col: _entry_meta(table, col))
 
 
 def describe_plan(stages: list, table: DataTable) -> list[tuple[str, list]]:
@@ -398,8 +436,7 @@ def _compile_segment(seg: _Segment) -> tuple:
     data = mesh_lib.batch_sharding(mesh)
     dev_params = jax.device_put(params_tuple, repl)
     fn = jax.jit(composite, in_shardings=(repl, data), out_shardings=data)
-    dp = mesh.shape["dp"] * mesh.shape["fsdp"]
-    return fn, dev_params, data, dp
+    return fn, dev_params, data, mesh_dp(mesh)
 
 
 def _segment_minibatch(seg: _Segment) -> tuple[int, int]:
@@ -411,6 +448,38 @@ def _segment_minibatch(seg: _Segment) -> tuple[int, int]:
     inflights = [int(s.max_inflight) for s in seg.stages
                  if getattr(s, "max_inflight", None)]
     return size, (min(inflights) if inflights else 8)
+
+
+def mesh_dp(mesh: Any) -> int:
+    """The data extent minibatches must divide over: 1 on a single-device
+    mesh (the plain-placement fast path), else the dp×fsdp product. The
+    ONE definition shared by the executor and the pre-flight predictors."""
+    if mesh.devices.size == 1:
+        return 1
+    return mesh.shape["dp"] * mesh.shape["fsdp"]
+
+
+def dp_rounded_minibatch(size: int, dp: int, n_rows: int) -> int:
+    """The executor's minibatch sizing: cap at the row count, then round UP
+    to a dp multiple (padding covers the excess) so every chip gets rows.
+    Shared with the pre-flight crossing predictors so predictions cannot
+    drift from execution."""
+    return -(-min(int(size), n_rows) // dp) * dp
+
+
+def predict_segment_minibatches(seg: _Segment, n_rows: int) -> int:
+    """How many fixed-shape minibatches a fused run of ``seg`` over
+    ``n_rows`` rows costs — one H2D upload and one async D2H fetch round
+    each. Same sizing arithmetic as :func:`_run_segment` via the shared
+    helpers, without compiling or transferring anything. Note: reading the
+    segment's mesh initializes the jax backend (device *enumeration*, not
+    execution) — pre-flight callers on shared hosts should pin
+    ``JAX_PLATFORMS=cpu``."""
+    if n_rows <= 0:
+        return 0
+    size, _ = _segment_minibatch(seg)
+    size = dp_rounded_minibatch(size, mesh_dp(_segment_mesh(seg)), n_rows)
+    return -(-n_rows // size)
 
 
 # compiled segments kept per cache_host; LRU-capped so streaming sources
@@ -450,9 +519,8 @@ def _run_segment(seg: _Segment, table: DataTable,
     else:
         fn, dev_params, target, dp = _compile_segment(seg)
 
-    # minibatch must divide over the data axes: round UP to a dp multiple
-    # (padding covers the excess) so every chip gets rows
-    size = -(-min(size, len(batch)) // dp) * dp
+    # minibatch must divide over the data axes (shared sizing helper)
+    size = dp_rounded_minibatch(size, dp, len(batch))
 
     names = "→".join(type(s).__name__ for s in seg.stages)
     with timed(f"FusedSegment[{names}]", _log, len(table)):

@@ -23,7 +23,7 @@ import numpy as np
 # the image-struct contract's canonical definitions live next to the Arrow
 # wire format in data.table; re-exported here as the schema-facing names
 from mmlspark_tpu.data.table import (  # noqa: F401
-    DataTable, IMAGE_FIELDS, K_IMAGE as _K_IMAGE,
+    DataTable, IMAGE_FIELDS, K_IMAGE as _K_IMAGE, is_missing as _is_missing,
 )
 
 
@@ -141,8 +141,16 @@ def is_image_column(table: DataTable, column: str) -> bool:
     if table.column_meta(column).get(SchemaConstants.K_IMAGE):
         return True
     col = table[column]
-    if len(col) and isinstance(col[0], dict):
-        return set(IMAGE_FIELDS).issubset(col[0].keys())
+    if col.dtype != object:
+        return False
+    # probe the first NON-MISSING cell: a leading None/NaN (a failed
+    # decode, a missing row) must not hide an otherwise-image column
+    for v in col:
+        if _is_missing(v):
+            continue
+        if isinstance(v, dict):
+            return set(IMAGE_FIELDS).issubset(v.keys())
+        return False
     return False
 
 

@@ -63,6 +63,77 @@ class PipelineStage(Params):
     def _load_extra(self, directory: str) -> None:
         pass
 
+    # -- static schema inference (the transformSchema analog) --
+    #
+    # The pre-flight analyzer (mmlspark_tpu/analysis) walks a pipeline's
+    # stages calling infer_schema with NO data and NO device execution.
+    # A stage maps the incoming abstract TableSchema to the schema its
+    # transform would produce, raising analysis.info.SchemaError on a
+    # contract violation (missing column, wrong kind, size mismatch).
+    # The default below is derived from the declared column-role params;
+    # stages whose output layout is computable (image geometry, model
+    # forwards via jax.eval_shape) override it.
+
+    def _declared_input_columns(self) -> list[str]:
+        """Column names this stage reads, per its column-role params."""
+        declared = type(self).params()
+        cols: list[str] = []
+        if "input_col" in declared and self.get("input_col"):
+            cols.append(self.get("input_col"))
+        if "input_cols" in declared and self.get("input_cols"):
+            cols.extend(self.get("input_cols"))
+        if isinstance(self, Estimator) and "label_col" in declared \
+                and self.get("label_col"):
+            cols.append(self.get("label_col"))
+        return cols
+
+    def _declared_output_columns(self) -> list[str]:
+        declared = type(self).params()
+        cols: list[str] = []
+        if "output_col" in declared and self.get("output_col"):
+            cols.append(self.get("output_col"))
+        if "output_cols" in declared and self.get("output_cols"):
+            cols.extend(self.get("output_cols"))
+        return cols
+
+    def infer_schema(self, schema: Any) -> Any:
+        """Map an abstract input schema to this stage's output schema.
+
+        Default: require every declared input column to exist and add the
+        declared output columns with unknown layout. Override to compute
+        real output dtypes/shapes (and to enforce stronger contracts).
+        """
+        from mmlspark_tpu.analysis.info import ColumnInfo, SchemaError
+        missing = [c for c in self._declared_input_columns()
+                   if c not in schema]
+        out = schema.copy()
+        if missing:
+            msg = (f"{type(self).__name__} reads missing column(s) "
+                   f"{missing}; available: {list(schema)}")
+            if schema.exact:
+                raise SchemaError("missing-input-column", msg)
+            out.warn("missing-input-column", msg + " (schema is inexact: "
+                     "an opaque stage may have added them)", "info")
+        for c in self._declared_output_columns():
+            out.columns[c] = ColumnInfo.unknown()
+        return out
+
+    def infer_rows(self, n: int | None, schema: Any) -> int | None:
+        """Predicted output row count for ``n`` input rows (None =
+        unknown). Default: row-preserving; sampling/augmenting/dropping
+        stages override."""
+        return n
+
+    def _infer_state(self, schema: Any, n: int | None
+                     ) -> tuple[Any, int | None]:
+        """One-pass (schema, rows) inference — the analyzer's entry point.
+        Default composes the two public hooks (rows first: ``infer_rows``
+        reads the PRE-stage schema); Pipeline/PipelineModel override to
+        fold their stages once, so nested analysis work (UDF probes,
+        eval_shape traces) runs a single time per walk."""
+        rows = None if n is None else self.infer_rows(n, schema)
+        return self.infer_schema(schema), rows
+
     def __repr__(self) -> str:
         sets = ", ".join(f"{k}={v!r}" for k, v in
                          self._simple_param_values().items())
@@ -222,3 +293,39 @@ class LambdaTransformer(Transformer):
 
     def transform(self, table: DataTable) -> DataTable:
         return self.fn(table)
+
+    def infer_schema(self, schema: Any) -> Any:
+        """Probe the UDF on a 0-row table realizing the schema: the column
+        *set* it produces is observed concretely, while cell layouts of
+        columns it touches become unknown (nothing provable about a UDF's
+        values from zero rows). If the UDF cannot run on an empty table the
+        schema degrades to inexact instead of failing the analysis."""
+        from mmlspark_tpu.analysis.info import ColumnInfo, TableSchema
+        try:
+            empty = schema.empty_table()
+            probed = self.fn(empty)
+        except Exception as e:
+            out = schema.as_inexact()
+            out.warn(
+                "opaque-stage",
+                f"LambdaTransformer fn could not be probed on an empty "
+                f"table ({type(e).__name__}: {e}); downstream column "
+                "checks are best-effort", "info")
+            return out
+        cols = {}
+        for name in probed.columns:
+            if name in empty and name in schema.columns \
+                    and probed[name] is empty[name]:
+                cols[name] = schema.columns[name].copy()  # untouched
+            else:
+                cols[name] = ColumnInfo.unknown(
+                    meta=dict(probed.column_meta(name)))
+        out = TableSchema(cols, exact=schema.exact)
+        out.pending = list(schema.pending)  # findings ride along the fold
+        return out
+
+    def infer_rows(self, n: int | None, schema: Any) -> int | None:
+        # a UDF may filter or expand rows; assume row-preserving (the
+        # common case) — the plan audit's crossing prediction documents
+        # this as an approximation for row-changing UDFs
+        return n

@@ -111,6 +111,83 @@ class TrainClassifier(Estimator, HasLabelCol):
             featurize_model=feat_model, fitted_learner=fitted,
             label_levels=list(levels))
 
+    def infer_schema(self, schema: Any) -> Any:
+        return _train_infer_schema(self, schema, classification=True)
+
+    def infer_rows(self, n: int | None, schema: Any) -> int | None:
+        return _train_infer_rows(self, n, schema)
+
+
+def _score_column_infos(uid: str, kind: str, num_classes: int | None,
+                        label_info: Any, classification: bool) -> dict:
+    """The abstract score columns a Trained*Model.transform writes, with
+    the metadata protocol stamped (what the evaluators will read)."""
+    from mmlspark_tpu.analysis.info import ColumnInfo
+
+    def stamp(info: Any, purpose: str) -> Any:
+        info.meta[SchemaConstants.K_COLUMN_PURPOSE] = purpose
+        info.meta[SchemaConstants.K_MODEL_UID] = uid
+        info.meta[SchemaConstants.K_SCORE_VALUE_KIND] = kind
+        return info
+
+    if not classification:
+        return {SchemaConstants.SCORES_COLUMN: stamp(
+            ColumnInfo.scalar("float64"), SchemaConstants.SCORES_COLUMN)}
+    labels = (label_info.copy() if label_info is not None
+              else ColumnInfo.unknown())
+    labels.has_missing = True  # out-of-range codes emit None
+    return {
+        SchemaConstants.SCORES_COLUMN: stamp(
+            ColumnInfo.vector(num_classes, "float64"),
+            SchemaConstants.SCORES_COLUMN),
+        SchemaConstants.SCORED_LABELS_COLUMN: stamp(
+            labels, SchemaConstants.SCORED_LABELS_COLUMN),
+        SchemaConstants.SCORED_PROBABILITIES_COLUMN: stamp(
+            ColumnInfo.vector(num_classes, "float64"),
+            SchemaConstants.SCORED_PROBABILITIES_COLUMN),
+    }
+
+
+def _train_infer_schema(est: Any, schema: Any, classification: bool) -> Any:
+    """Shared TrainClassifier/TrainRegressor estimator inference: label
+    and feature columns must exist; the fitted model will add the stamped
+    score columns (widths are fit-time artifacts)."""
+    from mmlspark_tpu.analysis.info import SchemaError
+    out = schema.copy()
+    if est.label_col not in out.columns and schema.exact:
+        raise SchemaError(
+            "missing-input-column",
+            f"{type(est).__name__} trains on missing label column "
+            f"{est.label_col!r}; available: {list(schema)}")
+    missing = [c for c in (est.feature_columns or [])
+               if c not in out.columns]
+    if missing and schema.exact:
+        raise SchemaError(
+            "missing-input-column",
+            f"{type(est).__name__} featurizes missing column(s) "
+            f"{missing}; available: {list(schema)}")
+    kind = (SchemaConstants.CLASSIFICATION_KIND if classification
+            else SchemaConstants.REGRESSION_KIND)
+    out.columns.update(_score_column_infos(
+        est.uid, kind, None, out.get(est.label_col), classification))
+    return out
+
+
+def _train_infer_rows(est: Any, n: int | None, schema: Any) -> int | None:
+    """Train* fitting drops rows with missing labels and the featurization
+    na.drop may remove more — the count is unknowable when any consumed
+    column can hold missing values."""
+    if n is None:
+        return None
+    cols = list(est.feature_columns
+                or [c for c in schema.columns if c != est.label_col])
+    cols.append(est.label_col)
+    for c in cols:
+        ci = schema.get(c)
+        if ci is not None and ci.has_missing:
+            return None
+    return n
+
 
 class TrainedClassifierModel(Transformer, HasLabelCol):
     """Fitted :class:`TrainClassifier`: featurizes, scores, and stamps
@@ -160,3 +237,32 @@ class TrainedClassifierModel(Transformer, HasLabelCol):
         if self.label_col in out:
             out = set_label_column(out, self.uid, self.label_col, kind)
         return out
+
+    def infer_schema(self, schema: Any) -> Any:
+        out = self.featurize_model.infer_schema(schema)
+        out = out.drop(self.features_col)
+        levels = list(self.label_levels or [])
+        label_info = schema.get(self.label_col)
+        infos = _score_column_infos(
+            self.uid, SchemaConstants.CLASSIFICATION_KIND,
+            len(levels) or None, label_info, classification=True)
+        labels_col = SchemaConstants.SCORED_LABELS_COLUMN
+        infos[labels_col].meta[SchemaConstants.K_IS_CATEGORICAL] = True
+        infos[labels_col].meta[
+            SchemaConstants.K_CATEGORICAL_LEVELS] = levels
+        out.columns.update(infos)
+        if self.label_col in out.columns:
+            li = out.columns[self.label_col]
+            li.meta[SchemaConstants.K_COLUMN_PURPOSE] = \
+                SchemaConstants.LABEL_COLUMN
+            li.meta[SchemaConstants.K_MODEL_UID] = self.uid
+            li.meta[SchemaConstants.K_SCORE_VALUE_KIND] = \
+                SchemaConstants.CLASSIFICATION_KIND
+        return out
+
+    def infer_rows(self, n: int | None, schema: Any) -> int | None:
+        # scoring re-runs the featurization, whose na.drop analog may
+        # remove rows — delegate to the fitted featurize pipeline
+        if n is None:
+            return None
+        return self.featurize_model.infer_rows(n, schema)

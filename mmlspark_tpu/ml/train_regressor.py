@@ -57,6 +57,14 @@ class TrainRegressor(Estimator, HasLabelCol):
             label_col=self.label_col, features_col=features_col,
             featurize_model=feat_model, fitted_learner=fitted)
 
+    def infer_schema(self, schema):
+        from mmlspark_tpu.ml.train_classifier import _train_infer_schema
+        return _train_infer_schema(self, schema, classification=False)
+
+    def infer_rows(self, n, schema):
+        from mmlspark_tpu.ml.train_classifier import _train_infer_rows
+        return _train_infer_rows(self, n, schema)
+
 
 class TrainedRegressorModel(Transformer, HasLabelCol):
     """Fitted :class:`TrainRegressor`: featurizes, predicts, and stamps
@@ -83,3 +91,26 @@ class TrainedRegressorModel(Transformer, HasLabelCol):
         if self.label_col in out:
             out = set_label_column(out, self.uid, self.label_col, kind)
         return out
+
+    def infer_schema(self, schema):
+        from mmlspark_tpu.ml.train_classifier import _score_column_infos
+        out = self.featurize_model.infer_schema(schema)
+        out = out.drop(self.features_col)
+        out.columns.update(_score_column_infos(
+            self.uid, SchemaConstants.REGRESSION_KIND, None, None,
+            classification=False))
+        if self.label_col in out.columns:
+            li = out.columns[self.label_col]
+            li.meta[SchemaConstants.K_COLUMN_PURPOSE] = \
+                SchemaConstants.LABEL_COLUMN
+            li.meta[SchemaConstants.K_MODEL_UID] = self.uid
+            li.meta[SchemaConstants.K_SCORE_VALUE_KIND] = \
+                SchemaConstants.REGRESSION_KIND
+        return out
+
+    def infer_rows(self, n, schema):
+        # scoring re-runs the featurization, whose na.drop analog may
+        # remove rows — delegate to the fitted featurize pipeline
+        if n is None:
+            return None
+        return self.featurize_model.infer_rows(n, schema)

@@ -110,6 +110,25 @@ class ImageFeaturizer(Transformer, DeviceStage, HasInputCol, HasOutputCol):
         from mmlspark_tpu.core import plan
         return plan.execute_stages(self._stages(), table, cache_host=self)
 
+    # ---- static schema inference: compose the internal resize→forward
+    #      stages' own inference, so the predicted features layout is the
+    #      traced truth (eval_shape through the truncated node) and the
+    #      materialized resized image column is modeled too ----
+
+    def infer_schema(self, schema: Any) -> Any:
+        from mmlspark_tpu.analysis.info import (
+            SchemaError, require_image_input,
+        )
+        if self.model is None:
+            raise SchemaError(
+                "model-not-set",
+                "ImageFeaturizer has no model bundle; set model=, "
+                "set_model_by_name(), or set_model_from_repo() first")
+        require_image_input(schema, self.input_col, "ImageFeaturizer")
+        for stage in self._stages():
+            schema = stage.infer_schema(schema)
+        return schema
+
     # ---- DeviceStage protocol: resize∘forward as one composable op, so
     #      an ImageFeaturizer inside a larger pipeline fuses with its
     #      neighbors. Declines when the resize would actually change the

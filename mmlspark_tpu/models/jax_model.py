@@ -37,7 +37,9 @@ from mmlspark_tpu.core.logging_utils import get_logger, timed
 from mmlspark_tpu.core.params import Param
 # minibatches lives in core.plan (shared with fused pipeline segments);
 # re-exported here for the bridge and existing callers
-from mmlspark_tpu.core.plan import minibatches, pipeline_minibatches  # noqa: F401
+from mmlspark_tpu.core.plan import (  # noqa: F401
+    dp_rounded_minibatch, mesh_dp, minibatches, pipeline_minibatches,
+)
 from mmlspark_tpu.core.schema import is_image_column
 from mmlspark_tpu.core.stage import (
     ArrayMeta, DeviceOp, DeviceStage, HasInputCol, HasOutputCol, Transformer,
@@ -225,8 +227,7 @@ class JaxModel(Transformer, DeviceStage, HasInputCol, HasOutputCol):
         data = mesh_lib.batch_sharding(mesh)
         dev_params = jax.device_put(bundle.params, repl)
         fn = jax.jit(fwd, in_shardings=(repl, data), out_shardings=data)
-        dp = mesh.shape["dp"] * mesh.shape["fsdp"]
-        cache[key] = (fn, dev_params, data, dp,
+        cache[key] = (fn, dev_params, data, mesh_dp(mesh),
                       (bundle.module, bundle.params))
         return cache[key][:4]
 
@@ -242,9 +243,8 @@ class JaxModel(Transformer, DeviceStage, HasInputCol, HasOutputCol):
             batch = coerce_input_matrix(table, self.input_col,
                                         bundle.input_spec)
             fn, dev_params, data, dp = self._compiled_apply(bundle, node)
-            # minibatch must divide over the data axes: round UP to a dp
-            # multiple (padding covers the excess) so every chip gets rows
-            size = -(-min(size, len(batch)) // dp) * dp
+            # minibatch must divide over the data axes (shared sizing)
+            size = dp_rounded_minibatch(size, dp, len(batch))
             # the three-stage upload/compute/fetch software pipeline with
             # the max_inflight HBM bound, shared with fused pipeline
             # segments (core.plan)
@@ -256,6 +256,67 @@ class JaxModel(Transformer, DeviceStage, HasInputCol, HasOutputCol):
         else:
             out_col = list(result)
         return table.with_column(self.output_col, out_col)
+
+    # ---- static schema inference ----
+
+    def infer_schema(self, schema: Any) -> Any:
+        """The traced truth: the predicted output layout comes from
+        ``jax.eval_shape`` over the same forward ``device_fn`` composes —
+        no data, no device execution, no compilation. A provable per-row
+        size mismatch against the bundle's ``input_spec`` is rejected here
+        instead of as an XLA shape error after the H2D upload."""
+        from mmlspark_tpu.analysis.info import (
+            KIND_IMAGE, ColumnInfo, SchemaError,
+        )
+        out = schema.copy()
+        info = out.get(self.input_col)
+        if info is None:
+            if schema.exact:
+                raise SchemaError(
+                    "missing-input-column",
+                    f"JaxModel reads missing column {self.input_col!r}; "
+                    f"available: {list(schema)}")
+            info = ColumnInfo.unknown()
+        bundle: ModelBundle = self.model
+        if bundle is None:
+            raise SchemaError(
+                "model-not-set",
+                "JaxModel has no model bundle; set model= or "
+                "set_model_location() before running the pipeline")
+        try:
+            node = self._resolve_node(bundle)
+        except Exception as e:
+            raise SchemaError("bad-output-node", str(e))
+        spec = tuple(bundle.input_spec)
+        want = int(np.prod(spec))
+        size = info.row_size
+        if size is not None and size != want:
+            kind_note = ("an image column unrolling to"
+                         if info.kind == KIND_IMAGE else "per-row size")
+            raise SchemaError(
+                "input-size-mismatch",
+                f"column {self.input_col!r} is {kind_note} {size} values "
+                f"but model {bundle.name!r} expects input_spec {spec} "
+                f"({want} values)")
+        meta = schema.entry_meta(self.input_col)
+        if meta is None or int(np.prod(meta.shape)) != want:
+            # layout not statically coercible; trace with the model's own
+            # spec (what coerce_input_matrix reshapes to)
+            meta = ArrayMeta(spec, "float32")
+        from mmlspark_tpu.core.plan import _stage_device_fn
+        op = _stage_device_fn(self, meta)  # memoized eval_shape trace
+        if op is None:  # pragma: no cover - defensive; sizes matched above
+            raise SchemaError(
+                "device-fn-declined",
+                f"JaxModel.device_fn declined layout {meta}")
+        shape = tuple(op.out_meta.shape)
+        if shape == ():
+            out.columns[self.output_col] = ColumnInfo.scalar(
+                op.out_meta.dtype)
+        else:
+            out.columns[self.output_col] = ColumnInfo.vector(
+                int(np.prod(shape)), op.out_meta.dtype)
+        return out
 
     # ---- DeviceStage protocol: lets the pipeline planner fuse this model
     #      with adjacent device stages into one compiled program ----

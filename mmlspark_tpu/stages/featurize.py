@@ -178,6 +178,65 @@ class AssembleFeatures(Estimator, HasFeaturesCol):
             number_of_features=self.number_of_features,
             selected_slots=selected_slots)
 
+    def infer_schema(self, schema: Any) -> Any:
+        """Pre-fit contract check: every column to featurize must exist and
+        image columns need ``allow_images``. The assembled width is
+        computed when provable (no text columns — slot selection is a
+        fit-time artifact)."""
+        from mmlspark_tpu.analysis import info as ai
+        out = schema.copy()
+        cols = list(self.columns_to_featurize or schema.columns)
+        width: int | None = 0
+        for c in cols:
+            if c not in out.columns:
+                if schema.exact:
+                    raise ai.SchemaError(
+                        "missing-input-column",
+                        f"AssembleFeatures featurizes missing column "
+                        f"{c!r}; available: {list(schema)}")
+                width = None
+                continue
+            ci = out.columns[c]
+            if ci.kind == ai.KIND_IMAGE and not self.allow_images:
+                raise ai.SchemaError(
+                    "images-not-allowed",
+                    f"column {c!r} is an image column but allow_images is "
+                    "False — this assembly is vector-only; set "
+                    "allow_images=True or unroll/featurize the images "
+                    "first")
+            w = _abstract_block_width(ci, bool(
+                self.one_hot_encode_categoricals))
+            width = None if (width is None or w is None) else width + w
+        out.columns[self.features_col] = ai.ColumnInfo.vector(
+            width, "float32")
+        if width is not None:
+            out.columns[self.features_col].meta[
+                SchemaConstants.K_VECTOR_SIZE] = int(width)
+        return out
+
+
+def _abstract_block_width(ci: Any, one_hot: bool) -> int | None:
+    """Width one column contributes to the assembled vector, from its
+    abstract info; None when not statically provable."""
+    from mmlspark_tpu.analysis import info as ai
+    if ci.meta.get(SchemaConstants.K_IS_CATEGORICAL):
+        levels = ci.meta.get(SchemaConstants.K_CATEGORICAL_LEVELS)
+        if levels is None:
+            # categorical with fit-time levels (an unfitted ValueIndexer
+            # upstream): the one-hot width is not provable yet
+            return None
+        return (len(levels) - 1) if one_hot else 1
+    if ci.kind == ai.KIND_SCALAR:
+        return 1
+    if ci.kind == ai.KIND_DATE:
+        return 8
+    if ci.kind == ai.KIND_VECTOR:
+        return ci.row_size
+    if ci.kind == ai.KIND_IMAGE:
+        s = ci.concrete_shape
+        return None if s is None else 2 + int(np.prod(s))
+    return None  # text/tokens (fit-time slots), object, unknown
+
 
 class AssembleFeaturesModel(Transformer, DeviceStage, HasFeaturesCol):
     """Fitted :class:`AssembleFeatures`: applies the per-column featurization
@@ -292,6 +351,90 @@ class AssembleFeaturesModel(Transformer, DeviceStage, HasFeaturesCol):
         return out.with_meta(
             self.features_col,
             **{SchemaConstants.K_VECTOR_SIZE: int(features.shape[1])})
+
+    # ---- static schema inference ----
+
+    def infer_schema(self, schema: Any) -> Any:
+        """Check the fitted plan still matches the incoming schema: every
+        planned column present and of the planned kind (an image column
+        reaching a numeric/vector slot is the image-vs-vector confusion),
+        categorical levels unchanged since fit (silent mis-encoding
+        otherwise), and the assembled width computed exactly."""
+        from mmlspark_tpu.analysis import info as ai
+        out = schema.copy()
+        width: int | None = 0
+        text_counted = False
+        for entry in self.plan or []:
+            c, kind = entry["col"], entry["kind"]
+            ci = out.get(c)
+            if ci is None:
+                if schema.exact:
+                    raise ai.SchemaError(
+                        "missing-input-column",
+                        f"featurization plan reads missing column {c!r}; "
+                        f"available: {list(schema)}")
+                width = None
+                continue
+            w: int | None
+            if kind == _KIND_CATEGORICAL:
+                levels = entry.get("levels") or []
+                seen = ci.meta.get(SchemaConstants.K_CATEGORICAL_LEVELS)
+                if seen is not None and list(seen) != list(levels):
+                    out.warn(
+                        "categorical-level-drift",
+                        f"column {c!r} was fitted with levels "
+                        f"{levels!r:.80} but now carries {seen!r:.80}; "
+                        "codes will be mis-encoded silently")
+                w = (len(levels) - 1) if entry.get("one_hot", True) else 1
+            elif kind == _KIND_IMAGE:
+                if ci.kind not in (ai.KIND_IMAGE, ai.KIND_OBJECT,
+                                   ai.KIND_UNKNOWN):
+                    raise ai.SchemaError(
+                        "plan-schema-mismatch",
+                        f"featurization plan expects column {c!r} to be an "
+                        f"image column but it is now {ci.kind}")
+                s = ci.concrete_shape
+                w = None if s is None else 2 + int(np.prod(s))
+            elif kind in (_KIND_STRING, _KIND_TOKENS):
+                # every text column hashes into ONE shared slot block
+                w = 0 if text_counted else len(self.selected_slots or [])
+                text_counted = True
+            elif kind == _KIND_DATE:
+                w = 8
+            elif kind == _KIND_VECTOR:
+                if ci.kind == ai.KIND_IMAGE:
+                    raise ai.SchemaError(
+                        "plan-schema-mismatch",
+                        f"featurization plan expects column {c!r} as a "
+                        "numeric vector but it is now an image column — "
+                        "vector-only assembly cannot consume images")
+                w = entry.get("size")
+            else:  # numeric / bool
+                if ci.kind == ai.KIND_IMAGE:
+                    raise ai.SchemaError(
+                        "plan-schema-mismatch",
+                        f"featurization plan expects column {c!r} as "
+                        f"{kind} but it is now an image column — "
+                        "vector-only assembly cannot consume images")
+                w = 1
+            width = None if (width is None or w is None) else width + w
+        info = ai.ColumnInfo.vector(width, "float32")
+        if width is not None:
+            info.meta[SchemaConstants.K_VECTOR_SIZE] = int(width)
+        out.columns[self.features_col] = info
+        return out
+
+    def infer_rows(self, n: int | None, schema: Any) -> int | None:
+        # the na.drop analog removes rows with missing values in any
+        # featurized column; when missing rows are possible the output
+        # count is unknowable statically
+        if n is None:
+            return None
+        for entry in self.plan or []:
+            ci = schema.get(entry["col"])
+            if ci is not None and ci.has_missing:
+                return None
+        return n
 
     # ---- DeviceStage protocol: the numeric image assembly as a fused op.
     #      Only the single-image-column plan qualifies — it is the one

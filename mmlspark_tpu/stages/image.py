@@ -47,19 +47,23 @@ def _op_crop(img: np.ndarray, p: Mapping) -> np.ndarray:
     return img[y:y + h, x:x + w]
 
 
+# format name → cv2 conversion-code attribute; the name set is shared with
+# schema inference so bad formats are rejected pre-flight, not per-row
+_COLOR_FORMAT_CODES = {
+    "gray": "COLOR_BGR2GRAY", "grey": "COLOR_BGR2GRAY",
+    "rgb": "COLOR_BGR2RGB", "hsv": "COLOR_BGR2HSV",
+    "luv": "COLOR_BGR2LUV", "lab": "COLOR_BGR2LAB",
+    "yuv": "COLOR_BGR2YUV",
+}
+
+
 def _op_color_format(img: np.ndarray, p: Mapping) -> np.ndarray:
     import cv2
     fmt = p["format"]
-    codes = {
-        "gray": cv2.COLOR_BGR2GRAY, "grey": cv2.COLOR_BGR2GRAY,
-        "rgb": cv2.COLOR_BGR2RGB, "hsv": cv2.COLOR_BGR2HSV,
-        "luv": cv2.COLOR_BGR2LUV, "lab": cv2.COLOR_BGR2LAB,
-        "yuv": cv2.COLOR_BGR2YUV,
-    }
-    if fmt not in codes:
+    if fmt not in _COLOR_FORMAT_CODES:
         raise ValueError(f"unknown color format {fmt!r}; "
-                         f"one of {sorted(codes)}")
-    out = cv2.cvtColor(img, codes[fmt])
+                         f"one of {sorted(_COLOR_FORMAT_CODES)}")
+    out = cv2.cvtColor(img, getattr(cv2, _COLOR_FORMAT_CODES[fmt]))
     return out if out.ndim == 3 else out[:, :, None]
 
 
@@ -245,6 +249,51 @@ class ImageTransformer(Transformer, DeviceStage, HasInputCol, HasOutputCol):
         table = table.with_column(self.output_col, out)
         return mark_image_column(table, self.output_col)
 
+    # ---- static schema inference ----
+
+    def infer_schema(self, schema: Any) -> Any:
+        """Replay the op list over the abstract image geometry: resize and
+        crop rewrite (h, w), color_format rewrites channels, and an
+        out-of-bounds crop or unknown op is rejected here instead of as a
+        per-row error mid-transform."""
+        from mmlspark_tpu.analysis.info import (
+            KIND_IMAGE, ColumnInfo, SchemaError, require_image_input,
+        )
+        out = schema.copy()
+        info = require_image_input(out, self.input_col, "ImageTransformer")
+        shape = info.shape if info.kind == KIND_IMAGE and info.shape else \
+            (None, None, None)
+        h, w, c = (tuple(shape) + (None,) * 3)[:3]
+        for op in self.ops or []:
+            kind = op.get("op")
+            if kind not in OPS:
+                raise SchemaError(
+                    "unknown-image-op",
+                    f"unknown image op {kind!r}; available: {sorted(OPS)}")
+            if kind == "resize":
+                h, w = int(op["height"]), int(op["width"])
+            elif kind == "crop":
+                x, y = int(op.get("x", 0)), int(op.get("y", 0))
+                ch, cw = int(op["height"]), int(op["width"])
+                if (h is not None and y + ch > h) or \
+                        (w is not None and x + cw > w):
+                    raise SchemaError(
+                        "crop-out-of-bounds",
+                        f"crop ({y}:{y + ch}, {x}:{x + cw}) falls outside "
+                        f"the incoming image geometry ({h}x{w})")
+                h, w = ch, cw
+            elif kind == "color_format":
+                fmt = op.get("format")
+                if fmt not in _COLOR_FORMAT_CODES:
+                    raise SchemaError(
+                        "unknown-color-format",
+                        f"unknown color format {fmt!r}; one of "
+                        f"{sorted(_COLOR_FORMAT_CODES)}")
+                c = 1 if fmt in ("gray", "grey") else c
+        out.columns[self.output_col] = ColumnInfo.image(
+            h, w, c, has_missing=info.has_missing)
+        return out
+
     # ---- DeviceStage protocol ----
 
     def device_fn(self, meta: ArrayMeta) -> DeviceOp | None:
@@ -334,6 +383,23 @@ class UnrollImage(Transformer, DeviceStage, HasInputCol, HasOutputCol):
                 vecs = [one(d) for d in datas]
         return table.with_column(self.output_col, vecs)
 
+    # ---- static schema inference ----
+
+    def infer_schema(self, schema: Any) -> Any:
+        from mmlspark_tpu.analysis.info import (
+            KIND_IMAGE, ColumnInfo, require_image_input,
+        )
+        out = schema.copy()
+        info = require_image_input(out, self.input_col, "UnrollImage")
+        size = None
+        if info.kind == KIND_IMAGE:
+            s = info.concrete_shape
+            if s is not None:
+                size = int(np.prod(s))
+        out.columns[self.output_col] = ColumnInfo.vector(
+            size, "float32", has_missing=info.has_missing)
+        return out
+
     # ---- DeviceStage protocol ----
 
     def device_fn(self, meta: ArrayMeta) -> DeviceOp | None:
@@ -388,3 +454,20 @@ class ImageSetAugmenter(Transformer, HasInputCol, HasOutputCol):
                                  output_col=self.output_col).flip(code)
             result = result.concat(t.transform(table))
         return result
+
+    def infer_schema(self, schema: Any) -> Any:
+        from mmlspark_tpu.analysis.info import require_image_input
+        out = schema.copy()
+        info = require_image_input(out, self.input_col, "ImageSetAugmenter")
+        aug = info.copy()
+        from mmlspark_tpu.core.schema import SchemaConstants
+        aug.meta[SchemaConstants.K_IMAGE] = True
+        out.columns[self.output_col] = aug
+        return out
+
+    def infer_rows(self, n: int | None, schema: Any) -> int | None:
+        if n is None:
+            return None
+        copies = 1 + int(bool(self.flip_left_right)) \
+            + int(bool(self.flip_up_down))
+        return n * copies

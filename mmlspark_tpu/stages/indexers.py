@@ -75,6 +75,16 @@ class ValueIndexer(Estimator, HasInputCol, HasOutputCol):
             input_col=self.input_col, output_col=self.output_col,
             levels=levels)
 
+    def infer_schema(self, schema: Any) -> Any:
+        schema = super().infer_schema(schema)
+        from mmlspark_tpu.analysis.info import ColumnInfo
+        # levels are a fit-time artifact; the output is provably int32
+        # categorical codes either way
+        info = ColumnInfo.scalar("int32")
+        info.meta[SchemaConstants.K_IS_CATEGORICAL] = True
+        schema.columns[self.output_col] = info
+        return schema
+
 
 class ValueIndexerModel(Transformer, HasInputCol, HasOutputCol):
     """Fitted :class:`ValueIndexer`: maps values to level codes and stamps
@@ -88,6 +98,16 @@ class ValueIndexerModel(Transformer, HasInputCol, HasOutputCol):
         codes = index_values(table[self.input_col], list(self.levels))
         out = table.with_column(self.output_col, codes)
         return set_categorical_levels(out, self.output_col, list(self.levels))
+
+    def infer_schema(self, schema: Any) -> Any:
+        schema = super().infer_schema(schema)
+        from mmlspark_tpu.analysis.info import ColumnInfo
+        info = ColumnInfo.scalar("int32")
+        info.meta[SchemaConstants.K_IS_CATEGORICAL] = True
+        info.meta[SchemaConstants.K_CATEGORICAL_LEVELS] = list(
+            self.levels or [])
+        schema.columns[self.output_col] = info
+        return schema
 
 
 class IndexToValue(Transformer, HasInputCol, HasOutputCol):
@@ -104,3 +124,27 @@ class IndexToValue(Transformer, HasInputCol, HasOutputCol):
         codes = np.asarray(table[self.input_col], dtype=np.int64)
         values = [levels[c] if 0 <= c < len(levels) else None for c in codes]
         return table.with_column(self.output_col, values)
+
+    def infer_schema(self, schema: Any) -> Any:
+        from mmlspark_tpu.analysis.info import ColumnInfo, SchemaError
+        out = schema.copy()
+        info = out.get(self.input_col)
+        if info is None:
+            if schema.exact:
+                raise SchemaError(
+                    "missing-input-column",
+                    f"IndexToValue reads missing column "
+                    f"{self.input_col!r}; available: {list(schema)}")
+            info = ColumnInfo.unknown()
+        levels = info.meta.get(SchemaConstants.K_CATEGORICAL_LEVELS)
+        if (levels is None and info.kind != "unknown"
+                and not info.meta.get(SchemaConstants.K_IS_CATEGORICAL)):
+            # flagged-categorical without levels is fine: an unfitted
+            # ValueIndexer upstream stamps the flag, the levels are a
+            # fit-time artifact
+            raise SchemaError(
+                "categorical-levels-missing",
+                f"column {self.input_col!r} carries no categorical levels "
+                "in its metadata; run ValueIndexer first")
+        out.columns[self.output_col] = ColumnInfo.unknown()
+        return out

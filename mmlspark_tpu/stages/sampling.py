@@ -62,3 +62,20 @@ class PartitionSample(Transformer):
         # AssignToPartition
         parts = self._rng().integers(0, self.num_parts, size=len(table))
         return table.with_column(self.new_col_name, parts.astype(np.int32))
+
+    def infer_schema(self, schema):
+        if self.mode == MODE_ATP:
+            from mmlspark_tpu.analysis.info import ColumnInfo
+            out = schema.copy()
+            out.columns[self.new_col_name] = ColumnInfo.scalar("int32")
+            return out
+        return schema.copy()
+
+    def infer_rows(self, n, schema):
+        if n is None or self.mode == MODE_ATP:
+            return n
+        if self.mode == MODE_HEAD:
+            return min(self.count, n)
+        if self.rs_mode == RS_ABSOLUTE:
+            return min(self.count, n)
+        return int(round(self.percent * n))

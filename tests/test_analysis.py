@@ -1,0 +1,427 @@
+"""Pre-flight analyzer suite.
+
+Three contracts:
+
+* **Static rejection** — deliberately broken pipelines yield stage-indexed
+  typed diagnostics with ZERO DataTable construction and ZERO device
+  crossings (the transformSchema-before-any-data-moves guarantee).
+* **Prediction parity** — for every parity pipeline in tests/test_plan.py
+  the predicted output schema (columns, dtypes, shapes) and predicted
+  H2D/D2H crossing counts match what actual execution produces.
+* **Audit semantics** — fusion breaks, recompile hazards, categorical
+  drift, purpose collisions, and Pipeline.fit's analyzer-backed stage-kind
+  error.
+"""
+
+import numpy as np
+import pytest
+
+import test_plan  # the parity-pipeline builders (image_table, mlp_bundle)
+
+from mmlspark_tpu.analysis import (
+    ColumnInfo, SchemaError, TableSchema, analyze,
+)
+from mmlspark_tpu.core import plan
+from mmlspark_tpu.core.pipeline import Pipeline, PipelineModel
+from mmlspark_tpu.core.schema import SchemaConstants, make_image
+from mmlspark_tpu.core.stage import LambdaTransformer
+from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.models.jax_model import JaxModel
+from mmlspark_tpu.stages.featurize import AssembleFeatures
+from mmlspark_tpu.stages.image import ImageTransformer, UnrollImage
+from mmlspark_tpu.stages.indexers import ValueIndexerModel
+
+
+def assert_schema_matches(pred: TableSchema, obs: TableSchema) -> None:
+    """Every concretely-predicted fact must hold in the observed schema;
+    unknown-marked columns must at least exist."""
+    assert list(pred.columns) == list(obs.columns)
+    for name, p in pred.columns.items():
+        o = obs.columns[name]
+        if p.kind == "unknown" or o.kind == "unknown":
+            continue
+        assert p.kind == o.kind, f"{name}: {p.kind} != {o.kind}"
+        if p.dtype is not None and o.dtype is not None:
+            assert p.dtype == o.dtype, f"{name}: {p.dtype} != {o.dtype}"
+        if p.shape is not None and o.shape is not None:
+            assert len(p.shape) == len(o.shape), name
+            for a, b in zip(p.shape, o.shape):
+                if a is not None and b is not None:
+                    assert a == b, f"{name}: {p.shape} != {o.shape}"
+
+
+# ---- prediction parity against every test_plan pipeline ----
+
+def _case_crop_flip_unroll():
+    return ([ImageTransformer().crop(2, 3, 16, 12).flip(-1),
+             UnrollImage(scale=1.0, offset=0.0)], test_plan.image_table())
+
+
+def _case_resize():
+    return ([ImageTransformer().resize(16, 12), UnrollImage()],
+            test_plan.image_table(h=29, w=23))
+
+
+def _case_affine_rgb():
+    return ([ImageTransformer().flip(1),
+             UnrollImage(scale=1 / 255.0, offset=-0.5, to_rgb=True)],
+            test_plan.image_table())
+
+
+def _case_three_stage_model():
+    table = test_plan.image_table(n=10, h=12, w=10)
+    afm = AssembleFeatures(columns_to_featurize=["image"],
+                           allow_images=True,
+                           features_col="features").fit(table)
+    jm = JaxModel(model=test_plan.mlp_bundle(2 + 12 * 10 * 3),
+                  input_col="features", output_col="scores",
+                  minibatch_size=4, mesh_spec={"dp": 1})
+    return [ImageTransformer().flip(0), afm, jm], table
+
+
+def _case_chained_models():
+    r = np.random.default_rng(3)
+    table = DataTable({"x": list(r.normal(size=(9, 6)).astype(np.float32))})
+    jm1 = JaxModel(model=test_plan.mlp_bundle(6, out_dim=5, seed=1),
+                   input_col="x", output_col="h", minibatch_size=4)
+    jm2 = JaxModel(model=test_plan.mlp_bundle(5, out_dim=3, seed=2),
+                   input_col="h", output_col="scores", minibatch_size=4)
+    return [jm1, jm2], table
+
+
+def _case_mixed_host_device():
+    table = test_plan.image_table(n=6)
+    tag = LambdaTransformer(fn=lambda t: t.with_column(
+        "tag", [1] * len(t)))
+    renorm = LambdaTransformer(fn=lambda t: t.with_column(
+        "features", [v * 2.0 for v in t["features"]]))
+    return [tag, ImageTransformer().flip(1), UnrollImage(), renorm], table
+
+
+def _case_single_device_stage():
+    return [ImageTransformer().flip(1)], test_plan.image_table(n=4)
+
+
+def _case_empty_table():
+    return ([ImageTransformer().flip(1), UnrollImage()],
+            DataTable({"image": []}))
+
+
+def _case_ragged_images():
+    r = np.random.default_rng(5)
+    rows = [make_image(f"p{k}", r.integers(0, 255, (10 + k, 8, 3)))
+            for k in range(5)]
+    return ([ImageTransformer().flip(1), UnrollImage()],
+            DataTable({"image": rows}))
+
+
+def _case_unsupported_op():
+    return ([ImageTransformer().blur(3, 3), UnrollImage()],
+            test_plan.image_table(n=4))
+
+
+def _case_lone_jax_model():
+    r = np.random.default_rng(9)
+    table = DataTable({"x": list(r.normal(size=(10, 6)).astype(np.float32))})
+    jm = JaxModel(model=test_plan.mlp_bundle(6, out_dim=3, seed=4),
+                  input_col="x", output_col="scores", minibatch_size=4)
+    return [jm], table
+
+
+PARITY_CASES = {
+    "crop_flip_unroll": _case_crop_flip_unroll,
+    "resize": _case_resize,
+    "affine_rgb": _case_affine_rgb,
+    "three_stage_model": _case_three_stage_model,
+    "chained_models": _case_chained_models,
+    "mixed_host_device": _case_mixed_host_device,
+    "single_device_stage": _case_single_device_stage,
+    "empty_table": _case_empty_table,
+    "ragged_images": _case_ragged_images,
+    "unsupported_op": _case_unsupported_op,
+    "lone_jax_model": _case_lone_jax_model,
+}
+
+
+@pytest.mark.parametrize("case", sorted(PARITY_CASES))
+def test_prediction_matches_execution(case):
+    stages, table = PARITY_CASES[case]()
+    report = analyze(stages, TableSchema.from_table(table),
+                     n_rows=len(table))
+    assert report.ok, [str(d) for d in report.errors]
+    with plan.count_crossings() as c:
+        out = PipelineModel(stages).transform(table)
+    assert report.plan.uploads == c.uploads, report.plan.format()
+    assert report.plan.fetches == c.fetches
+    assert_schema_matches(report.schema, TableSchema.from_table(out))
+
+
+def test_audit_structure_matches_describe_plan():
+    stages, table = _case_three_stage_model()
+    report = analyze(stages, TableSchema.from_table(table))
+    described = [(k, len(ss)) for k, ss in plan.describe_plan(stages, table)]
+    assert report.plan.structure() == described == [("device", 3)]
+
+
+# ---- static rejection: broken pipelines, zero data, zero transfers ----
+
+def _forbid_datatable(monkeypatch):
+    def boom(self, *a, **k):
+        raise AssertionError(
+            "static analysis must not construct a DataTable")
+    monkeypatch.setattr(DataTable, "__init__", boom)
+
+
+def test_broken_pipelines_flagged_without_data_or_transfers(monkeypatch):
+    schema = TableSchema.from_spec({
+        "image": {"kind": "image", "shape": [24, 18, 3]},
+        "vec": {"kind": "vector", "shape": [10], "dtype": "float32"},
+    })
+    jm = JaxModel(model=test_plan.mlp_bundle(6, out_dim=3),
+                  input_col="vec", output_col="scores", minibatch_size=4)
+    afm = AssembleFeatures(columns_to_featurize=["vec"],
+                           features_col="assembled").fit(
+        DataTable({"vec": list(np.zeros((3, 10), np.float32))}))
+    _forbid_datatable(monkeypatch)
+    with plan.count_crossings() as c:
+        # missing input column
+        r1 = analyze([UnrollImage(input_col="imagezz")], schema)
+        # image column fed to a vector-only stage (numeric/vector plan)
+        bad_plan = [{"col": "image", "kind": "vector", "size": 10}]
+        afm2 = afm.copy(plan=bad_plan)
+        r2 = analyze([afm2], schema)
+        # dtype/size mismatch into a fused device segment: the vector is
+        # 10-wide, the model wants 6
+        r3 = analyze([UnrollImage(input_col="image", output_col="vec"),
+                      jm], schema)
+    assert c.uploads == 0 and c.fetches == 0
+
+    d1 = r1.errors[0]
+    assert d1.code == "missing-input-column" and d1.stage_index == 0
+    assert "imagezz" in d1.message
+
+    d2 = r2.errors[0]
+    assert d2.code == "plan-schema-mismatch" and d2.stage_index == 0
+    assert "image" in d2.message
+
+    d3 = r3.errors[0]
+    assert d3.code == "input-size-mismatch" and d3.stage_index == 1
+    assert d3.stage == "JaxModel"
+    # the unroll output (24*18*3) does not match the model spec either way
+    assert "1296" in d3.message and "6" in d3.message
+
+
+def test_analysis_of_saved_pipeline_without_data(monkeypatch, tmp_path):
+    pm = PipelineModel([ImageTransformer().resize(16, 12), UnrollImage()])
+    path = str(tmp_path / "pm")
+    pm.save(path)
+    loaded = PipelineModel.load(path)
+    schema = TableSchema.from_spec(
+        {"image": {"kind": "image", "shape": [32, 32, 3]}})
+    _forbid_datatable(monkeypatch)
+    with plan.count_crossings() as c:
+        report = analyze(loaded, schema, n_rows=64)
+    assert c.uploads == 0
+    assert report.ok
+    assert report.schema.columns["features"].summary() == \
+        ("vector", "float32", (16 * 12 * 3,))
+    assert report.plan.structure() == [("device", 2)]
+    assert report.plan.uploads == 1  # 64 rows, one dp-rounded minibatch
+
+
+# ---- diagnostics ----
+
+def test_crop_out_of_bounds_and_unknown_op():
+    schema = TableSchema.from_spec(
+        {"image": {"kind": "image", "shape": [16, 16, 3]}})
+    r = analyze([ImageTransformer().crop(10, 10, 16, 16)], schema)
+    assert r.errors[0].code == "crop-out-of-bounds"
+    r = analyze([ImageTransformer(ops=[{"op": "sharpen"}])], schema)
+    assert r.errors[0].code == "unknown-image-op"
+
+
+def test_image_expected_and_model_not_set():
+    schema = TableSchema.from_spec(
+        {"vec": {"kind": "vector", "shape": [8]}})
+    r = analyze([UnrollImage(input_col="vec")], schema)
+    assert r.errors[0].code == "image-column-expected"
+    r = analyze([JaxModel(input_col="vec")], schema)
+    assert r.errors[0].code == "model-not-set"
+
+
+def test_recompile_hazard_on_polymorphic_entry():
+    schema = TableSchema.from_spec(
+        {"image": {"kind": "image", "shape": [None, None, 3]}})
+    r = analyze([ImageTransformer().resize(8, 8), UnrollImage()], schema,
+                n_rows=10)
+    assert any(d.code == "shape-polymorphic-entry" for d in r.warnings)
+    # the geometry still resolves once the resize pins it
+    assert r.schema.columns["features"].summary() == \
+        ("vector", "float32", (8 * 8 * 3,))
+
+
+def test_categorical_drift_and_shadowing():
+    info = ColumnInfo.scalar("int32")
+    info.meta[SchemaConstants.K_IS_CATEGORICAL] = True
+    info.meta[SchemaConstants.K_CATEGORICAL_LEVELS] = ["a", "b", "z"]
+    schema = TableSchema({"cat": info})
+    fitted = AssembleFeatures(columns_to_featurize=["cat"]).fit(
+        DataTable({"cat": np.array([0, 1, 2], np.int32)},
+                  {"cat": {SchemaConstants.K_IS_CATEGORICAL: True,
+                           SchemaConstants.K_CATEGORICAL_LEVELS:
+                               ["a", "b", "c"]}}))
+    r = analyze([fitted], schema)
+    assert any(d.code == "categorical-level-drift" for d in r.warnings)
+
+    # overwriting an image column with a vector is flagged at the write
+    schema2 = TableSchema.from_spec(
+        {"image": {"kind": "image", "shape": [8, 8, 3]}})
+    r2 = analyze([UnrollImage(input_col="image", output_col="image")],
+                 schema2)
+    assert any(d.code == "column-shadowed" for d in r2.diagnostics)
+
+
+def test_score_purpose_collision():
+    stamped = {SchemaConstants.K_COLUMN_PURPOSE:
+               SchemaConstants.SCORES_COLUMN,
+               SchemaConstants.K_MODEL_UID: "m1"}
+    schema = TableSchema({
+        "s1": ColumnInfo.vector(3, "float64", meta=dict(stamped)),
+        "s2": ColumnInfo.vector(3, "float64", meta=dict(stamped)),
+    })
+    r = analyze([], schema)
+    assert any(d.code == "score-purpose-collision" for d in r.warnings)
+
+
+def test_unfitted_indexer_chain_analyzes_clean():
+    # ValueIndexer → IndexToValue and ValueIndexer → AssembleFeatures are
+    # valid pipelines whose levels/widths are fit-time artifacts: analysis
+    # must stay clean and report the width as unknown, never a wrong number
+    from mmlspark_tpu.stages.indexers import IndexToValue, ValueIndexer
+    schema = TableSchema.from_spec({
+        "cat": "text", "x": {"kind": "scalar", "dtype": "float64"}})
+    r = analyze(Pipeline([
+        ValueIndexer(input_col="cat", output_col="idx"),
+        IndexToValue(input_col="idx", output_col="back")]), schema)
+    assert r.ok, [str(d) for d in r.errors]
+    r2 = analyze(Pipeline([
+        ValueIndexer(input_col="cat", output_col="cat_idx"),
+        AssembleFeatures(columns_to_featurize=["cat_idx", "x"])]), schema)
+    assert r2.ok
+    feats = r2.schema.columns["features"]
+    assert feats.row_size is None  # one-hot width unknown until fit
+    assert SchemaConstants.K_VECTOR_SIZE not in feats.meta
+
+
+def test_unknown_color_format_rejected_preflight():
+    schema = TableSchema.from_spec(
+        {"image": {"kind": "image", "shape": [8, 8, 3]}})
+    r = analyze([ImageTransformer().color_format("foo")], schema)
+    assert r.errors[0].code == "unknown-color-format"
+    r2 = analyze([ImageTransformer().color_format("gray")], schema)
+    assert r2.ok
+    assert r2.schema.columns["image"].shape == (8, 8, 1)
+
+
+def test_value_indexer_levels_flow_into_assembly():
+    vim = ValueIndexerModel(input_col="color", output_col="color_idx",
+                            levels=["blue", "green", "red"])
+    schema = TableSchema.from_spec({"color": "text"})
+    r = analyze([vim], schema)
+    info = r.schema.columns["color_idx"]
+    assert info.summary() == ("scalar", "int32", ())
+    assert info.meta[SchemaConstants.K_CATEGORICAL_LEVELS] == \
+        ["blue", "green", "red"]
+
+
+def test_estimator_pipeline_with_train_classifier():
+    from mmlspark_tpu.ml import TrainClassifier
+    schema = TableSchema.from_spec({
+        "age": {"kind": "scalar", "dtype": "float64"},
+        "income": "text",
+    })
+    p = Pipeline([TrainClassifier(label_col="income")])
+    r = analyze(p, schema)
+    assert r.ok
+    assert SchemaConstants.SCORED_LABELS_COLUMN in r.schema.columns
+    # label column missing → stage-indexed error
+    r2 = analyze(Pipeline([TrainClassifier(label_col="nope")]), schema)
+    assert r2.errors[0].code == "missing-input-column"
+
+
+def test_lambda_probe_tracks_columns():
+    schema = TableSchema.from_spec(
+        {"x": {"kind": "vector", "shape": [4]}})
+    add = LambdaTransformer(fn=lambda t: t.with_column("y", [0] * len(t)))
+    r = analyze([add, UnrollImage(input_col="nope")], schema)
+    assert "y" in r.schema.columns
+    # schema stayed exact, so the bad column is still an error
+    assert r.errors[0].code == "missing-input-column"
+
+    crashy = LambdaTransformer(fn=lambda t: t.take([0]))  # dies on 0 rows?
+    r2 = analyze([crashy], schema)
+    assert r2.ok  # worst case: schema degrades, never a crash
+
+
+def test_trained_model_rows_unknown_when_na_drop_possible():
+    # the featurization's na.drop analog makes the scored row count
+    # unknowable when a feature column can hold missing values — the
+    # model must not claim an exact count (and with it, exact crossings)
+    from mmlspark_tpu.ml import TrainClassifier
+    t = DataTable({"x": np.array([1.0, np.nan, 3.0, 4.0]),
+                   "label": ["a", "b", "a", "b"]})
+    model = TrainClassifier(label_col="label").fit(t)
+    schema = TableSchema.from_table(t)
+    assert model.infer_rows(4, schema) is None
+    assert len(model.transform(t)) == 3  # na.drop actually fires
+    clean = DataTable({"x": np.arange(4.0), "label": ["a", "b", "a", "b"]})
+    assert model.infer_rows(4, TableSchema.from_table(clean)) == 4
+
+
+def test_nested_lambda_probe_runs_once_per_analysis():
+    calls = []
+
+    def fn(t):
+        calls.append(len(t))
+        return t.with_column("y", [0] * len(t))
+
+    nested = PipelineModel([LambdaTransformer(fn=fn)])
+    schema = TableSchema.from_spec({"x": {"kind": "vector", "shape": [4]}})
+    analyze([nested], schema, n_rows=10)
+    assert len(calls) == 1, calls  # the 0-row probe, exactly once
+
+
+def test_nested_fold_preserves_warnings_through_lambda():
+    # a warning attached inside a nested Pipeline must survive a following
+    # opaque stage's schema rebuild and surface at the outer walk
+    info = ColumnInfo.scalar("int32")
+    info.meta[SchemaConstants.K_IS_CATEGORICAL] = True
+    info.meta[SchemaConstants.K_CATEGORICAL_LEVELS] = ["a", "b", "z"]
+    schema = TableSchema({"cat": info})
+    fitted = AssembleFeatures(columns_to_featurize=["cat"]).fit(
+        DataTable({"cat": np.array([0, 1, 2], np.int32)},
+                  {"cat": {SchemaConstants.K_IS_CATEGORICAL: True,
+                           SchemaConstants.K_CATEGORICAL_LEVELS:
+                               ["a", "b", "c"]}}))
+    ident = LambdaTransformer(fn=lambda t: t.with_column(
+        "extra", [0] * len(t)))
+    nested = PipelineModel([fitted, ident])
+    r = analyze([nested], schema)
+    assert any(d.code == "categorical-level-drift" for d in r.warnings)
+
+
+# ---- Pipeline.fit stage-kind diagnostic (via the analyzer) ----
+
+def test_pipeline_fit_rejects_non_stage_with_indexed_message():
+    table = DataTable({"x": np.arange(4.0)})
+    bad = Pipeline([ImageTransformer(), {"not": "a stage"}, 42])
+    with pytest.raises(TypeError) as exc:
+        bad.fit(table)
+    msg = str(exc.value)
+    assert "stage 1 (dict)" in msg and "stage 2 (int)" in msg
+    assert "neither Transformer nor Estimator" in msg
+
+
+def test_schema_error_formatting():
+    err = SchemaError("some-code", "the message")
+    assert err.code == "some-code" and str(err) == "the message"

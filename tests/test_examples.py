@@ -5,16 +5,41 @@ The reference executes every sample notebook in its test suite
 these are the analogs for the 101/102/201/301/302/303/304 family — dead
 examples cannot rot silently."""
 
+import importlib
 import os
 import sys
 
 import pytest
 
-pytestmark = pytest.mark.slow  # executes every example end-to-end
+slow = pytest.mark.slow  # runtime tests execute every example end-to-end
 
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(REPO, "examples"))
+
+
+# examples exposing a build_pipeline() → (pipeline, TableSchema) hook; the
+# analyzer validates each statically in tier-1 so example drift (renamed
+# columns, mis-wired stages, broken geometry) is caught without running
+# anything end-to-end
+ANALYZABLE_EXAMPLES = [
+    "tabular_classification_101",
+    "flight_delay_regression_102",
+    "book_reviews_text_201",
+    "cifar_eval_301",
+    "image_transforms_302",
+    "flowers_featurizer_305",
+]
+
+
+@pytest.mark.parametrize("module_name", ANALYZABLE_EXAMPLES)
+def test_example_pipelines_analyze_clean(module_name):
+    from mmlspark_tpu.analysis import analyze
+    mod = importlib.import_module(module_name)
+    pipeline, schema = mod.build_pipeline()
+    report = analyze(pipeline, schema, n_rows=64)
+    assert report.ok, (module_name,
+                       [str(d) for d in report.errors])
 
 
 @pytest.fixture(scope="module")
@@ -24,6 +49,7 @@ def zoo_repo(tmp_path_factory):
     return ensure_repo(str(tmp_path_factory.mktemp("examples_zoo")))
 
 
+@slow
 def test_example_101_tabular_classification():
     import tabular_classification_101 as ex
     out = ex.run("small")
@@ -31,6 +57,7 @@ def test_example_101_tabular_classification():
     assert out["auc"] is None or out["auc"] > 0.85
 
 
+@slow
 def test_example_102_flight_delay_regression():
     import flight_delay_regression_102 as ex
     out = ex.run("small")
@@ -38,12 +65,14 @@ def test_example_102_flight_delay_regression():
     assert out["root_mean_squared_error"] < 12.0
 
 
+@slow
 def test_example_201_text_featurizer():
     import book_reviews_text_201 as ex
     out = ex.run("small")
     assert out["accuracy"] > 0.85, out
 
 
+@slow
 def test_example_301_cifar_eval(zoo_repo):
     import cifar_eval_301 as ex
     out = ex.run("small", repo_dir=zoo_repo)
@@ -56,6 +85,7 @@ def test_example_301_cifar_eval(zoo_repo):
     assert abs(out["accuracy"] - out["manifest_accuracy"]) < 0.02, out
 
 
+@slow
 def test_example_302_image_transforms():
     import image_transforms_302 as ex
     out = ex.run("small")
@@ -64,12 +94,14 @@ def test_example_302_image_transforms():
     assert 0.0 < out["feature_mean"] < 1.0
 
 
+@slow
 def test_example_303_transfer_learning(zoo_repo):
     import transfer_learning_303 as ex
     out = ex.run("small", repo_dir=zoo_repo)
     assert out["accuracy"] > 0.85, out
 
 
+@slow
 def test_example_304_medical_entity(zoo_repo):
     import medical_entity_304 as ex
     out = ex.run("small", repo_dir=zoo_repo)
@@ -77,6 +109,7 @@ def test_example_304_medical_entity(zoo_repo):
     assert out["bucket_shapes"] == [16, 32, 64]
 
 
+@slow
 def test_example_103_before_after():
     import before_after_103 as ex
     out = ex.run("small")
@@ -86,6 +119,7 @@ def test_example_103_before_after():
     assert abs(out["before_accuracy"] - out["after_accuracy"]) < 0.12, out
 
 
+@slow
 def test_example_202_word2vec():
     import book_reviews_word2vec_202 as ex
     out = ex.run("small")
@@ -96,6 +130,7 @@ def test_example_202_word2vec():
     assert len(set(out["synonym_probe"]) & set(POSITIVE)) >= 2, out
 
 
+@slow
 def test_example_305_flowers_featurizer(zoo_repo):
     import flowers_featurizer_305 as ex
     out = ex.run("small", repo_dir=zoo_repo)
@@ -107,6 +142,7 @@ def test_example_305_flowers_featurizer(zoo_repo):
     assert out["deep_accuracy"] > 2 * out["raw_pixel_accuracy"], out
 
 
+@slow
 def test_example_306_distributed_finetune():
     import distributed_finetune_306 as ex
     ex.main()  # asserts dp vs dp×pp and dp vs dp×ep loss parity inside

@@ -1,0 +1,119 @@
+"""Tier-1 wiring of tools/lint_jax.py.
+
+Two gates: the codebase itself must be clean (zero findings after the
+curated allowlist — DEFAULT_ALLOWLIST documents every intentional
+exception), and a fixture seeded with each anti-pattern must yield
+exactly the expected findings (the lint finds what it claims to find).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from lint_jax import (  # noqa: E402
+    DEFAULT_ALLOWLIST, lint_paths, lint_source,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_codebase_is_clean():
+    findings = lint_paths([os.path.join(REPO, "mmlspark_tpu")])
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_allowlist_is_curated_not_dead():
+    # every allowlist entry must still suppress something real — a stale
+    # entry silently widens the gate
+    for suffix, rules in DEFAULT_ALLOWLIST.items():
+        path = os.path.join(REPO, suffix)
+        assert os.path.exists(path), f"allowlisted file {suffix} is gone"
+        raw = lint_paths([path], allowlist={})
+        hit_rules = {f.rule for f in raw}
+        for rule in rules:
+            assert rule in hit_rules, (
+                f"allowlist entry ({suffix}, {rule}) suppresses nothing")
+
+
+FIXTURE = '''
+import jax
+import numpy as np
+from functools import partial
+from jax.experimental.shard_map import shard_map          # JX103
+from mmlspark_tpu.core.params import Param
+
+
+class BadStage:
+    tags = Param(default=[], doc="mutable default")       # JX104
+
+
+@jax.jit
+def step(params, x):
+    y = np.asarray(x) + 1                                 # JX101
+    s = float(x.sum())                                    # JX101
+    return y, s, x.item()                                 # JX101
+
+
+@partial(jax.jit, static_argnums=(1,))
+def step2(x, k):
+    return x.tolist()                                     # JX101
+
+
+def fit(batches):
+    for b in batches:
+        f = jax.jit(lambda p, v: v + b)                   # JX102
+    g = jax.shard_map(step, None, None, None)             # JX103
+    h = getattr(jax, "shard_map")                         # JX103
+    return f, g, h
+
+
+def traced_by_name(params, x):
+    return int(x[0])                                      # JX101
+
+
+jitted = jax.jit(traced_by_name)
+
+
+def host_side_is_fine(x):
+    # not jitted: host syncs here are intentional and unflagged
+    return float(np.asarray(x).sum())
+
+
+@jax.jit
+def allowed(params, x):
+    return x.item()  # lint-jax: allow(JX101)
+'''
+
+
+def test_fixture_yields_exactly_the_seeded_findings():
+    findings = lint_source(FIXTURE, "fixture.py")
+    got = sorted((f.rule, f.line) for f in findings)
+    lines = FIXTURE.splitlines()
+    want = sorted(
+        (rule, i + 1)
+        for i, text in enumerate(lines)
+        for rule in ("JX101", "JX102", "JX103", "JX104")
+        if f"# {rule}" in text)
+    assert got == want, (got, want)
+
+
+def test_shim_surface_is_not_flagged():
+    # calling THROUGH the compat shim is what JX103 tells you to do; the
+    # rule must only fire on jax-rooted spellings
+    src = ("from mmlspark_tpu.parallel import mesh as mesh_lib\n"
+           "def f(body, m, i, o):\n"
+           "    return mesh_lib.shard_map(body, m, i, o)\n")
+    assert lint_source(src, "x.py") == []
+    src2 = "import jax\ng = jax.shard_map(None, None, None, None)\n"
+    assert [f.rule for f in lint_source(src2, "x.py")] == ["JX103"]
+
+
+def test_pragma_suppresses():
+    src = ("import jax\n"
+           "@jax.jit\n"
+           "def f(x):\n"
+           "    return x.item()  # lint-jax: allow(JX101)\n")
+    assert lint_source(src, "x.py") == []
+    src_no = src.replace("  # lint-jax: allow(JX101)", "")
+    assert [f.rule for f in lint_source(src_no, "x.py")] == ["JX101"]

@@ -1,0 +1,123 @@
+"""Direct coverage of core/schema.py helpers — the metadata protocol's
+single point of truth (score-column roles, categorical levels, image
+detection, unused-name generation)."""
+
+import numpy as np
+
+from mmlspark_tpu.core.schema import (
+    SchemaConstants, find_score_column, find_unused_column_name,
+    get_categorical_levels, get_score_value_kind, is_categorical,
+    is_image_column, make_image, mark_image_column, set_categorical_levels,
+    set_score_column,
+)
+from mmlspark_tpu.data.table import DataTable
+
+
+def scored_table():
+    t = DataTable({"a": np.arange(3.0), "b": np.arange(3.0),
+                   "c": np.arange(3.0)})
+    t = set_score_column(t, "model_1", "a", SchemaConstants.SCORES_COLUMN,
+                        SchemaConstants.CLASSIFICATION_KIND)
+    t = set_score_column(t, "model_2", "b", SchemaConstants.SCORES_COLUMN,
+                        SchemaConstants.REGRESSION_KIND)
+    return t
+
+
+# ---- find_score_column with model_uid filtering ----
+
+def test_find_score_column_first_match_without_uid():
+    t = scored_table()
+    assert find_score_column(t, SchemaConstants.SCORES_COLUMN) == "a"
+
+
+def test_find_score_column_filters_by_model_uid():
+    t = scored_table()
+    assert find_score_column(t, SchemaConstants.SCORES_COLUMN,
+                             model_uid="model_2") == "b"
+    assert find_score_column(t, SchemaConstants.SCORES_COLUMN,
+                             model_uid="model_3") is None
+
+
+def test_find_score_column_purpose_mismatch_returns_none():
+    t = scored_table()
+    assert find_score_column(t, SchemaConstants.SCORED_LABELS_COLUMN) is None
+
+
+def test_score_value_kind_round_trip():
+    t = scored_table()
+    assert get_score_value_kind(t, "a") == \
+        SchemaConstants.CLASSIFICATION_KIND
+    assert get_score_value_kind(t, "b") == SchemaConstants.REGRESSION_KIND
+    assert get_score_value_kind(t, "c") is None
+
+
+# ---- categorical levels ----
+
+def test_set_get_categorical_levels_round_trip():
+    t = DataTable({"cat": np.array([0, 1, 2], np.int32)})
+    t = set_categorical_levels(t, "cat", ["lo", "mid", "hi"])
+    assert is_categorical(t, "cat")
+    assert get_categorical_levels(t, "cat") == ["lo", "mid", "hi"]
+
+
+def test_get_categorical_levels_requires_flag():
+    # a levels list without the is_categorical flag is not categorical
+    t = DataTable({"cat": np.array([0, 1], np.int32)})
+    t = t.with_meta(
+        "cat", **{SchemaConstants.K_CATEGORICAL_LEVELS: ["x", "y"]})
+    assert get_categorical_levels(t, "cat") is None
+    assert not is_categorical(t, "cat")
+
+
+def test_categorical_levels_survive_with_column_rebuild():
+    t = DataTable({"cat": np.array([0, 1], np.int32)})
+    t = set_categorical_levels(t, "cat", [10, 20])
+    t = t.with_column("other", np.arange(2.0))
+    assert get_categorical_levels(t, "cat") == [10, 20]
+
+
+# ---- find_unused_column_name collision chains ----
+
+def test_find_unused_column_name_no_collision():
+    t = DataTable({"x": np.arange(2.0)})
+    assert find_unused_column_name(t, "features") == "features"
+
+
+def test_find_unused_column_name_walks_collision_chain():
+    t = DataTable({"features": np.arange(2.0),
+                   "features_1": np.arange(2.0),
+                   "features_2": np.arange(2.0)})
+    assert find_unused_column_name(t, "features") == "features_3"
+
+
+# ---- is_image_column (incl. the leading-None regression) ----
+
+def test_is_image_column_detects_structs_and_meta():
+    img = make_image("p", np.zeros((4, 4, 3), np.uint8))
+    t = DataTable({"image": [img, img]})
+    assert is_image_column(t, "image")
+    t2 = DataTable({"blob": [{"weird": 1}, {"weird": 2}]})
+    assert not is_image_column(t2, "blob")
+    t2 = mark_image_column(t2, "blob")  # explicit meta wins
+    assert is_image_column(t2, "blob")
+
+
+def test_is_image_column_skips_leading_none():
+    # regression: a leading None (failed decode / missing row) must not
+    # hide an image column from first-cell sniffing
+    img = make_image("p", np.zeros((4, 4, 3), np.uint8))
+    t = DataTable({"image": [None, None, img]})
+    assert is_image_column(t, "image")
+
+
+def test_is_image_column_skips_leading_nan():
+    # NaN is the other missing spelling (shared is_missing predicate)
+    img = make_image("p", np.zeros((4, 4, 3), np.uint8))
+    t = DataTable({"image": [float("nan"), img]})
+    assert is_image_column(t, "image")
+
+
+def test_is_image_column_all_none_and_non_object():
+    assert not is_image_column(DataTable({"c": [None, None]}), "c")
+    assert not is_image_column(DataTable({"c": np.arange(3.0)}), "c")
+    assert not is_image_column(DataTable({"c": [None, "str"]}), "c")

@@ -1,0 +1,89 @@
+"""analyze — pre-flight pipeline & codebase analysis CLI.
+
+Two subcommands::
+
+    python tools/analyze.py pipeline <saved-stage-dir> --schema schema.json
+        [--rows N] [--strict]
+    python tools/analyze.py code [path ...]
+
+``pipeline`` loads a persisted stage (a Pipeline/PipelineModel saved with
+``.save()``, or any single stage), abstractly interprets it over the
+column schema declared in the JSON file, and prints typed diagnostics,
+the predicted output schema, and the device-plan audit (fusion segments,
+predicted H2D/D2H crossings, recompile hazards) — **without building a
+table or touching a device**. Exit code 1 when error-level diagnostics
+exist (``--strict`` also fails on warnings).
+
+The schema JSON maps column name → spec (see
+``TableSchema.from_spec``)::
+
+    {"image": {"kind": "image", "shape": [32, 32, 3]},
+     "age":   {"kind": "scalar", "dtype": "float64"},
+     "text":  "text"}
+
+``code`` runs the JAX anti-pattern lint (tools/lint_jax.py) and shares
+its exit semantics.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def cmd_pipeline(args: argparse.Namespace) -> int:
+    # keep analysis off accelerators: eval_shape needs no device, and a
+    # pre-flight check must not grab a TPU just to reject a pipeline
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from mmlspark_tpu.analysis import TableSchema, analyze
+    from mmlspark_tpu.core.stage import PipelineStage
+
+    with open(args.schema, "r", encoding="utf-8") as fh:
+        spec = json.load(fh)
+    schema = TableSchema.from_spec(spec)
+    stage = PipelineStage.load(args.model)
+    report = analyze(stage, schema, n_rows=args.rows)
+    print(report.format())
+    if report.errors or (args.strict and report.warnings):
+        return 1
+    return 0
+
+
+def cmd_code(args: argparse.Namespace) -> int:
+    import lint_jax
+    return lint_jax.main(args.paths)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="analyze", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("pipeline",
+                       help="statically validate a saved pipeline")
+    p.add_argument("model", help="directory of a stage saved with .save()")
+    p.add_argument("--schema", required=True,
+                   help="JSON file declaring the input column schema")
+    p.add_argument("--rows", type=int, default=None,
+                   help="row count for concrete crossing prediction")
+    p.add_argument("--strict", action="store_true",
+                   help="exit non-zero on warnings too")
+    p.set_defaults(func=cmd_pipeline)
+
+    c = sub.add_parser("code", help="run the JAX anti-pattern lint")
+    c.add_argument("paths", nargs="*", help="files/dirs (default: "
+                   "mmlspark_tpu/)")
+    c.set_defaults(func=cmd_code)
+
+    args = parser.parse_args(argv)
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

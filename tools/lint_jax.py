@@ -1,0 +1,282 @@
+"""lint_jax — AST lint for JAX anti-patterns in mmlspark_tpu.
+
+A pyflakes-style single-pass visitor (no imports of the linted code, no
+jax initialization) catching the mistakes that cost the most on TPU:
+
+* **JX101 host sync in jit** — ``np.asarray``/``np.array``, ``float()``/
+  ``int()``/``bool()`` on non-constants, ``.item()``/``.tolist()`` inside
+  a jit-compiled function. Each forces a device→host transfer + blocking
+  sync in the middle of a traced computation (or a tracer error).
+* **JX102 jit in loop** — ``jax.jit(...)`` constructed inside a for/while
+  body: every iteration builds a fresh callable with an empty compile
+  cache (the classic accidental-recompile).
+* **JX103 raw shard_map** — importing/calling ``jax.shard_map`` or
+  ``jax.experimental.shard_map`` directly instead of the
+  ``mmlspark_tpu/parallel/mesh.py`` compat shim (the shim papers over the
+  check_rep/check_vma rename across jax versions; direct use breaks one
+  side or the other).
+* **JX104 mutable Param default** — ``Param(default=[])`` / ``{}`` /
+  ``set()``: the default is shared across every stage instance.
+
+Intentional exceptions are suppressed two ways, both documented in
+docs/static_analysis.md:
+
+* an inline pragma on the offending line: ``# lint-jax: allow(JX101)``;
+* the curated :data:`DEFAULT_ALLOWLIST` below (file-suffix → rules), for
+  files whose whole purpose is the exception (the shard_map shim itself).
+
+Usage::
+
+    python tools/lint_jax.py [path ...]     # default: mmlspark_tpu/
+
+Prints one line per finding and exits non-zero if any survive the
+allowlist. ``tests/test_lint.py`` runs this over the codebase in tier-1
+(zero-findings gate) and over a seeded fixture (exact-findings gate).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import sys
+
+# files whose entire purpose is the exception; suffix-matched against the
+# normalized path. Keep reasons here so the gate stays reviewable.
+DEFAULT_ALLOWLIST: dict[str, frozenset] = {
+    # the compat shim itself: it must touch both jax.shard_map spellings
+    "mmlspark_tpu/parallel/mesh.py": frozenset({"JX103"}),
+}
+
+RULES = {
+    "JX101": "host sync inside a jit-compiled function",
+    "JX102": "jax.jit constructed inside a loop body",
+    "JX103": "shard_map used directly; route through parallel/mesh.py's "
+             "compat shim",
+    "JX104": "mutable default value in a Param declaration",
+}
+
+_JIT_NAMES = {"jit", "pjit"}
+_NUMPY_ALIASES = {"np", "numpy", "onp"}
+_HOST_NP_CALLS = {"asarray", "array", "copy"}
+_HOST_BUILTINS = {"float", "int", "bool"}
+_HOST_METHODS = {"item", "tolist"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+def _is_jit_func(node: ast.AST) -> bool:
+    """Is this expression a reference to jax.jit / jit / pjit?"""
+    if isinstance(node, ast.Name):
+        return node.id in _JIT_NAMES
+    if isinstance(node, ast.Attribute):
+        return node.attr in _JIT_NAMES
+    return False
+
+
+def _has_jit_decorator(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        if _is_jit_func(dec):
+            return True
+        if isinstance(dec, ast.Call):
+            # @partial(jax.jit, ...) / @functools.partial(jit, ...)
+            fname = dec.func
+            is_partial = (isinstance(fname, ast.Name)
+                          and fname.id == "partial") or (
+                isinstance(fname, ast.Attribute) and fname.attr == "partial")
+            if is_partial and dec.args and _is_jit_func(dec.args[0]):
+                return True
+            if _is_jit_func(fname):  # @jax.jit(static_argnums=...)
+                return True
+    return False
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.lines = source.splitlines()
+        self.findings: list[Finding] = []
+        self.loop_depth = 0
+        self.jitted_names: set[str] = set()
+        self.jitted_lambdas: list[ast.Lambda] = []
+
+    # -- pass 1 collects jit targets; pass 2 walks their bodies --
+
+    def collect(self, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and _is_jit_func(node.func):
+                if node.args:
+                    target = node.args[0]
+                    if isinstance(target, ast.Name):
+                        self.jitted_names.add(target.id)
+                    elif isinstance(target, ast.Lambda):
+                        self.jitted_lambdas.append(target)
+
+    def _emit(self, node: ast.AST, rule: str, message: str) -> None:
+        line = getattr(node, "lineno", 0)
+        text = self.lines[line - 1] if 0 < line <= len(self.lines) else ""
+        if f"lint-jax: allow({rule})" in text:
+            return
+        self.findings.append(Finding(self.path, line, rule, message))
+
+    # -- JX102 / JX103 / JX104: module-wide --
+
+    def visit_For(self, node: ast.For) -> None:
+        self._loop_body(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._loop_body(node)
+
+    def _loop_body(self, node: ast.AST) -> None:
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if _is_jit_func(node.func) and self.loop_depth > 0:
+            self._emit(node, "JX102",
+                       "jax.jit called inside a loop builds a fresh "
+                       "callable (and compile cache) every iteration; "
+                       "hoist it out of the loop")
+        func = node.func
+        # jax.shard_map(...) / jax.experimental.shard_map.shard_map(...) —
+        # but NOT the shim's own surface (mesh.shard_map / mesh_lib.
+        # shard_map), which is exactly what the rule tells you to call
+        if isinstance(func, ast.Attribute) and func.attr == "shard_map":
+            root = func.value
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name) and root.id == "jax":
+                self._emit(node, "JX103", RULES["JX103"])
+        # getattr(jax, "shard_map")
+        if (isinstance(func, ast.Name) and func.id == "getattr"
+                and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Constant)
+                and node.args[1].value == "shard_map"):
+            self._emit(node, "JX103", RULES["JX103"])
+        # Param(default=<mutable>)
+        if (isinstance(func, ast.Name) and func.id == "Param") or (
+                isinstance(func, ast.Attribute) and func.attr == "Param"):
+            for kw in node.keywords:
+                if kw.arg == "default" and isinstance(
+                        kw.value, (ast.List, ast.Dict, ast.Set)):
+                    self._emit(node, "JX104",
+                               "Param(default=<mutable literal>) is shared "
+                               "across every stage instance; use None or a "
+                               "tuple")
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module and node.module.startswith("jax.experimental.shard_map"):
+            self._emit(node, "JX103", RULES["JX103"])
+        self.generic_visit(node)
+
+    # -- JX101: walk jitted function bodies --
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._maybe_lint_jit_body(node)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._maybe_lint_jit_body(node)
+        self.generic_visit(node)
+
+    def _maybe_lint_jit_body(self, node: ast.AST) -> None:
+        name = getattr(node, "name", None)
+        if _has_jit_decorator(node) or (name and name in self.jitted_names):
+            self._lint_traced_body(node)
+
+    def lint_lambdas(self) -> None:
+        for lam in self.jitted_lambdas:
+            self._lint_traced_body(lam)
+
+    def _lint_traced_body(self, fn: ast.AST) -> None:
+        """Flag host syncs anywhere inside a traced function (nested defs
+        included — they trace too)."""
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                if isinstance(func, ast.Attribute):
+                    if func.attr in _HOST_METHODS:
+                        self._emit(node, "JX101",
+                                   f".{func.attr}() blocks on a device→"
+                                   "host sync inside a traced function")
+                    elif (func.attr in _HOST_NP_CALLS
+                          and isinstance(func.value, ast.Name)
+                          and func.value.id in _NUMPY_ALIASES):
+                        self._emit(node, "JX101",
+                                   f"np.{func.attr} materializes a traced "
+                                   "value on host; use jnp inside jitted "
+                                   "code")
+                elif isinstance(func, ast.Name) \
+                        and func.id in _HOST_BUILTINS:
+                    if node.args and not isinstance(node.args[0],
+                                                    ast.Constant):
+                        self._emit(node, "JX101",
+                                   f"{func.id}() on a traced value forces "
+                                   "a host sync (or a tracer error); keep "
+                                   "the computation in jax")
+
+
+def lint_source(source: str, path: str = "<string>") -> list[Finding]:
+    tree = ast.parse(source, filename=path)
+    linter = _Linter(path, source)
+    linter.collect(tree)
+    linter.visit(tree)
+    linter.lint_lambdas()
+    return linter.findings
+
+
+def _allowed(path: str, rule: str, allowlist: dict) -> bool:
+    norm = path.replace(os.sep, "/")
+    for suffix, rules in allowlist.items():
+        if norm.endswith(suffix) and rule in rules:
+            return True
+    return False
+
+
+def lint_paths(paths: list[str],
+               allowlist: dict | None = None) -> list[Finding]:
+    allowlist = DEFAULT_ALLOWLIST if allowlist is None else allowlist
+    findings: list[Finding] = []
+    for root in paths:
+        files = []
+        if os.path.isdir(root):
+            for dirpath, _dirs, names in os.walk(root):
+                files.extend(os.path.join(dirpath, n) for n in names
+                             if n.endswith(".py"))
+        else:
+            files.append(root)
+        for f in sorted(files):
+            with open(f, "r", encoding="utf-8") as fh:
+                src = fh.read()
+            findings.extend(x for x in lint_source(src, f)
+                            if not _allowed(f, x.rule, allowlist))
+    return findings
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = argv or [os.path.join(repo, "mmlspark_tpu")]
+    findings = lint_paths(paths)
+    for f in findings:
+        print(f)
+    print(f"lint_jax: {len(findings)} finding(s) over {paths}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
