@@ -455,6 +455,44 @@ def main() -> None:
     except Exception as e:  # best-effort metric; label failures accurately
         pipe_rows_s = f"error: {e}"
 
+    # train input pipeline (round 7): prefetch on/off A/B on the canonical
+    # CIFAR train config. With prefetch the batch gather + H2D commit run
+    # on a background thread up to prefetch_depth steps ahead
+    # (train/input.DeviceLoader), so steady-state step wall-clock is
+    # max(H2D, compute) instead of the sum; the uint8 batches ship thin
+    # and cast/normalize inside the jitted step. Numerics are bit-identical
+    # across the A/B (asserted in tests/test_train_input.py); the wait
+    # fractions make the split self-attributing under link drift
+    train_ab: dict | None = None
+    try:
+        n_tr, bs_tr = 2048, 256
+        x_tr = rng.integers(0, 255, size=(n_tr, 32, 32, 3)).astype(np.uint8)
+        y_tr = rng.integers(0, 10, size=n_tr).astype(np.int64)
+        train_ab = {}
+        for label, depth in (("prefetch", 2), ("sync", 0)):
+            cfg_tr = TrainConfig(batch_size=bs_tr, epochs=1,
+                                 optimizer="momentum", learning_rate=0.01,
+                                 log_every=10**9, prefetch_depth=depth,
+                                 seed=0)
+            tr = Trainer(ConvNetCifar(), cfg_tr)
+            # warm pass compiles step_masked at the timed batch shape
+            tr.fit_arrays(x_tr[:2 * bs_tr], y_tr[:2 * bs_tr])
+            t0 = time.perf_counter()
+            tr.fit_arrays(x_tr, y_tr)
+            dt = time.perf_counter() - t0
+            s = tr.input_stats or {}
+            train_ab[label] = {
+                "images_per_s_per_chip": round(n_tr / dt / n_dev, 1),
+                "input_bound_fraction": s.get("input_bound_fraction"),
+                "input_wait_s": s.get("input_wait_s"),
+                "step_s": s.get("step_s"),
+                "assemble_s": s.get("assemble_s"),
+                "commit_s": s.get("commit_s"),
+                "committed_ahead_max": s.get("committed_ahead_max"),
+            }
+    except Exception as e:  # best-effort metric; label failures accurately
+        train_ab = {"error": f"{type(e).__name__}: {e}"}
+
     # BASELINE configs 3-5 (flagship models); skip with BENCH_FAST=1
     import os
     extra: dict = {}
@@ -476,6 +514,13 @@ def main() -> None:
         "pipeline_rows_per_s": pipe_rows_s,
         "pipeline_rows_per_s_unfused": pipe_rows_s_unfused,
         "pipeline_crossings": pipe_crossings,
+        "train_prefetch_images_per_s_per_chip": (train_ab or {}).get(
+            "prefetch", {}).get("images_per_s_per_chip"),
+        "train_sync_images_per_s_per_chip": (train_ab or {}).get(
+            "sync", {}).get("images_per_s_per_chip"),
+        "train_input_bound_fraction": (train_ab or {}).get(
+            "prefetch", {}).get("input_bound_fraction"),
+        "train_input_ab": train_ab,
         "tunnel_upload_mb_s": tunnel_mb_s,
         "mxu_matmul_tf_s": mxu_tf_s,
         "fetch_rtt_ms": rtt_ms,

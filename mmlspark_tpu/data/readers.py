@@ -186,26 +186,43 @@ def stream_images(
 ) -> Iterator[DataTable]:
     """Stream decoded images as chunked image-struct DataTables.
 
-    Each chunk decodes on a thread pool; memory is bounded by
+    Each chunk decodes on a shared thread pool; memory is bounded by
     ``chunk_rows`` decoded images (ImageNet-shard-scale ingest without
-    materializing the dataset)."""
-    for raw in stream_binary_files(path, recursive, sample_ratio,
-                                   inspect_zip, seed, shard_index,
-                                   num_shards, extensions=IMAGE_EXTENSIONS,
-                                   chunk_rows=chunk_rows):
-        yield _decode_chunk(raw, drop_invalid, image_col, num_threads)
+    materializing the dataset). ONE pool serves the whole stream — a
+    fresh pool per 256-row chunk cost ``num_threads`` thread spawns per
+    chunk, pure overhead on shard-scale streams."""
+    pool = (ThreadPoolExecutor(max_workers=num_threads)
+            if num_threads > 1 else None)
+    try:
+        for raw in stream_binary_files(path, recursive, sample_ratio,
+                                       inspect_zip, seed, shard_index,
+                                       num_shards,
+                                       extensions=IMAGE_EXTENSIONS,
+                                       chunk_rows=chunk_rows):
+            yield _decode_chunk(raw, drop_invalid, image_col, num_threads,
+                                pool=pool)
+    finally:
+        # runs on generator close/GC too (an abandoned stream must not
+        # leak its decode threads)
+        if pool is not None:
+            pool.shutdown(wait=False)
 
 
 def _decode_chunk(raw: DataTable, drop_invalid: bool, image_col: str,
-                  num_threads: int) -> DataTable:
+                  num_threads: int,
+                  pool: ThreadPoolExecutor | None = None) -> DataTable:
     def decode_one(args):
         p, b = args
         return (p, decode_image(b))
 
     records = list(zip(raw["path"], raw["bytes"]))
-    if len(records) > 1 and num_threads > 1:
-        with ThreadPoolExecutor(max_workers=num_threads) as pool:
-            decoded = list(pool.map(decode_one, records))
+    if len(records) > 1 and pool is not None:
+        decoded = list(pool.map(decode_one, records))
+    elif len(records) > 1 and num_threads > 1:
+        # one-shot callers (read_images) still get a pool for this chunk;
+        # num_threads <= 1 stays strictly sequential
+        with ThreadPoolExecutor(max_workers=num_threads) as one_shot:
+            decoded = list(one_shot.map(decode_one, records))
     else:
         decoded = [decode_one(r) for r in records]
 

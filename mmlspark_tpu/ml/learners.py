@@ -51,6 +51,10 @@ class Learner(Params):
 
 
 class FittedLearner:
+    # input-pipeline accounting for learners trained through _train_jax
+    # (input_bound_fraction et al.; None for closed-form/host learners)
+    input_stats: dict | None = None
+
     def predict_arrays(self, x: np.ndarray
                        ) -> tuple[np.ndarray, np.ndarray | None]:
         """Return (predictions, probabilities-or-None)."""
@@ -59,13 +63,30 @@ class FittedLearner:
 
 # ---- JAX linear / MLP learners ----
 
+# committed-batch lookahead for the learner train loops (see
+# train/input.DeviceLoader): the permutation gather + H2D upload of batch
+# i+1 overlaps the compiled step of batch i. Numerics are unchanged at any
+# depth; 2 is classic double-buffering
+LEARNER_PREFETCH_DEPTH = 2
+
+
 def _train_jax(loss_fn: Callable, params0: Any, x: np.ndarray, y: np.ndarray,
                learning_rate: float, epochs: int, batch_size: int,
-               seed: int, weight_decay: float = 0.0) -> Any:
-    """Shared jit-compiled optax Adam loop over padded minibatches."""
+               seed: int, weight_decay: float = 0.0,
+               stats_out: dict | None = None) -> Any:
+    """Shared jit-compiled optax Adam loop over padded minibatches.
+
+    Batch assembly (the shuffled fancy-index gather) and the device commit
+    run on a background thread ``LEARNER_PREFETCH_DEPTH`` steps ahead of
+    consumption, so the step loop only pulls device-resident batches.
+    ``stats_out``, when given, receives the input-wait/step-time
+    decomposition (``input_bound_fraction`` et al.)."""
+    import time
+
     import jax
-    import jax.numpy as jnp
     import optax
+
+    from mmlspark_tpu.train.input import DeviceLoader, input_stats
 
     n = x.shape[0]
     batch_size = int(min(batch_size, n))
@@ -84,15 +105,34 @@ def _train_jax(loss_fn: Callable, params0: Any, x: np.ndarray, y: np.ndarray,
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
+    def host_batches():
+        rng = np.random.default_rng(seed)
+        for _ in range(epochs):
+            order = rng.permutation(n)
+            for s in range(steps_per_epoch):
+                idx = order[s * batch_size:(s + 1) * batch_size]
+                if len(idx) < batch_size:  # static shapes for the jit cache
+                    idx = np.concatenate([idx,
+                                          order[:batch_size - len(idx)]])
+                yield x[idx], y[idx]
+
+    dev0 = jax.devices()[0]
+
+    def commit(batch):
+        return (jax.device_put(batch[0], dev0),
+                jax.device_put(batch[1], dev0))
+
     params = params0
-    rng = np.random.default_rng(seed)
-    for _ in range(epochs):
-        order = rng.permutation(n)
-        for s in range(steps_per_epoch):
-            idx = order[s * batch_size:(s + 1) * batch_size]
-            if len(idx) < batch_size:  # keep shapes static for the jit cache
-                idx = np.concatenate([idx, order[:batch_size - len(idx)]])
-            params, opt_state, _ = step(params, opt_state, x[idx], y[idx])
+    loader = DeviceLoader(host_batches(), commit,
+                          depth=LEARNER_PREFETCH_DEPTH, name="learner")
+    t0 = time.perf_counter()
+    try:
+        for xb, yb in loader:
+            params, opt_state, _ = step(params, opt_state, xb, yb)
+    finally:
+        loader.close()
+    if stats_out is not None:
+        stats_out.update(input_stats(loader, time.perf_counter() - t0))
     return params
 
 
@@ -128,12 +168,15 @@ class LogisticRegression(Learner):
             ce = optax.softmax_cross_entropy_with_integer_labels(logits, yb)
             return ce.mean() + self.reg_param * (params["w"] ** 2).sum()
 
+        stats: dict = {}
         params = _train_jax(loss_fn, params0,
                             x.astype(np.float32), y.astype(np.int32),
                             self.learning_rate, self.epochs, self.batch_size,
-                            self.seed)
-        return _LinearFitted(np.asarray(params["w"]), np.asarray(params["b"]),
-                             classifier=True)
+                            self.seed, stats_out=stats)
+        fitted = _LinearFitted(np.asarray(params["w"]),
+                               np.asarray(params["b"]), classifier=True)
+        fitted.input_stats = stats
+        return fitted
 
 
 class LinearRegression(Learner):
@@ -216,11 +259,15 @@ class MLPClassifier(_MLPBase):
             return optax.softmax_cross_entropy_with_integer_labels(
                 logits, yb).mean()
 
+        stats: dict = {}
         params = _train_jax(loss_fn, params0, x.astype(np.float32),
                             y.astype(np.int32), self.learning_rate,
-                            self.epochs, self.batch_size, self.seed)
-        return _MLPFitted({k2: np.asarray(v) for k2, v in params.items()},
-                          n_layers, classifier=True)
+                            self.epochs, self.batch_size, self.seed,
+                            stats_out=stats)
+        fitted = _MLPFitted({k2: np.asarray(v) for k2, v in params.items()},
+                            n_layers, classifier=True)
+        fitted.input_stats = stats
+        return fitted
 
 
 class MLPRegressor(_MLPBase):
@@ -237,11 +284,15 @@ class MLPRegressor(_MLPBase):
             pred = self._forward(params, xb, n_layers)[:, 0]
             return ((pred - yb) ** 2).mean()
 
+        stats: dict = {}
         params = _train_jax(loss_fn, params0, x.astype(np.float32),
                             y.astype(np.float32), self.learning_rate,
-                            self.epochs, self.batch_size, self.seed)
-        return _MLPFitted({k: np.asarray(v) for k, v in params.items()},
-                          n_layers, classifier=False)
+                            self.epochs, self.batch_size, self.seed,
+                            stats_out=stats)
+        fitted = _MLPFitted({k: np.asarray(v) for k, v in params.items()},
+                            n_layers, classifier=False)
+        fitted.input_stats = stats
+        return fitted
 
 
 class _MLPFitted(FittedLearner):

@@ -15,6 +15,7 @@ from typing import Any
 
 import numpy as np
 
+from mmlspark_tpu.core.logging_utils import get_logger
 from mmlspark_tpu.core.params import Param
 from mmlspark_tpu.core.schema import (
     SchemaConstants, find_unused_column_name, set_categorical_levels,
@@ -29,6 +30,8 @@ from mmlspark_tpu.stages.featurize import (
     Featurize, NUM_FEATURES_DEFAULT, NUM_FEATURES_TREE_OR_NN,
 )
 from mmlspark_tpu.stages.indexers import index_values, sorted_levels
+
+_log = get_logger(__name__)
 
 
 def featurize_params_for(learner: Learner) -> tuple[int, bool]:
@@ -106,6 +109,17 @@ class TrainClassifier(Estimator, HasLabelCol):
         y = y.astype(np.int64)
 
         fitted = learner.fit_arrays(x, y, num_classes=len(levels))
+        # input-pipeline honesty: was the fit compute- or input-bound?
+        # (jax learners train through the prefetching DeviceLoader —
+        # train/input.py; closed-form/host learners report nothing)
+        stats = getattr(fitted, "input_stats", None)
+        if stats:
+            _log.debug("TrainClassifier[%s]: input_bound_fraction=%s "
+                       "(wait %ss / step %ss, %s batches)",
+                       type(learner).__name__,
+                       stats.get("input_bound_fraction"),
+                       stats.get("input_wait_s"), stats.get("step_s"),
+                       stats.get("batches"))
         return TrainedClassifierModel(
             label_col=self.label_col, features_col=features_col,
             featurize_model=feat_model, fitted_learner=fitted,

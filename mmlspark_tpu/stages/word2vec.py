@@ -191,7 +191,10 @@ class Word2Vec(Estimator, HasInputCol, HasOutputCol):
                         jnp.asarray(w),
                         jax.random.fold_in(k_train, step_i))
                     step_i += 1
-                losses.append(float(l))
+                # device scalar, resolved after training: an inline
+                # float() here is a host sync every epoch (JX105)
+                losses.append(l)
+        losses = [float(l_) for l_ in losses]
         _log.info("Word2Vec loss %.4f -> %.4f over %d epochs",
                   losses[0], losses[-1], self.epochs)
         vectors = np.asarray(params["in"], np.float32)

@@ -100,9 +100,12 @@ def _train_eval(bundle, xtr, ytr, xte, yte, steps: int = 300,
         s = (i % per_epoch) * bs
         idx = order[s:s + bs]
         params, opt, l = step(params, opt, xtr[idx], ytr[idx])
+        # keep device scalars; resolve after the loop (a float() here
+        # blocks the host on every step — JX105)
         if first is None:
-            first = float(l)
-        last = float(l)
+            first = l
+        last = l
+    first, last = float(first), float(last)
 
     jeval = jax.jit(logits_fn)
     preds = []
@@ -163,8 +166,9 @@ def _train_bn_and_fold(xtr, ytr, xte, yte, steps: int = 200, bs: int = 128,
         s = (i % per_epoch) * bs
         idx = order[s:s + bs]
         params, stats, opt, l = step(params, stats, opt, xtr[idx], ytr[idx])
-        first = first if first is not None else float(l)
-        last = float(l)
+        first = first if first is not None else l  # resolved after the loop
+        last = l
+    first, last = float(first), float(last)
 
     folded = fold_batchnorm({"params": params, "batch_stats": stats},
                             param_dtype=jnp.bfloat16)
@@ -271,8 +275,9 @@ def build(repo_dir: str, scale: str = "small") -> list:
     for i in range(120):
         s = (i * 64) % 192
         params, opt, l = tstep(params, opt, tr_t[s:s + 64], tr_y[s:s + 64])
-        first = first if first is not None else float(l)
-        last = float(l)
+        first = first if first is not None else l  # resolved after the loop
+        last = l
+    first, last = float(first), float(last)
     preds = np.asarray(jax.jit(
         lambda p, xb: b.module.apply({"params": p}, xb))(params, te_t)
     ).argmax(-1)

@@ -7,8 +7,10 @@ jit-compiled steps sharded over a device mesh.
 """
 
 from mmlspark_tpu.train.checkpoint import TrainCheckpointer
+from mmlspark_tpu.train.input import DeviceLoader
 from mmlspark_tpu.train.learner import JaxLearner, JaxLearnerModel
 from mmlspark_tpu.train.loop import TrainConfig, Trainer, make_train_step
 
-__all__ = ["JaxLearner", "JaxLearnerModel", "TrainCheckpointer",
-           "TrainConfig", "Trainer", "make_train_step"]
+__all__ = ["DeviceLoader", "JaxLearner", "JaxLearnerModel",
+           "TrainCheckpointer", "TrainConfig", "Trainer",
+           "make_train_step"]

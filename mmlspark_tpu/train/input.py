@@ -1,0 +1,283 @@
+"""Asynchronous prefetching train-input pipeline.
+
+The training loop used to commit every batch synchronously inside the
+step loop: gather the shuffled batch, ``device_put`` it, run the step —
+so through a transfer-bound link the upload of batch i+1 serialized
+behind the compute of batch i. :class:`DeviceLoader` is the standard
+overlapped input pipeline (tf.data's prefetch, Murray et al. VLDB 2021;
+the ``prefetch_to_device`` double-buffering idiom of the Flax training
+playbook) built as a first-class subsystem:
+
+* **batch assembly** (permutation gather / chunk-rebatch / image decode)
+  runs on ONE background thread pulling the host-batch iterator,
+* the **commit** (``jax.device_put`` or
+  ``jax.make_array_from_process_local_data``, reusing the Trainer's data
+  shardings) is issued up to ``depth`` batches ahead of consumption, so
+  steady-state wall clock per step is max(H2D, compute) instead of the
+  sum,
+* HBM held by in-flight batches is bounded by the queue depth,
+* the consumer pulls already-device-resident arrays and raises the
+  producer's exception (source or commit) at the point of consumption;
+  ``close()`` shuts the worker down without leaking the thread even when
+  the consumer abandons the loop mid-epoch.
+
+``depth=0`` is the synchronous fallback: the same iterator/commit are
+driven inline with identical numerics (this is the A/B path ``bench.py``
+measures). Prefetching never changes numerics at any depth — the same
+host batches are committed to the same shardings in the same order; only
+*when* the H2D transfer is issued moves.
+
+Multi-host rule (docs/training_input.md): a producer whose iterator
+performs cross-process exchanges (the ``fit_stream`` liveness allgather /
+batch-signature sync) must call :meth:`DeviceLoader.drain_barrier` first,
+so every process interleaves collectives with step dispatch in the same
+order; the consumer reports step dispatches via
+:meth:`DeviceLoader.note_dispatched`.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Iterable, Iterator
+
+from mmlspark_tpu.core.logging_utils import get_logger
+
+_log = get_logger(__name__)
+
+THREAD_PREFIX = "DeviceLoader"
+
+_ITEM, _ERROR, _DONE = "item", "error", "done"
+
+
+def _annotate(name: str):
+    """Profiler span (utils/profiling.annotate), inert if jax is absent —
+    the loader must stay importable for host-only assembly tests."""
+    try:
+        from mmlspark_tpu.utils.profiling import annotate
+        return annotate(name)
+    except Exception:  # pragma: no cover - jax always present in CI
+        import contextlib
+        return contextlib.nullcontext()
+
+
+class DeviceLoader:
+    """Bounded-queue prefetching loader: iterate committed device batches.
+
+    Parameters
+    ----------
+    source:
+        Iterator/iterable of host-side items (typically
+        ``(bx, by, bw)`` numpy batches, or tagged tuples around them).
+    commit:
+        ``item -> item`` mapping host arrays to device-committed arrays
+        (``jax.device_put`` / ``make_array_from_process_local_data`` with
+        the trainer's data sharding). Runs on the worker thread, up to
+        ``depth`` items ahead of consumption.
+    depth:
+        Maximum committed-but-unconsumed batches (queue bound = HBM
+        bound). ``0`` disables the worker thread entirely: assembly and
+        commit run inline in ``__next__`` (the synchronous A/B path).
+    name:
+        Label for the worker thread and profiler spans.
+
+    Accounting (read after — or during — iteration):
+
+    * ``committed`` / ``consumed`` — batches through each end,
+    * ``max_ahead`` — max batches that were already committed *beyond*
+      the one being consumed (the proof the pipeline actually ran ahead),
+    * ``wait_s`` — consumer time blocked waiting for input (for
+      ``depth=0`` this is the full inline assemble+commit time, so the
+      number stays comparable across the A/B),
+    * ``assemble_s`` / ``commit_s`` — producer-side decomposition.
+    """
+
+    def __init__(self, source: Iterable | Iterator,
+                 commit: Callable[[Any], Any],
+                 depth: int = 2, name: str = "train-input"):
+        self.depth = max(int(depth), 0)
+        self.name = name
+        self._source = iter(source)
+        self._commit = commit
+        self.committed = 0
+        self.consumed = 0
+        self.dispatched = 0
+        self.max_ahead = 0
+        self.wait_s = 0.0
+        self.assemble_s = 0.0
+        self.commit_s = 0.0
+        self._done = False
+        if self.depth > 0:
+            self._q: queue.Queue = queue.Queue(maxsize=self.depth)
+            self._stop = threading.Event()
+            self._disp_cv = threading.Condition()
+            self._thread = threading.Thread(
+                target=self._run, name=f"{THREAD_PREFIX}[{name}]",
+                daemon=True)
+            self._thread.start()
+
+    # ---- producer (worker thread) ----
+
+    def _run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                t0 = time.perf_counter()
+                try:
+                    item = next(self._source)
+                except StopIteration:
+                    break
+                self.assemble_s += time.perf_counter() - t0
+                t0 = time.perf_counter()
+                with _annotate(f"{self.name}/commit"):
+                    out = self._commit(item)
+                self.commit_s += time.perf_counter() - t0
+                self.committed += 1
+                if not self._put((_ITEM, out)):
+                    return  # closed while blocked on a full queue
+            self._put((_DONE, None))
+        except BaseException as e:  # noqa: BLE001 — relayed to consumer
+            self._put((_ERROR, e))
+
+    def _put(self, msg: tuple) -> bool:
+        """Bounded put that aborts when the loader is closed — a consumer
+        that stopped pulling must never leave the worker blocked."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(msg, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    # ---- consumer ----
+
+    def __iter__(self) -> "DeviceLoader":
+        return self
+
+    def __next__(self) -> Any:
+        if self.depth == 0:
+            # synchronous fallback: identical iterator + commit, inline.
+            # The full assemble+commit time counts as input wait so the
+            # prefetch on/off decomposition stays comparable
+            t0 = time.perf_counter()
+            with _annotate(f"{self.name}/input"):
+                item = next(self._source)  # StopIteration ends iteration
+                self.assemble_s += time.perf_counter() - t0
+                t1 = time.perf_counter()
+                out = self._commit(item)
+                self.commit_s += time.perf_counter() - t1
+            self.wait_s += time.perf_counter() - t0
+            self.committed += 1
+            self.consumed += 1
+            return out
+        if self._done:
+            raise StopIteration
+        t0 = time.perf_counter()
+        with _annotate(f"{self.name}/wait"):
+            tag, val = self._q.get()
+        self.wait_s += time.perf_counter() - t0
+        if tag is _DONE:
+            self._done = True
+            raise StopIteration
+        if tag is _ERROR:
+            self._done = True
+            self.close()
+            raise val
+        # batches fully committed BEYOND the one now being handed over
+        ahead = self.committed - self.consumed - 1
+        if ahead > self.max_ahead:
+            self.max_ahead = ahead
+        self.consumed += 1
+        return val
+
+    # ---- multi-host dispatch fencing ----
+
+    def note_dispatched(self) -> None:
+        """Consumer: record that the step for the last pulled batch has
+        been dispatched (required only when the producer uses
+        :meth:`drain_barrier`)."""
+        if self.depth == 0:
+            return
+        with self._disp_cv:
+            self.dispatched += 1
+            self._disp_cv.notify_all()
+
+    def drain_barrier(self, poll_s: float = 0.05) -> None:
+        """Producer: block until every committed batch's step has been
+        dispatched by the consumer. Multi-host producers call this before
+        issuing a cross-process collective (liveness allgather, batch
+        signature sync) so every process's device-op issue order is
+        identical — collectives interleaved differently across processes
+        deadlock. Returns immediately in synchronous (depth=0) mode and
+        when the loader is closed."""
+        if self.depth == 0:
+            return
+        with self._disp_cv:
+            while (not self._stop.is_set()
+                   and self.dispatched < self.committed):
+                self._disp_cv.wait(timeout=poll_s)
+
+    # ---- lifecycle ----
+
+    def close(self) -> None:
+        """Stop the worker and release the queue. Idempotent; safe after
+        consumer exceptions mid-epoch (no leaked thread, no deadlock)."""
+        if self.depth == 0:
+            close_fn = getattr(self._source, "close", None)
+            if close_fn is not None:
+                try:
+                    close_fn()
+                except Exception:  # pragma: no cover - best-effort
+                    pass
+            return
+        self._stop.set()
+        with self._disp_cv:
+            self._disp_cv.notify_all()  # unblock a producer in the barrier
+        try:  # unblock a producer stuck on a full queue
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=10.0)
+        if self._thread.is_alive():  # pragma: no cover - defensive
+            _log.warning("DeviceLoader[%s] worker did not stop", self.name)
+            return
+        # deterministic release of source-held resources (decode pools,
+        # file handles) instead of waiting for GC of the abandoned frame
+        close_fn = getattr(self._source, "close", None)
+        if close_fn is not None:
+            try:
+                close_fn()
+            except Exception:  # pragma: no cover - best-effort
+                pass
+
+    def __enter__(self) -> "DeviceLoader":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+
+def input_stats(loader: DeviceLoader, loop_s: float) -> dict:
+    """Per-run input-wait vs. step-time accounting for a finished loop.
+
+    ``input_bound_fraction`` is the share of loop wall-clock the consumer
+    spent blocked on input — ~0 means compute-bound (prefetch hid the
+    input side), ~1 means the pipeline is input-bound and a deeper queue
+    or faster assembly/link is the lever. ``step_s`` is everything else
+    in the consumer loop: step dispatch plus the periodic lagged metric
+    fetches that drain the device pipeline."""
+    wait = loader.wait_s
+    loop_s = max(float(loop_s), 0.0)
+    return {
+        "prefetch_depth": loader.depth,
+        "batches": loader.consumed,
+        "committed_ahead_max": loader.max_ahead,
+        "input_wait_s": round(wait, 4),
+        "step_s": round(max(loop_s - wait, 0.0), 4),
+        "input_bound_fraction": (round(min(wait / loop_s, 1.0), 4)
+                                 if loop_s > 0 else 0.0),
+        "assemble_s": round(loader.assemble_s, 4),
+        "commit_s": round(loader.commit_s, 4),
+    }

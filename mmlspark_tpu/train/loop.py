@@ -17,6 +17,7 @@ CommandBuilders.scala:79-93), this trains in-process:
 from __future__ import annotations
 
 import dataclasses
+import time
 from functools import partial
 from typing import Any, Callable, Iterator
 
@@ -50,6 +51,21 @@ class TrainConfig:
     mesh_spec: Any = None            # MeshSpec | dict | None (dp over all)
     donate_state: bool = True
     log_every: int = 50
+    # asynchronous input pipeline (train/input.py): batch assembly runs on
+    # a background thread and the device commit is issued up to this many
+    # batches ahead of consumption, so steady-state step wall-clock is
+    # max(H2D, compute) instead of the sum; HBM held by in-flight batches
+    # is bounded by the depth. 0 = fully synchronous (assemble + commit
+    # inline in the step loop — the pre-round-7 behavior). Numerics are
+    # bit-identical at every depth: the same host batches commit to the
+    # same shardings in the same order
+    prefetch_depth: int = 2
+    # on-device scale applied after the f32 cast of uint8 inputs: uint8
+    # image batches ship thin (¼ the H2D bytes of f32 — the round-2
+    # inference convention applied to training) and cast/normalize INSIDE
+    # the jitted step. The default maps raw bytes to [0, 1]; float inputs
+    # are never touched
+    input_scale: float = 1.0 / 255.0
     # multi-host fit_stream: local batches buffered per cross-process
     # liveness exchange. 1 = a host-side barrier every step (the
     # conservative round-3 behavior); larger values amortize it over up to
@@ -322,9 +338,17 @@ def make_train_step(module: Any, cfg: TrainConfig, mesh: Any):
             return (x.astype(jnp.int32) != pad_id).astype(jnp.float32)
         return None
 
+    def _prep_x(x):
+        # uint8 ships thin (¼ the H2D bytes) and casts/normalizes on
+        # device — the round-2 inference convention, applied to training.
+        # Token matrices are int32/int64 and pass through untouched
+        if x.dtype == jnp.uint8:
+            return x.astype(jnp.float32) * cfg.input_scale
+        return x
+
     def _step(state, x, y):
         def compute_loss(params):
-            logits, aux = _forward(params, x)
+            logits, aux = _forward(params, _prep_x(x))
             per = loss_fn(logits, y, token_mask=_token_mask(x))
             return per.mean() + cfg.moe_aux_weight * aux
 
@@ -337,7 +361,7 @@ def make_train_step(module: Any, cfg: TrainConfig, mesh: Any):
         # clamped denominator makes an all-zero-weight batch (multi-host
         # filler between liveness syncs) an exact no-op instead of 0/0 NaN
         def compute_loss(params):
-            logits, aux = _forward(params, x)
+            logits, aux = _forward(params, _prep_x(x))
             per = loss_fn(logits, y, token_mask=_token_mask(x))
             # gate the aux term on the row weights too: an all-filler batch
             # must be an EXACT no-op, but routing statistics are computed
@@ -480,6 +504,11 @@ class Trainer:
             module, self.cfg, self.mesh)
         self.state = None
         self.history: list[float] = []
+        # per-step input-wait vs. step-time accounting for the last fit
+        # (train/input.input_stats): input_bound_fraction, wait/step split,
+        # committed_ahead_max — the honest answer to "was that run input-
+        # bound or compute-bound?"
+        self.input_stats: dict | None = None
         self._fingerprint: dict | None = None
 
     def data_target(self):
@@ -616,30 +645,61 @@ class Trainer:
         ckpt = self._checkpointer()
         # resume completes the REMAINDER of the configured schedule: the
         # first `resumed` (already-trained) steps of the epoch/batch walk are
-        # replayed as no-ops so batch order stays deterministic
-        global_step = 0
-        with timed(f"Trainer[{type(self.module).__name__}]", _log, len(x)):
-            if nproc > 1:
-                def commit(arr):
-                    # local slice → its block of the globally-sharded array
-                    return jax.make_array_from_process_local_data(data, arr)
-            else:
-                def commit(arr):
-                    return jax.device_put(arr, data)
+        # replayed as no-ops so batch order stays deterministic. The resumed
+        # prefix is skipped in the PRODUCER, before assembly/commit — a
+        # replayed batch never crosses the link
+        from mmlspark_tpu.train.input import DeviceLoader, input_stats
+
+        if nproc > 1:
+            def commit(arr):
+                # local slice → its block of the globally-sharded array
+                return jax.make_array_from_process_local_data(data, arr)
+        else:
+            def commit(arr):
+                return jax.device_put(arr, data)
+
+        total_steps = cfg.epochs * (-(-len(x) // bs_local))
+
+        def host_batches():
+            gs = 0
             for epoch in range(cfg.epochs):
-                for i, (bx, by, bw) in enumerate(
+                for i, batch in enumerate(
                         _batches(x, y, bs_local, cfg.seed + epoch, valid)):
-                    global_step += 1
-                    if global_step <= resumed:
+                    gs += 1
+                    if gs <= resumed:
                         continue
+                    yield gs, i, batch
+
+        def commit_batch(item):
+            gs, i, (bx, by, bw) = item
+            return gs, i, (commit(bx), commit(by), commit(bw))
+
+        # one-step-lagged loss fetch: resolving the PREVIOUS log point's
+        # device scalar never stalls the in-flight prefetch window (the
+        # inline float() was a host sync mid-pipeline every log_every steps)
+        pending = None
+        loader = DeviceLoader(host_batches(), commit_batch,
+                              depth=cfg.prefetch_depth, name="fit_arrays")
+        t_loop = time.perf_counter()
+        try:
+            with timed(f"Trainer[{type(self.module).__name__}]", _log,
+                       len(x)):
+                for gs, i, (dx, dy, dw) in loader:
                     self.state, metrics = self.step_masked(
-                        self.state, commit(bx), commit(by), commit(bw))
+                        self.state, dx, dy, dw)
                     if i % cfg.log_every == 0:
-                        self.history.append(float(metrics["loss"]))
+                        if pending is not None:
+                            self.history.append(float(pending))  # lint-jax: allow(JX105) — one-step-lagged fetch
+                        pending = metrics["loss"]
                     if (ckpt is not None and cfg.checkpoint_every > 0
-                            and global_step % cfg.checkpoint_every == 0):
+                            and gs % cfg.checkpoint_every == 0):
                         self.save_checkpoint()
-        if ckpt is not None and global_step > resumed:
+        finally:
+            loader.close()
+        if pending is not None:
+            self.history.append(float(pending))
+        self.input_stats = input_stats(loader, time.perf_counter() - t_loop)
+        if ckpt is not None and total_steps > resumed:
             self.save_checkpoint()
         return self
 
@@ -694,13 +754,33 @@ class Trainer:
                              "epochs": int(cfg.epochs),
                              "param_dtype": cfg.param_dtype or "float32",
                              "sched": 2}
-        resumed = 0
         ckpt = self._checkpointer()
-        global_step = 0
-        rows = 0
-        shapes: tuple | None = None  # (x tail shape/dtype, y tail/dtype)
+        # producer-side progress, read by the consumer once the loader is
+        # drained (the worker has exited by then): walked steps include the
+        # resumed prefix, rows count only real (non-filler) examples
+        prog = {"steps": 0, "rows": 0, "resumed": 0}
+        box: dict = {"loader": None}
 
-        def dummy_batch():
+        from mmlspark_tpu.train.input import DeviceLoader, input_stats
+
+        def ensure_state(bx) -> None:
+            # runs on the producer thread BEFORE the first batch is
+            # yielded — the consumer is still blocked on the queue, so
+            # state init / checkpoint restore never overlaps step dispatch
+            if self.state is None:
+                spec = tuple(input_spec or bx.shape[1:])
+                self.state = self.init_state(spec)
+                prog["resumed"] = self.maybe_restore() or 0
+
+        def fence() -> None:
+            # multi-host: every cross-process exchange must interleave
+            # with step dispatch in the same order on every process —
+            # drain the in-flight window before issuing the collective
+            # (docs/training_input.md, "lockstep rules")
+            if box["loader"] is not None:
+                box["loader"].drain_barrier()
+
+        def dummy_batch(shapes: tuple | None) -> tuple:
             # zero-weight filler keeping cross-process collectives aligned
             # when this process's shard ran dry before its peers'
             if shapes is not None:
@@ -717,10 +797,19 @@ class Trainer:
                     np.zeros((bs_local,) + ys, yd),
                     np.zeros(bs_local, np.float32))
 
-        sig_synced = False
         import itertools as _itertools
         sync_n = max(int(cfg.liveness_sync_every), 1)
-        with timed(f"Trainer[{type(self.module).__name__}:stream]", _log):
+
+        def host_batches():
+            # chunk pull (→ image decode in streaming sources) + rebatch +
+            # filler/liveness reconciliation, all on the producer thread —
+            # with prefetch_depth > 0 the whole input side overlaps step
+            # compute. Filler batches and the signature sync flow through
+            # unchanged, so the multi-host step walk is identical to the
+            # synchronous path
+            shapes: tuple | None = None  # (x tail shape/dtype, y tail/dt)
+            sig_synced = False
+            gs = 0
             for epoch in range(cfg.epochs):
                 it = iter(epoch_iter())
                 if nproc > 1 and not sig_synced:
@@ -728,6 +817,7 @@ class Trainer:
                     # processes): a process whose shard is empty adopts its
                     # peers' shapes/dtypes for filler batches, so every
                     # process compiles the identical step program
+                    fence()
                     first = next(it, None)
                     shapes = _sync_batch_signature(first) or shapes
                     sig_synced = True
@@ -746,9 +836,11 @@ class Trainer:
                         # filler up to the block's max count. Step counts
                         # are exact: the longest stream sets the walk
                         block = list(_itertools.islice(it, sync_n))
+                        fence()
                         from jax.experimental import multihost_utils
-                        counts = np.asarray(multihost_utils.process_allgather(
-                            np.asarray(len(block), np.int64)))
+                        counts = np.asarray(
+                            multihost_utils.process_allgather(
+                                np.asarray(len(block), np.int64)))
                         block_steps = int(counts.max())
                         if block_steps == 0:
                             break
@@ -760,31 +852,59 @@ class Trainer:
                         block = [nxt]
                     for batch in block:
                         if batch is None:
-                            batch = dummy_batch()
+                            batch = dummy_batch(shapes)
                         bx, by, bw = batch
                         shapes = ((bx.shape[1:], bx.dtype),
                                   (by.shape[1:], by.dtype))
-                        if self.state is None:
-                            spec = tuple(input_spec or bx.shape[1:])
-                            self.state = self.init_state(spec)
-                            resumed = self.maybe_restore() or 0
-                        global_step += 1
-                        if global_step <= resumed:
+                        ensure_state(bx)
+                        gs += 1
+                        prog["steps"] = gs
+                        if gs <= prog["resumed"]:
                             continue
-                        rows += int(bw.sum())
-                        self.state, metrics = self.step_masked(
-                            self.state, commit(bx), commit(by), commit(bw))
-                        if (global_step - 1) % cfg.log_every == 0:
-                            self.history.append(float(metrics["loss"]))
-                        if (ckpt is not None and cfg.checkpoint_every > 0
-                                and global_step % cfg.checkpoint_every == 0):
-                            self.save_checkpoint()
-        if global_step == 0:
+                        prog["rows"] += int(bw.sum())
+                        yield gs, batch
+
+        def commit_batch(item):
+            gs, (bx, by, bw) = item
+            return gs, (commit(bx), commit(by), commit(bw))
+
+        pending = None  # one-step-lagged loss fetch (see fit_arrays)
+        loader = DeviceLoader(host_batches(), commit_batch,
+                              depth=cfg.prefetch_depth, name="fit_stream")
+        box["loader"] = loader
+        t_loop = time.perf_counter()
+        try:
+            with timed(f"Trainer[{type(self.module).__name__}:stream]",
+                       _log):
+                for gs, (dx, dy, dw) in loader:
+                    self.state, metrics = self.step_masked(
+                        self.state, dx, dy, dw)
+                    if (gs - 1) % cfg.log_every == 0:
+                        if pending is not None:
+                            self.history.append(float(pending))  # lint-jax: allow(JX105) — one-step-lagged fetch
+                        pending = metrics["loss"]
+                    if (ckpt is not None and cfg.checkpoint_every > 0
+                            and gs % cfg.checkpoint_every == 0):
+                        self.save_checkpoint()
+                    # AFTER the checkpoint: save_checkpoint's
+                    # sync_global_devices is itself a cross-process
+                    # collective, so the producer's drain_barrier must
+                    # hold until it completes — releasing it at step
+                    # dispatch would let the liveness allgather race the
+                    # checkpoint barrier across processes
+                    loader.note_dispatched()
+        finally:
+            loader.close()
+        if pending is not None:
+            self.history.append(float(pending))
+        self.input_stats = input_stats(loader, time.perf_counter() - t_loop)
+        if prog["steps"] == 0:
             raise ValueError(
                 "fit_stream: the stream yielded no data (empty source or "
                 "mistyped path?)")
-        _log.info("fit_stream: %d rows in %d steps", rows, global_step)
-        if ckpt is not None and global_step > resumed:
+        _log.info("fit_stream: %d rows in %d steps", prog["rows"],
+                  prog["steps"])
+        if ckpt is not None and prog["steps"] > prog["resumed"]:
             self.save_checkpoint()
         return self
 

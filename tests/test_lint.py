@@ -75,6 +75,18 @@ def traced_by_name(params, x):
 jitted = jax.jit(traced_by_name)
 
 
+def train(batches, state, step_masked):
+    history = []
+    for b in batches:
+        state, metrics = step_masked(state, b)
+        history.append(float(metrics["loss"]))            # JX105
+        pending = metrics["loss"]
+        x = float(pending)                                # JX105
+        y = metrics["loss"].item()                        # JX105
+    final = float(metrics["loss"])  # after the loop: drains, no stall
+    return state, history, x, y, final
+
+
 def host_side_is_fine(x):
     # not jitted: host syncs here are intentional and unflagged
     return float(np.asarray(x).sum())
@@ -93,7 +105,7 @@ def test_fixture_yields_exactly_the_seeded_findings():
     want = sorted(
         (rule, i + 1)
         for i, text in enumerate(lines)
-        for rule in ("JX101", "JX102", "JX103", "JX104")
+        for rule in ("JX101", "JX102", "JX103", "JX104", "JX105")
         if f"# {rule}" in text)
     assert got == want, (got, want)
 
@@ -107,6 +119,42 @@ def test_shim_surface_is_not_flagged():
     assert lint_source(src, "x.py") == []
     src2 = "import jax\ng = jax.shard_map(None, None, None, None)\n"
     assert [f.rule for f in lint_source(src2, "x.py")] == ["JX103"]
+
+
+def test_jx105_lagged_fetch_is_clean():
+    # the one-step-lagged idiom: record the device scalar in the loop,
+    # resolve it AFTER (or pragma the in-loop resolution of the previous
+    # step's scalar, as train/loop.py does)
+    src = ("def fit(batches, state, step):\n"
+           "    for b in batches:\n"
+           "        state, m = step(state, b)\n"
+           "        pending = m['loss']\n"
+           "    return float(pending)\n")
+    assert lint_source(src, "x.py") == []
+    src_sync = src.replace("        pending = m['loss']\n",
+                           "        v = float(m['loss'])\n")
+    assert [f.rule for f in lint_source(src_sync, "x.py")] == ["JX105"]
+
+
+def test_jx105_pragma_suppresses():
+    src = ("def fit(batches, state, step):\n"
+           "    for b in batches:\n"
+           "        state, m = step(state, b)\n"
+           "        v = float(m['loss'])  # lint-jax: allow(JX105)\n"
+           "    return v\n")
+    assert lint_source(src, "x.py") == []
+
+
+def test_jx105_ignores_non_step_calls():
+    # scalar fetches on values from non-step calls in a loop are host-side
+    # bookkeeping, not a pipeline stall — out of JX105's scope
+    src = ("def walk(rows, measure):\n"
+           "    total = 0.0\n"
+           "    for r in rows:\n"
+           "        v = measure(r)\n"
+           "        total += float(v)\n"
+           "    return total\n")
+    assert lint_source(src, "x.py") == []
 
 
 def test_pragma_suppresses():

@@ -1,13 +1,17 @@
 """Tier-1 wiring of tools/perf_smoke.py: the planner must fuse the
 canonical image pipeline into exactly one H2D upload and one async D2H
-fetch round per minibatch (counted at the planner's crossing seams)."""
+fetch round per minibatch (counted at the planner's crossing seams), and
+the train input pipeline must actually commit batches ahead of
+consumption (counted at the DeviceLoader's producer/consumer seams)."""
 
 import os
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
 
-from perf_smoke import check_fused_crossings  # noqa: E402
+from perf_smoke import (  # noqa: E402
+    check_fused_crossings, check_train_prefetch,
+)
 
 
 def test_canonical_image_pipeline_fuses_to_one_round_trip():
@@ -15,3 +19,10 @@ def test_canonical_image_pipeline_fuses_to_one_round_trip():
     assert result["h2d_uploads"] == result["minibatches"]
     assert result["d2h_fetch_rounds"] == result["minibatches"]
     assert result["segments"] == [("device", 3)]
+
+
+def test_train_loader_commits_ahead_of_consumption():
+    result = check_train_prefetch()
+    assert result["committed_ahead_max"] >= result["prefetch_depth"]
+    assert result["batches"] == result["steps"]
+    assert 0.0 <= result["input_bound_fraction"] <= 1.0

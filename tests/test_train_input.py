@@ -1,0 +1,268 @@
+"""The asynchronous prefetching train-input pipeline (train/input.py).
+
+Two families of guarantees:
+
+* **Numerics**: prefetch on/off produce BIT-identical loss histories and
+  final params for a fixed seed — the loader moves *when* batches cross
+  the link, never what crosses — for fit_arrays and fit_stream including
+  the padded-tail and unequal-chunk cases, and the uint8-to-device
+  convention matches host-side normalization to float tolerance.
+* **Lifecycle**: the bounded queue commits ahead of consumption, producer
+  and commit errors surface at the point of consumption, and shutdown is
+  clean on mid-epoch exceptions — no leaked threads, no deadlock.
+"""
+
+import itertools
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from mmlspark_tpu.models.zoo import MLP
+from mmlspark_tpu.train import DeviceLoader, TrainConfig, Trainer
+from mmlspark_tpu.train.input import THREAD_PREFIX, input_stats
+
+
+def _loader_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith(THREAD_PREFIX)]
+
+
+def _assert_no_leaked_threads(timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if not _loader_threads():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"leaked loader threads: {_loader_threads()}")
+
+
+def _params_bitwise_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for u, v in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(u), np.asarray(v))
+
+
+def _xy(n=40, seed=3):
+    r = np.random.default_rng(seed)
+    x = r.normal(size=(n, 6)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int64)
+    return x, y
+
+
+def _cfg(depth, **kw):
+    base = dict(batch_size=16, epochs=2, learning_rate=1e-2, log_every=1,
+                prefetch_depth=depth, donate_state=False)
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+class TestBitIdentity:
+    def test_fit_arrays_prefetch_matches_sync_with_padded_tail(self):
+        # 40 rows / batch 16 → the tail batch is zero-padded + masked
+        x, y = _xy(40)
+        trainers = {}
+        for depth in (2, 0):
+            tr = Trainer(MLP(features=(16,), num_outputs=2), _cfg(depth))
+            tr.fit_arrays(x, y)
+            trainers[depth] = tr
+        assert trainers[2].history == trainers[0].history
+        assert len(trainers[2].history) == 6  # 3 batches × 2 epochs
+        _params_bitwise_equal(trainers[2].params, trainers[0].params)
+        assert trainers[2].input_stats["prefetch_depth"] == 2
+        assert trainers[2].input_stats["batches"] == 6
+        assert trainers[0].input_stats["committed_ahead_max"] == 0
+        _assert_no_leaked_threads()
+
+    def test_fit_stream_prefetch_matches_sync_unequal_chunks(self):
+        x, y = _xy(40)
+        sizes = [5, 11, 3, 13, 7, 1]  # 40 rows in ragged chunks
+
+        def source():
+            off = 0
+            for n in sizes:
+                yield x[off:off + n], y[off:off + n]
+                off += n
+
+        trainers = {}
+        for depth in (3, 0):
+            tr = Trainer(MLP(features=(16,), num_outputs=2), _cfg(depth))
+            tr.fit_stream(source)
+            trainers[depth] = tr
+        assert trainers[3].history == trainers[0].history
+        _params_bitwise_equal(trainers[3].params, trainers[0].params)
+        assert trainers[3].input_stats["batches"] == 6
+        _assert_no_leaked_threads()
+
+    def test_deep_prefetch_matches_depth_one(self):
+        # depth only bounds the queue; any depth > 0 is the same walk
+        x, y = _xy(40)
+        a = Trainer(MLP(features=(16,), num_outputs=2), _cfg(1))
+        b = Trainer(MLP(features=(16,), num_outputs=2), _cfg(8))
+        a.fit_arrays(x, y)
+        b.fit_arrays(x, y)
+        assert a.history == b.history
+        _params_bitwise_equal(a.params, b.params)
+
+    def test_uint8_ships_thin_and_normalizes_on_device(self):
+        # uint8 batches cast to f32 and scale by cfg.input_scale INSIDE
+        # the jitted step — equivalent to host-side /255 normalization to
+        # float tolerance (a*(1/255) vs a/255 differ in last-ulp rounding)
+        r = np.random.default_rng(7)
+        xu = r.integers(0, 255, size=(48, 12)).astype(np.uint8)
+        y = (xu.astype(np.float32).sum(axis=1) > 6 * 255).astype(np.int64)
+        xf = xu.astype(np.float32) / 255.0
+
+        tru = Trainer(MLP(features=(16,), num_outputs=2), _cfg(2))
+        tru.fit_arrays(xu, y)
+        trf = Trainer(MLP(features=(16,), num_outputs=2), _cfg(2))
+        trf.fit_arrays(xf, y)
+        np.testing.assert_allclose(tru.history, trf.history,
+                                   rtol=1e-5, atol=1e-6)
+        for u, v in zip(jax.tree_util.tree_leaves(tru.params),
+                        jax.tree_util.tree_leaves(trf.params)):
+            np.testing.assert_allclose(np.asarray(u), np.asarray(v),
+                                       rtol=1e-4, atol=1e-6)
+
+
+class TestLoaderLifecycle:
+    def test_commits_ahead_of_slow_consumer(self):
+        ld = DeviceLoader(iter(range(10)), lambda v: v, depth=2,
+                          name="t-ahead")
+        got = []
+        with ld:
+            for v in ld:
+                time.sleep(0.02)  # slow consumer: producer fills the queue
+                got.append(v)
+        assert got == list(range(10))
+        assert ld.committed == ld.consumed == 10
+        assert ld.max_ahead >= 2
+        _assert_no_leaked_threads()
+
+    def test_depth_zero_is_synchronous_no_thread(self):
+        before = _loader_threads()
+        ld = DeviceLoader(iter(range(5)), lambda v: v * 2, depth=0,
+                          name="t-sync")
+        assert _loader_threads() == before  # no worker spawned
+        assert list(ld) == [0, 2, 4, 6, 8]
+        assert ld.committed == ld.consumed == 5
+        assert ld.max_ahead == 0
+
+    def test_consumer_exception_shuts_down_cleanly(self):
+        # producer is blocked on a full queue when the consumer bails —
+        # close() must unblock it and join the thread (no deadlock)
+        with pytest.raises(RuntimeError, match="boom"):
+            with DeviceLoader(itertools.count(), lambda v: v, depth=2,
+                              name="t-bail") as ld:
+                for v in ld:
+                    if v == 3:
+                        raise RuntimeError("boom")
+        _assert_no_leaked_threads()
+
+    def test_source_exception_propagates_at_consumption(self):
+        def src():
+            yield 1
+            yield 2
+            raise ValueError("decode failed")
+
+        ld = DeviceLoader(src(), lambda v: v, depth=2, name="t-srcfail")
+        got = []
+        with pytest.raises(ValueError, match="decode failed"):
+            with ld:
+                for v in ld:
+                    got.append(v)
+        assert got == [1, 2]
+        _assert_no_leaked_threads()
+
+    def test_commit_exception_propagates(self):
+        def commit(v):
+            if v == 2:
+                raise TypeError("cannot commit")
+            return v
+
+        with pytest.raises(TypeError, match="cannot commit"):
+            with DeviceLoader(iter(range(5)), commit, depth=2,
+                              name="t-commitfail") as ld:
+                list(ld)
+        _assert_no_leaked_threads()
+
+    def test_close_is_idempotent(self):
+        ld = DeviceLoader(iter(range(100)), lambda v: v, depth=2,
+                          name="t-idem")
+        next(ld)
+        ld.close()
+        ld.close()
+        _assert_no_leaked_threads()
+
+    def test_sync_mode_closes_source(self):
+        closed = []
+
+        def src():
+            try:
+                yield from range(10)
+            finally:
+                closed.append(True)
+
+        ld = DeviceLoader(src(), lambda v: v, depth=0, name="t-synccl")
+        next(ld)
+        ld.close()
+        assert closed == [True]
+
+    def test_input_stats_shape(self):
+        ld = DeviceLoader(iter(range(4)), lambda v: v, depth=2,
+                          name="t-stats")
+        with ld:
+            list(ld)
+        s = input_stats(ld, 1.0)
+        assert s["batches"] == 4
+        assert 0.0 <= s["input_bound_fraction"] <= 1.0
+        assert set(s) == {"prefetch_depth", "batches", "committed_ahead_max",
+                          "input_wait_s", "step_s", "input_bound_fraction",
+                          "assemble_s", "commit_s"}
+
+
+class TestTrainerShutdown:
+    def test_fit_stream_source_error_mid_epoch_no_leak(self):
+        x, y = _xy(40)
+
+        def source():
+            yield x[:16], y[:16]
+            yield x[16:32], y[16:32]
+            raise OSError("shard went away")
+
+        tr = Trainer(MLP(features=(16,), num_outputs=2),
+                     _cfg(2, epochs=1))
+        with pytest.raises(OSError, match="shard went away"):
+            tr.fit_stream(source())
+        _assert_no_leaked_threads()
+
+    def test_fit_stream_empty_still_raises(self):
+        tr = Trainer(MLP(features=(16,), num_outputs=2), _cfg(2, epochs=1))
+        with pytest.raises(ValueError, match="yielded no data"):
+            tr.fit_stream(iter([]))
+        _assert_no_leaked_threads()
+
+    def test_step_error_mid_fit_no_leak(self):
+        # consumer-side failure: labels out of range make the masked step
+        # raise at dispatch on some backends; emulate determinism by
+        # breaking the trainer's step fn instead
+        x, y = _xy(40)
+        tr = Trainer(MLP(features=(16,), num_outputs=2), _cfg(2))
+        calls = {"n": 0}
+        real_step = tr.step_masked
+
+        def exploding_step(state, bx, by, bw):
+            calls["n"] += 1
+            if calls["n"] == 3:
+                raise RuntimeError("device OOM")
+            return real_step(state, bx, by, bw)
+
+        tr.step_masked = exploding_step
+        with pytest.raises(RuntimeError, match="device OOM"):
+            tr.fit_arrays(x, y)
+        _assert_no_leaked_threads()

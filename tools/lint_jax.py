@@ -17,6 +17,12 @@ jax initialization) catching the mistakes that cost the most on TPU:
   side or the other).
 * **JX104 mutable Param default** — ``Param(default=[])`` / ``{}`` /
   ``set()``: the default is shared across every stage instance.
+* **JX105 blocking scalar fetch in a step loop** — ``float()``/``int()``/
+  ``.item()`` on the output of a ``*step*`` call inside the training loop
+  that issued it: the coercion blocks the host on that step's device
+  completion mid-pipeline, stalling the prefetch window every time it
+  runs. Record the device scalar and resolve it one step later (the
+  lagged-fetch sites in ``train/loop.py`` carry the pragma).
 
 Intentional exceptions are suppressed two ways, both documented in
 docs/static_analysis.md:
@@ -54,7 +60,12 @@ RULES = {
     "JX103": "shard_map used directly; route through parallel/mesh.py's "
              "compat shim",
     "JX104": "mutable default value in a Param declaration",
+    "JX105": "blocking scalar fetch on a step output inside the step loop; "
+             "record the device scalar and resolve it one step later",
 }
+
+# the callee-name hint marking a train-step call whose outputs JX105 tracks
+_STEP_HINT = "step"
 
 _JIT_NAMES = {"jit", "pjit"}
 _NUMPY_ALIASES = {"np", "numpy", "onp"}
@@ -72,6 +83,15 @@ class Finding:
 
     def __str__(self) -> str:
         return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+def _callee_name(node: ast.AST) -> str | None:
+    """Terminal name of a call target: ``step`` / ``self.step_masked``."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
 
 
 def _is_jit_func(node: ast.AST) -> bool:
@@ -126,9 +146,13 @@ class _Linter(ast.NodeVisitor):
         text = self.lines[line - 1] if 0 < line <= len(self.lines) else ""
         if f"lint-jax: allow({rule})" in text:
             return
-        self.findings.append(Finding(self.path, line, rule, message))
+        finding = Finding(self.path, line, rule, message)
+        # nested loops run the JX105 subtree analysis once per level —
+        # report each site once
+        if finding not in self.findings:
+            self.findings.append(finding)
 
-    # -- JX102 / JX103 / JX104: module-wide --
+    # -- JX102 / JX103 / JX104 / JX105: module-wide --
 
     def visit_For(self, node: ast.For) -> None:
         self._loop_body(node)
@@ -137,9 +161,71 @@ class _Linter(ast.NodeVisitor):
         self._loop_body(node)
 
     def _loop_body(self, node: ast.AST) -> None:
+        self._lint_step_loop(node)
         self.loop_depth += 1
         self.generic_visit(node)
         self.loop_depth -= 1
+
+    # -- JX105: blocking scalar coercion on step outputs in the loop --
+
+    def _lint_step_loop(self, loop: ast.AST) -> None:
+        """Taint names bound from ``*step*(...)`` calls anywhere in this
+        loop's subtree (``state, metrics = self.step_masked(...)``),
+        propagate through plain/subscript aliasing (``pending =
+        metrics["loss"]``), and flag blocking coercions on tainted values
+        inside the loop. Host fetches after the loop drains are fine —
+        only the in-loop sync stalls the pipeline."""
+        tainted: set[str] = set()
+        for node in ast.walk(loop):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            fname = _callee_name(node.value.func)
+            if fname and _STEP_HINT in fname.lower():
+                for target in node.targets:
+                    elts = (target.elts if isinstance(target, ast.Tuple)
+                            else [target])
+                    tainted.update(n.id for n in elts
+                                   if isinstance(n, ast.Name))
+        if not tainted:
+            return
+        changed = True
+        while changed:  # alias fixpoint: pending = metrics["loss"]
+            changed = False
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Assign):
+                    continue
+                src = node.value
+                if isinstance(src, ast.Subscript):
+                    src = src.value
+                if isinstance(src, ast.Name) and src.id in tainted:
+                    for target in node.targets:
+                        if (isinstance(target, ast.Name)
+                                and target.id not in tainted):
+                            tainted.add(target.id)
+                            changed = True
+
+        def tainted_value(expr: ast.AST) -> bool:
+            if isinstance(expr, ast.Subscript):
+                expr = expr.value
+            return isinstance(expr, ast.Name) and expr.id in tainted
+
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if (isinstance(func, ast.Name) and func.id in ("float", "int")
+                    and node.args and tainted_value(node.args[0])):
+                self._emit(node, "JX105",
+                           f"{func.id}() on a step output blocks the host "
+                           "mid-pipeline; " + RULES["JX105"].split("; ")[1])
+            elif (isinstance(func, ast.Attribute)
+                    and func.attr in ("item", "tolist")
+                    and tainted_value(func.value)):
+                self._emit(node, "JX105",
+                           f".{func.attr}() on a step output blocks the "
+                           "host mid-pipeline; "
+                           + RULES["JX105"].split("; ")[1])
 
     def visit_Call(self, node: ast.Call) -> None:
         if _is_jit_func(node.func) and self.loop_depth > 0:

@@ -1,16 +1,26 @@
-"""perf_smoke — fast, CPU-safe check that pipeline fusion actually fuses.
+"""perf_smoke — fast, CPU-safe check that the perf subsystems actually
+engage.
 
-Asserts the planner executes the canonical image pipeline
-(resize → unroll → score) as ONE device segment costing exactly one H2D
-upload and one async D2H fetch round per minibatch, by counting crossings
-through the planner's ``_upload``/``_issue_fetch`` seams
-(:func:`mmlspark_tpu.core.plan.count_crossings`). The same check runs in
-tier-1 as tests/test_perf_smoke.py; this entry point is the
-``BENCH_FAST=1``-style standalone for CI wiring:
+Two gates, both counted at instrumented seams (no timing, so they cannot
+flake on a loaded CI box):
+
+* **pipeline fusion** — the planner executes the canonical image pipeline
+  (resize → unroll → score) as ONE device segment costing exactly one H2D
+  upload and one async D2H fetch round per minibatch, counted through the
+  planner's ``_upload``/``_issue_fetch`` seams
+  (:func:`mmlspark_tpu.core.plan.count_crossings`).
+* **train input prefetch** — on the canonical CIFAR train config the
+  ``DeviceLoader`` (train/input.py) actually commits batches ahead of
+  consumption: ``committed_ahead_max >= prefetch_depth``, every batch
+  flows through exactly once, and the input-wait/step-time decomposition
+  is reported.
+
+The same checks run in tier-1 as tests/test_perf_smoke.py; this entry
+point is the ``BENCH_FAST=1``-style standalone for CI wiring:
 
     JAX_PLATFORMS=cpu python tools/perf_smoke.py
 
-Prints one JSON line and exits non-zero on any fusion regression.
+Prints one JSON line and exits non-zero on any regression.
 """
 
 from __future__ import annotations
@@ -74,13 +84,55 @@ def check_fused_crossings() -> dict:
     }
 
 
+def check_train_prefetch() -> dict:
+    """Canonical CIFAR train config through the prefetching input
+    pipeline; raise AssertionError unless the loader ran ahead."""
+    from mmlspark_tpu.models.zoo import ConvNetCifar
+    from mmlspark_tpu.train.loop import TrainConfig, Trainer
+
+    n, bs, depth = 256, 32, 2
+    rng = np.random.default_rng(0)
+    # uint8 source: ships thin, casts/normalizes inside the jitted step
+    x = rng.integers(0, 255, (n, 32, 32, 3)).astype(np.uint8)
+    y = rng.integers(0, 10, n).astype(np.int64)
+    cfg = TrainConfig(batch_size=bs, epochs=1, optimizer="momentum",
+                      learning_rate=0.01, log_every=2,
+                      prefetch_depth=depth)
+    tr = Trainer(ConvNetCifar(num_classes=10, widths=(8, 16),
+                              dense_width=32), cfg)
+    tr.fit_arrays(x, y)
+
+    stats = tr.input_stats
+    steps = n // bs
+    assert stats is not None and stats["batches"] == steps, (
+        f"expected {steps} batches through the loader, got {stats}")
+    assert stats["committed_ahead_max"] >= depth, (
+        f"loader never ran {depth} batches ahead of consumption "
+        f"(committed_ahead_max={stats['committed_ahead_max']}) — the "
+        "prefetch pipeline is not overlapping input with compute")
+    assert 0.0 <= stats["input_bound_fraction"] <= 1.0
+    assert tr.history and all(np.isfinite(v) for v in tr.history), (
+        f"non-finite training history {tr.history}")
+    return {
+        "steps": steps,
+        "prefetch_depth": depth,
+        "batches": stats["batches"],
+        "committed_ahead_max": stats["committed_ahead_max"],
+        "input_bound_fraction": stats["input_bound_fraction"],
+        "input_wait_s": stats["input_wait_s"],
+        "step_s": stats["step_s"],
+    }
+
+
 def main() -> int:
     try:
         result = check_fused_crossings()
+        train = check_train_prefetch()
     except AssertionError as e:
         print(json.dumps({"perf_smoke": "FAIL", "reason": str(e)}))
         return 1
-    print(json.dumps({"perf_smoke": "OK", **result}))
+    print(json.dumps({"perf_smoke": "OK", **result,
+                      "train_prefetch": train}))
     return 0
 
 
