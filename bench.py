@@ -217,6 +217,64 @@ def bench_flagship_models(rng, n_dev: int, peak: float | None) -> dict:
     return out
 
 
+def bench_serve(jm, rng, n_total: int = 192) -> dict:
+    """Serve-layer A/B: dynamic bucket-ladder batching vs batch-size-1,
+    each at 1/8/64 concurrent requesters over the in-process client.
+
+    Single-row uint8 image requests against the same ConvNet JaxModel the
+    inference metrics use; the model object is shared across all runs so
+    warmup compiles are paid once (the plan cache persists on the stage).
+    """
+    import threading
+
+    from mmlspark_tpu.data.table import DataTable
+    from mmlspark_tpu.serve import Client, ModelServer, ServeConfig
+
+    imgs = rng.integers(0, 255, size=(n_total, 32 * 32 * 3)
+                        ).astype(np.uint8)
+    tables = [DataTable({"image": [imgs[i]]}) for i in range(n_total)]
+    out: dict = {}
+    for label, buckets in (("dynamic", (1, 8, 32, 128)), ("batch1", (1,))):
+        for conc in (1, 8, 64):
+            server = ModelServer(ServeConfig(
+                buckets=buckets, max_queue=n_total + conc,
+                deadline_ms=None))
+            server.add_model("m", jm, example=tables[0])
+            client = Client(server)
+            errors: list[str] = []
+
+            def worker(k: int) -> None:
+                try:
+                    for i in range(k, n_total, conc):
+                        client.predict("m", tables[i], timeout=600)
+                except BaseException as e:  # noqa: BLE001 — reported
+                    errors.append(f"{type(e).__name__}: {e}")
+
+            threads = [threading.Thread(target=worker, args=(k,))
+                       for k in range(conc)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            snap = server.stats("m").snapshot()
+            server.close()
+            key = f"{label}_c{conc}"
+            if errors:
+                out[key] = {"error": errors[0]}
+                continue
+            e2e = snap.get("e2e_ms") or {}
+            out[key] = {
+                "rows_per_s": round(n_total / wall, 1),
+                "p50_ms": e2e.get("p50"),
+                "p99_ms": e2e.get("p99"),
+                "occupancy_mean": snap.get("batch_occupancy_mean"),
+                "batches": snap.get("batches"),
+            }
+    return out
+
+
 def main() -> None:
     import jax
 
@@ -493,6 +551,21 @@ def main() -> None:
     except Exception as e:  # best-effort metric; label failures accurately
         train_ab = {"error": f"{type(e).__name__}: {e}"}
 
+    # online serving (round 8): the dynamic-batching model server through
+    # the in-process client at 1/8/64 concurrent requesters, A/B dynamic
+    # batching (the bucket ladder) vs batch-size-1 (buckets=(1,): every
+    # request its own dispatch). rows/s is wall-clock completion rate,
+    # p99 the per-request end-to-end latency from ServerStats — under
+    # concurrency the ladder converts queue depth into batch occupancy
+    # instead of a serialized dispatch train
+    serve_ab: dict | None = None
+    try:
+        if jm is None:
+            raise RuntimeError("inference setup failed, serve skipped")
+        serve_ab = bench_serve(jm, rng)
+    except Exception as e:  # best-effort metric; label failures accurately
+        serve_ab = {"error": f"{type(e).__name__}: {e}"}
+
     # BASELINE configs 3-5 (flagship models); skip with BENCH_FAST=1
     import os
     extra: dict = {}
@@ -521,6 +594,11 @@ def main() -> None:
         "train_input_bound_fraction": (train_ab or {}).get(
             "prefetch", {}).get("input_bound_fraction"),
         "train_input_ab": train_ab,
+        "serve_rows_per_s": (serve_ab or {}).get(
+            "dynamic_c8", {}).get("rows_per_s"),
+        "serve_p99_ms": (serve_ab or {}).get(
+            "dynamic_c8", {}).get("p99_ms"),
+        "serve_ab": serve_ab,
         "tunnel_upload_mb_s": tunnel_mb_s,
         "mxu_matmul_tf_s": mxu_tf_s,
         "fetch_rtt_ms": rtt_ms,

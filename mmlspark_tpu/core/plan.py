@@ -89,6 +89,10 @@ class CrossingCounter:
         self.upload_bytes = 0   # total H2D payload — fusion ships the
         #                         thinnest (entry) form, e.g. uint8 pixels
         #                         instead of f32 features
+        self.upload_shapes: set = set()  # distinct batch shapes entering the
+        #                         device — for a fixed program each new shape
+        #                         is one XLA compile, so this set is the
+        #                         recompile observable (serve's bucket gate)
 
 
 @contextlib.contextmanager
@@ -105,6 +109,9 @@ def count_crossings():
     def counting_upload(chunk, target):
         counter.uploads += 1
         counter.upload_bytes += int(getattr(chunk, "nbytes", 0))
+        shape = getattr(chunk, "shape", None)
+        if shape is not None:
+            counter.upload_shapes.add(tuple(shape))
         return orig_upload(chunk, target)
 
     def counting_fetch(outs):
@@ -116,6 +123,55 @@ def count_crossings():
         yield counter
     finally:
         _upload, _issue_fetch = orig_upload, orig_fetch
+
+
+def _windowed_dispatch(fn: Callable, dev_params: Any, batch: np.ndarray,
+                       size: int, target: Any, max_inflight: int
+                       ) -> tuple[list, list, Callable[[], None]]:
+    """The ONE definition of the upload → call → async-fetch → bounded-
+    window discipline, shared by batch execution
+    (:func:`pipeline_minibatches`) and the serving dispatch entry
+    (:func:`dispatch_segment`). Dispatches every minibatch, draining
+    device-resident outputs to ``max_inflight`` as it goes; returns
+    ``(pieces, shapes, drain_rest)`` where ``pieces`` accumulates one
+    ``[trimmed host array per output]`` list per drained chunk (in chunk
+    order), ``shapes`` is the observed upload shapes, and ``drain_rest()``
+    blocks until the window is empty — callers choose when to pay it."""
+    window: deque = deque()
+    pieces: list[list[np.ndarray]] = []
+    shapes: list[tuple] = []
+    inflight = max(2, int(max_inflight))
+
+    def drain_one() -> None:
+        outs, valid = window.popleft()
+        pieces.append([np.asarray(o)[:valid] for o in outs])
+
+    for chunk, valid in minibatches(batch, size):
+        shapes.append(tuple(chunk.shape))
+        outs = fn(dev_params, _upload(chunk, target))
+        if not isinstance(outs, tuple):
+            outs = (outs,)
+        _issue_fetch(outs)
+        window.append((outs, valid))
+        # drain to inflight-1 so at most max_inflight minibatch outputs are
+        # ever device-resident (the documented HBM bound)
+        while len(window) >= inflight:
+            drain_one()
+
+    def drain_rest() -> None:
+        while window:
+            drain_one()
+
+    return pieces, shapes, drain_rest
+
+
+def _assemble_outputs(pieces: list) -> list[np.ndarray]:
+    """Per-chunk ``pieces`` → one concatenated host array per output."""
+    if not pieces:
+        return []
+    return [np.concatenate([p[k] for p in pieces])
+            if len(pieces) > 1 else pieces[0][k]
+            for k in range(len(pieces[0]))]
 
 
 def pipeline_minibatches(fn: Callable, dev_params: Any, batch: np.ndarray,
@@ -132,30 +188,10 @@ def pipeline_minibatches(fn: Callable, dev_params: Any, batch: np.ndarray,
     every column its stages write). Returns one trimmed, concatenated host
     array per output.
     """
-    window: deque = deque()
-    parts: list[list[np.ndarray]] | None = None
-    inflight = max(2, int(max_inflight))
-
-    def drain_one() -> None:
-        outs, valid = window.popleft()
-        for k, o in enumerate(outs):
-            parts[k].append(np.asarray(o)[:valid])
-
-    for chunk, valid in minibatches(batch, size):
-        outs = fn(dev_params, _upload(chunk, target))
-        if not isinstance(outs, tuple):
-            outs = (outs,)
-        if parts is None:
-            parts = [[] for _ in outs]
-        _issue_fetch(outs)
-        window.append((outs, valid))
-        # drain to inflight-1 so at most max_inflight minibatch outputs are
-        # ever device-resident (the documented HBM bound)
-        while len(window) >= inflight:
-            drain_one()
-    while window:
-        drain_one()
-    return [np.concatenate(p) if len(p) > 1 else p[0] for p in parts or []]
+    pieces, _shapes, drain_rest = _windowed_dispatch(
+        fn, dev_params, batch, size, target, max_inflight)
+    drain_rest()
+    return _assemble_outputs(pieces)
 
 
 # ---- segment entry: host column → one stacked device-ready array ----
@@ -275,13 +311,20 @@ class _Segment:
 
 def collect_segment(stages: list, i: int,
                     meta_of: Callable[[str], ArrayMeta | None],
-                    explain: list | None = None) -> _Segment | None:
+                    explain: list | None = None,
+                    min_stages: int = 2) -> _Segment | None:
     """Root a maximal device segment at ``stages[i]``, resolving the entry
     column's layout through ``meta_of`` (a concrete-table probe at execution
     time; an abstract :class:`~mmlspark_tpu.analysis.info.TableSchema`
     lookup when the pre-flight analyzer replays this exact logic with no
     data). ``explain``, when given, collects human-readable reasons the
-    segment broke or never formed — the device-plan audit's trace."""
+    segment broke or never formed — the device-plan audit's trace.
+
+    ``min_stages`` defaults to 2 (a lone device stage keeps its own
+    already-optimized ``transform`` path in batch execution); the serving
+    entry (:func:`dispatch_segment` via :func:`transform_async`) passes 1,
+    because there the win is the *asynchronous single-batch dispatch*, which
+    a lone model stage benefits from just as much as a fused run."""
 
     def note(msg: str) -> None:
         if explain is not None:
@@ -340,7 +383,7 @@ def collect_segment(stages: list, i: int,
         emitters[out_col] = j - i
         out_metas[out_col] = op.out_meta
         j += 1
-    if len(seg_stages) < 2:
+    if len(seg_stages) < max(1, int(min_stages)):
         if len(seg_stages) == 1:
             note(f"stage {i} ({type(s0).__name__}) is a lone device stage "
                  "(a segment needs >= 2): it keeps its own transform path")
@@ -489,6 +532,34 @@ def predict_segment_minibatches(seg: _Segment, n_rows: int) -> int:
 _PLAN_CACHE_MAX = 8
 
 
+def _cached_segment(seg: _Segment, cache_host: Any) -> tuple:
+    """(jitted composite, device params, target, dp) for ``seg``, through
+    ``cache_host``'s LRU-capped compiled-segment cache when one is given.
+    Shared by batch execution (:func:`_run_segment`) and the serving
+    dispatch entry (:func:`dispatch_segment`), so an online server and
+    offline ``transform`` calls on the same model reuse ONE jitted
+    composite and one device-resident param upload."""
+    if cache_host is None:
+        return _compile_segment(seg)
+    key = (tuple(id(s) for s in seg.stages), seg.entry_col, seg.entry_meta)
+    lock = cache_host.__dict__.setdefault("_plan_lock", threading.Lock())
+    with lock:
+        store = cache_host.__dict__.setdefault("_plan_cache", {})
+        entry = store.get(key)
+        tokens = _segment_tokens(seg)
+        if entry is not None and entry[0] != tokens:
+            entry = None  # stage config changed: recompile
+        if entry is None:
+            # pin the stage objects so id() keys cannot be reused
+            entry = (tokens, _compile_segment(seg), tuple(seg.stages))
+        else:
+            del store[key]  # re-insert: LRU order = insertion order
+        store[key] = entry
+        while len(store) > _PLAN_CACHE_MAX:
+            store.pop(next(iter(store)))
+    return entry[1]
+
+
 def _run_segment(seg: _Segment, table: DataTable,
                  cache_host: Any) -> DataTable | None:
     """Execute a fused segment; None if entry coercion fails (host path)."""
@@ -497,27 +568,7 @@ def _run_segment(seg: _Segment, table: DataTable,
         return None
     batch, ctx = coerced
     size, max_inflight = _segment_minibatch(seg)
-
-    key = (tuple(id(s) for s in seg.stages), seg.entry_col, seg.entry_meta)
-    if cache_host is not None:
-        lock = cache_host.__dict__.setdefault("_plan_lock", threading.Lock())
-        with lock:
-            store = cache_host.__dict__.setdefault("_plan_cache", {})
-            entry = store.get(key)
-            tokens = _segment_tokens(seg)
-            if entry is not None and entry[0] != tokens:
-                entry = None  # stage config changed: recompile
-            if entry is None:
-                # pin the stage objects so id() keys cannot be reused
-                entry = (tokens, _compile_segment(seg), tuple(seg.stages))
-            else:
-                del store[key]  # re-insert: LRU order = insertion order
-            store[key] = entry
-            while len(store) > _PLAN_CACHE_MAX:
-                store.pop(next(iter(store)))
-        fn, dev_params, target, dp = entry[1]
-    else:
-        fn, dev_params, target, dp = _compile_segment(seg)
+    fn, dev_params, target, dp = _cached_segment(seg, cache_host)
 
     # minibatch must divide over the data axes (shared sizing helper)
     size = dp_rounded_minibatch(size, dp, len(batch))
@@ -530,6 +581,121 @@ def _run_segment(seg: _Segment, table: DataTable,
         emitter = seg.stages[seg.emitters[col]]
         table = emitter.device_emit(table, values, seg.out_metas[col], ctx)
     return table
+
+
+# ---- single-batch serving entry (the online model server's dispatch) ----
+
+class PendingTable:
+    """Handle for an asynchronously dispatched transform.
+
+    ``result()`` blocks on the device→host fetch, emits the output columns,
+    and returns the finished :class:`DataTable`; it is idempotent. A
+    PendingTable built from an already-materialized table (the host
+    fallback) returns immediately. ``shapes`` holds the batch shapes
+    actually uploaded to the device (empty for the host path) — the
+    *observed* recompile surface serving stats report, as opposed to the
+    caller's intended bucket. Single-consumer: the serve batcher's
+    in-flight window owns each handle."""
+
+    __slots__ = ("_table", "_finish", "shapes")
+
+    def __init__(self, table: DataTable | None = None,
+                 finish: Callable[[], DataTable] | None = None,
+                 shapes: tuple = ()):
+        self._table = table
+        self._finish = finish
+        self.shapes = tuple(shapes)
+
+    @property
+    def dispatched(self) -> bool:
+        """True while device work is still outstanding."""
+        return self._finish is not None
+
+    def result(self) -> DataTable:
+        if self._finish is not None:
+            self._table = self._finish()
+            self._finish = None
+        return self._table
+
+
+def dispatch_segment(seg: _Segment, table: DataTable,
+                     cache_host: Any
+                     ) -> tuple[Callable[[], DataTable], tuple] | None:
+    """Asynchronously dispatch ``seg`` over one packed (bucket-quantized)
+    batch; returns ``(finish, observed upload shapes)``.
+
+    The single-batch segment entry behind the online server. A batch at or
+    below the stages' minibatch bound — the common case, since bucket
+    ladders are sized to fit — is ONE minibatch: one H2D upload, one
+    program call, one async D2H fetch round, and the call returns as soon
+    as the device work is *issued* (JAX async dispatch +
+    ``copy_to_host_async``), so the serve batcher can pack batch i+1 while
+    the device computes batch i. A batch larger than the stages' declared
+    ``minibatch_size`` (a memory bound — see :func:`_segment_minibatch`)
+    is chunked at that bound with the usual ``max_inflight`` window, so
+    serving can never exceed the HBM envelope batch execution honors.
+    Because chunk sizes derive only from (bucket, bound, dp), compiled
+    shapes stay bounded by the bucket ladder. Returns a ``finish()`` that
+    blocks, trims the padding, and emits the output columns; ``None`` when
+    entry coercion declines (host path)."""
+    coerced = _coerce_entry(table, seg.entry_col, seg.entry_meta)
+    if coerced is None:
+        return None
+    batch, ctx = coerced
+    fn, dev_params, target, dp = _cached_segment(seg, cache_host)
+    bound, max_inflight = _segment_minibatch(seg)
+    size = dp_rounded_minibatch(min(bound, len(batch)), dp, len(batch))
+    pieces, shapes, drain_rest = _windowed_dispatch(
+        fn, dev_params, batch, size, target, max_inflight)
+
+    def finish() -> DataTable:
+        drain_rest()
+        host = _assemble_outputs(pieces)
+        out = table
+        for k, col in enumerate(seg.out_cols):
+            emitter = seg.stages[seg.emitters[col]]
+            out = emitter.device_emit(out, host[k], seg.out_metas[col],
+                                      ctx)
+        return out
+
+    return finish, tuple(shapes)
+
+
+def transform_async(stages: list, table: DataTable,
+                    cache_host: Any = None) -> PendingTable:
+    """Run a fitted-transformer list over one packed batch, dispatching the
+    *trailing* device segment asynchronously (the serving execution engine).
+
+    Semantics match :func:`execute_stages` exactly — same planning, same
+    fallback rules, same compiled-segment cache — except that when the
+    stage list *ends* in a device-capable segment (of any length ≥ 1,
+    including a lone model stage), that segment is dispatched via
+    :func:`dispatch_segment` and the returned :class:`PendingTable` is
+    still in flight: host packing of the next batch overlaps this batch's
+    device compute, and ``result()`` performs the blocking fetch."""
+    stages = list(stages)
+    i = 0
+    while i < len(stages):
+        seg = None
+        if len(table):
+            seg = collect_segment(stages, i,
+                                  lambda col: _entry_meta(table, col),
+                                  min_stages=1)
+        if seg is not None:
+            if seg.end == len(stages):
+                dispatched = dispatch_segment(seg, table, cache_host)
+                if dispatched is not None:
+                    finish, shapes = dispatched
+                    return PendingTable(finish=finish, shapes=shapes)
+            elif len(seg.stages) >= 2:
+                fused = _run_segment(seg, table, cache_host)
+                if fused is not None:
+                    table = fused
+                    i = seg.end
+                    continue
+        table = stages[i].transform(table)
+        i += 1
+    return PendingTable(table=table)
 
 
 def execute_stages(stages: list, table: DataTable,

@@ -11,11 +11,13 @@ environment has no egress; HTTP stays supported for real deployments).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import hashlib
 import json
 import os
 import shutil
+import threading
 from typing import Any, Iterable
 
 from mmlspark_tpu.core import config
@@ -63,6 +65,39 @@ def _sha256_file(path: str) -> str:
     for chunk in _fs.iter_chunks(path):
         h.update(chunk)
     return h.hexdigest()
+
+
+@contextlib.contextmanager
+def cache_entry_lock(path: str):
+    """Exclusive lock on one cache entry, across threads AND processes.
+
+    Two server workers loading the same model used to race
+    ``ModelDownloader.download``: both fetched into the same ``dest`` and
+    a reader could observe (and hash-record) a half-written file. The lock
+    file is ``<dest>.lock``; each acquisition opens its own descriptor, so
+    ``fcntl.flock`` excludes sibling threads as well as other processes.
+    Where fcntl is unavailable (non-POSIX), degrades to a process-local
+    mutex — atomic-rename publication below still keeps partially written
+    files invisible cross-process.
+    """
+    lock_path = path + ".lock"
+    local = _LOCAL_LOCKS.setdefault(lock_path, threading.Lock())
+    with local:
+        try:
+            import fcntl
+        except ImportError:  # pragma: no cover - non-POSIX fallback
+            yield
+            return
+        os.makedirs(os.path.dirname(lock_path) or ".", exist_ok=True)
+        with open(lock_path, "w") as fh:
+            fcntl.flock(fh, fcntl.LOCK_EX)
+            try:
+                yield
+            finally:
+                fcntl.flock(fh, fcntl.LOCK_UN)
+
+
+_LOCAL_LOCKS: dict[str, threading.Lock] = {}
 
 
 class Repository:
@@ -136,7 +171,20 @@ class ModelDownloader:
                        f"({self.repo.root})")
 
     def download(self, schema: ModelSchema) -> str:
+        """Fetch (or reuse) one model, concurrency-safe.
+
+        The whole check-fetch-verify-publish sequence holds the cache
+        entry's file lock, so two workers loading the same model serialize
+        (the second observes the first's verified file and returns
+        immediately); the fetch lands in a private temp file and is
+        published with ``os.replace``, so no reader — locked or not — can
+        ever observe a partially written cache entry.
+        """
         dest = self._cache_path(schema)
+        with cache_entry_lock(dest):
+            return self._download_locked(schema, dest)
+
+    def _download_locked(self, schema: ModelSchema, dest: str) -> str:
         sidecar = dest + ".sha256"
         if os.path.exists(dest):
             if schema.hash:
@@ -155,13 +203,18 @@ class ModelDownloader:
             _log.warning("cached model %s failed hash check; refetching",
                          schema.name)
             os.remove(dest)
-        self.repo.fetch(schema, dest)
-        actual = _sha256_file(dest)
-        if schema.hash and actual != schema.hash:
-            os.remove(dest)
-            raise IOError(
-                f"model {schema.name!r}: sha256 mismatch "
-                f"(manifest {schema.hash[:12]}…, got {actual[:12]}…)")
+        tmp = f"{dest}.tmp-{os.getpid()}-{threading.get_ident()}"
+        try:
+            self.repo.fetch(schema, tmp)
+            actual = _sha256_file(tmp)
+            if schema.hash and actual != schema.hash:
+                raise IOError(
+                    f"model {schema.name!r}: sha256 mismatch "
+                    f"(manifest {schema.hash[:12]}…, got {actual[:12]}…)")
+            os.replace(tmp, dest)  # atomic publication of the verified file
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
         with open(sidecar, "w") as f:
             f.write(actual)
         return dest

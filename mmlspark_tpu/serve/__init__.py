@@ -1,0 +1,46 @@
+"""Online serving subsystem — dynamic-batching model server.
+
+The reference's endgame was turning fitted pipelines into request/response
+services (`spark-serving`'s HTTP sources/sinks); everything before this
+package was offline batch. The TPU-native constraint an online server must
+design around is that **every distinct input shape is a recompile**, so the
+dynamic batcher quantizes request coalescing to a fixed bucket ladder and
+compiles exactly one program per (model, bucket) — see docs/serving.md.
+
+* :class:`ModelServer` — loads saved ``PipelineModel``s / ``ModelBundle``s,
+  validates each with the pre-flight analyzer at load time, and executes
+  requests through the fused device plan (``core.plan.transform_async``).
+* :class:`DynamicBatcher` — per-model bounded queue + coalescing dispatch
+  loop with admission control, deadlines, and graceful drain.
+* :class:`Client` — in-process client (deterministic tests, the bench).
+* :mod:`mmlspark_tpu.serve.http` — stdlib-only HTTP front end (JSON +
+  Arrow bodies); ``tools/serve.py`` is the CLI.
+"""
+
+from mmlspark_tpu.serve.config import ServeConfig  # noqa: F401
+from mmlspark_tpu.serve.errors import (  # noqa: F401
+    BadRequest, DeadlineExceeded, ModelLoadError, ModelNotFound,
+    Overloaded, ServeError, ServerClosed,
+)
+from mmlspark_tpu.serve.batcher import (  # noqa: F401
+    DynamicBatcher, ServeRequest, THREAD_PREFIX,
+)
+from mmlspark_tpu.serve.server import Client, ModelServer  # noqa: F401
+from mmlspark_tpu.serve.stats import ServerStats  # noqa: F401
+
+__all__ = [
+    "BadRequest",
+    "Client",
+    "DeadlineExceeded",
+    "DynamicBatcher",
+    "ModelLoadError",
+    "ModelNotFound",
+    "ModelServer",
+    "Overloaded",
+    "ServeConfig",
+    "ServeError",
+    "ServeRequest",
+    "ServerClosed",
+    "ServerStats",
+    "THREAD_PREFIX",
+]

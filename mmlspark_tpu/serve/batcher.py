@@ -1,0 +1,451 @@
+"""DynamicBatcher — per-model coalescing dispatch loop.
+
+Clipper-style adaptive batching over a compiled batch engine, with the TPU
+constraint driving the design: every distinct input shape is a recompile,
+so coalesced requests are packed into padded batches drawn from a fixed
+bucket ladder (``ServeConfig.buckets``) and the server compiles exactly one
+program per (model, bucket).
+
+The loop's discipline mirrors ``train/input.py``'s overlapped pipeline,
+inverted to the serving direction:
+
+* **admission** is a bounded FIFO — a full queue rejects with the typed
+  :class:`~mmlspark_tpu.serve.errors.Overloaded` (backpressure, not an
+  unbounded latency cliff), and requests whose deadline expires while
+  queued are cancelled *before dispatch*;
+* **packing** takes whole requests in FIFO order up to the largest bucket
+  and pads to the smallest bucket that fits (a request is never split, so
+  a timeout can never observe a partial result);
+* **dispatch** goes through ``core.plan.transform_async`` — one H2D
+  upload, one fused program call, one async D2H fetch round — and returns
+  while the device still computes, so host packing of batch *i+1* overlaps
+  device compute of batch *i*; the bounded in-flight window
+  (``max_inflight``) is where completed batches are drained and their
+  requests resolved;
+* **shutdown** (``close(drain=True)``) stops admission, answers every
+  already-admitted request, then joins the worker — no leaked thread.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any
+
+import numpy as np
+
+from mmlspark_tpu.core.logging_utils import get_logger
+from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.serve.config import ServeConfig
+from mmlspark_tpu.serve.errors import (
+    BadRequest, DeadlineExceeded, Overloaded, ServerClosed,
+)
+from mmlspark_tpu.serve.stats import ServerStats
+
+_log = get_logger(__name__)
+
+THREAD_PREFIX = "ServeBatcher"
+
+# request states — transitions are guarded by the request's own lock
+_QUEUED, _DISPATCHED, _DONE, _TIMED_OUT = range(4)
+
+
+def _cell_sig(cell: Any) -> tuple:
+    if isinstance(cell, dict) and "data" in cell:
+        d = np.asarray(cell["data"])
+        return ("image", d.shape, str(d.dtype))
+    if isinstance(cell, np.ndarray):
+        return ("array", cell.shape, str(cell.dtype))
+    if isinstance(cell, (list, tuple)):
+        return ("seq", len(cell))
+    return ("cell", type(cell).__name__)
+
+
+def _compat_key(table: DataTable) -> tuple:
+    """Batch-compatibility fingerprint: column names plus the (uniform)
+    per-cell layout of EVERY row. Requests only coalesce when keys match,
+    so a wrong-shape request (same column names, different per-row
+    layout) is dispatched alone and fails alone — it can never take a
+    batch of well-formed neighbors down with it. A request whose own rows
+    are ragged gets a key unique to itself, for the same reason.
+    O(rows × cols) on cheap signatures; requests are bucket-sized."""
+    parts = []
+    for name in sorted(table.columns):
+        col = table[name]
+        if col.dtype != object:
+            parts.append((name, ("np", str(col.dtype))))
+            continue
+        sig = _cell_sig(col[0]) if len(col) else ("empty",)
+        for cell in col[1:]:
+            if _cell_sig(cell) != sig:
+                # internally ragged: never packable with anything
+                return ("nonuniform", id(table))
+        parts.append((name, sig))
+    return tuple(parts)
+
+
+class ServeRequest:
+    """Handle for one admitted request; wait with :meth:`result`.
+
+    Resolution is atomic per request: a request either gets its complete
+    output table, or exactly one typed error — a deadline expiry can never
+    observe a partial result, and a result arriving after the caller gave
+    up is discarded.
+    """
+
+    __slots__ = ("model", "table", "n_rows", "deadline_ms", "_deadline",
+                 "_submitted", "_dispatched_at", "_resolved_at", "_state",
+                 "_lock", "_event", "_result", "_error", "_stats",
+                 "_compat")
+
+    def __init__(self, model: str, table: DataTable,
+                 deadline_ms: float | None, stats: ServerStats):
+        self.model = model
+        self.table = table
+        self.n_rows = len(table)
+        self._compat = _compat_key(table)
+        self.deadline_ms = deadline_ms
+        now = time.monotonic()
+        self._submitted = now
+        self._deadline = (None if deadline_ms is None
+                          else now + deadline_ms / 1e3)
+        self._dispatched_at: float | None = None
+        self._resolved_at: float | None = None
+        self._state = _QUEUED
+        self._lock = threading.Lock()
+        self._event = threading.Event()
+        self._result: DataTable | None = None
+        self._error: BaseException | None = None
+        self._stats = stats
+
+    # -- batcher side --
+
+    def _mark_dispatched(self, now: float) -> None:
+        with self._lock:
+            if self._state == _QUEUED:
+                self._state = _DISPATCHED
+                self._dispatched_at = now
+
+    def _resolve(self, table: DataTable) -> bool:
+        """Deliver the result; False when the caller already gave up (the
+        late result is discarded — never a partial/stale delivery)."""
+        with self._lock:
+            if self._state == _TIMED_OUT:
+                return False
+            self._state = _DONE
+            self._result = table
+            self._resolved_at = time.monotonic()
+        self._event.set()
+        return True
+
+    def _fail(self, error: BaseException) -> bool:
+        with self._lock:
+            if self._state == _TIMED_OUT:
+                return False
+            self._state = _DONE
+            self._error = error
+            self._resolved_at = time.monotonic()
+        self._event.set()
+        return True
+
+    # -- caller side --
+
+    @property
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: float | None = None) -> DataTable:
+        """Block until resolution; raises the request's typed error.
+
+        The effective wait is the sooner of ``timeout`` and the request's
+        own deadline. On expiry the request is atomically marked timed out
+        (any later resolution is discarded) and
+        :class:`DeadlineExceeded` is raised — never a partial result.
+        Giving up is terminal: a repeat call re-raises the same error
+        immediately (it can never block or hand back a discarded result).
+        """
+        with self._lock:
+            if self._state == _TIMED_OUT:
+                raise self._error
+        waits = [t for t in (timeout, None if self._deadline is None
+                             else self._deadline - time.monotonic())
+                 if t is not None]
+        ok = self._event.wait(min(waits) if waits else None)
+        with self._lock:
+            if self._state == _DONE:
+                if self._error is not None:
+                    raise self._error
+                return self._result
+            # not resolved in time: give up atomically and terminally
+            self._state = _TIMED_OUT
+            if not ok and timeout is not None and (
+                    self._deadline is None
+                    or time.monotonic() < self._deadline):
+                self._error = TimeoutError(
+                    f"model {self.model!r}: no result within {timeout}s "
+                    "(request deadline not yet reached)")
+            else:
+                self._error = DeadlineExceeded(
+                    self.model, self.deadline_ms or 0.0,
+                    "queued" if self._dispatched_at is None
+                    else "in-flight")
+            err = self._error
+        self._stats.record_timeout()  # once: the transition, not retries
+        raise err
+
+
+class DynamicBatcher:
+    """Bounded request queue + coalescing dispatch loop for ONE model."""
+
+    def __init__(self, name: str, stages: list, cache_host: Any,
+                 config: ServeConfig, stats: ServerStats | None = None):
+        self.name = name
+        self.stages = list(stages)
+        self.cache_host = cache_host
+        self.config = config
+        self.stats = stats or ServerStats(config.stats_window)
+        self._cv = threading.Condition()
+        self._queue: deque[ServeRequest] = deque()
+        self._closed = False     # admission stopped (drain in progress)
+        self._abort = False      # fail queued work instead of draining
+        self._thread = threading.Thread(
+            target=self._run, name=f"{THREAD_PREFIX}[{name}]", daemon=True)
+        self._thread.start()
+
+    # -- admission --
+
+    def submit(self, table: DataTable,
+               deadline_ms: float | None = None) -> ServeRequest:
+        """Admit one request (whole table = one atomic unit of work)."""
+        n = len(table)
+        if n == 0:
+            raise BadRequest(f"model {self.name!r}: empty request")
+        if n > self.config.max_bucket:
+            self.config.bucket_for(n, self.name)  # raises BadRequest
+        req = ServeRequest(self.name, table, deadline_ms, self.stats)
+        with self._cv:
+            if self._closed:
+                raise ServerClosed(
+                    f"model {self.name!r} is shutting down")
+            if len(self._queue) >= self.config.max_queue:
+                self.stats.record_rejected()
+                raise Overloaded(self.name, len(self._queue),
+                                 self.config.max_queue)
+            self._queue.append(req)
+            self.stats.record_admitted()
+            self._cv.notify()
+        return req
+
+    @property
+    def queued(self) -> int:
+        with self._cv:
+            return len(self._queue)
+
+    # -- the dispatch loop --
+
+    def _collect(self, now: float) -> tuple[list, list, int]:
+        """Pop expired requests plus the next packable FIFO run (whole
+        requests, total rows ≤ the largest bucket)."""
+        batch: list[ServeRequest] = []
+        expired: list[ServeRequest] = []
+        rows = 0
+        with self._cv:
+            while self._queue:
+                r = self._queue[0]
+                if r._deadline is not None and now >= r._deadline:
+                    self._queue.popleft()
+                    expired.append(r)
+                    continue
+                if batch and rows + r.n_rows > self.config.max_bucket:
+                    break
+                # only layout-compatible requests share a batch (same
+                # columns AND same per-row cell layout): a mis-shaped
+                # request must fail alone, not take the whole coalesced
+                # batch down with it
+                if batch and r._compat != batch[0]._compat:
+                    break
+                self._queue.popleft()
+                batch.append(r)
+                rows += r.n_rows
+        return batch, expired, rows
+
+    def _pack(self, batch: list, rows: int) -> tuple[DataTable, int]:
+        """Concatenate the requests' rows (one multi-way pass — pairwise
+        ``concat`` would re-copy the accumulated columns per request,
+        O(k²) on the hot packing path that is supposed to overlap device
+        compute) and pad to the bucket size by repeating the last row
+        (always coercible; trimmed on emit)."""
+        bucket = self.config.bucket_for(rows, self.name)
+        first = batch[0].table
+        if len(batch) == 1 and bucket == rows:
+            return first, bucket
+        pad = bucket - rows
+        cols: dict[str, np.ndarray] = {}
+        for name in first.columns:
+            parts = [r.table[name] for r in batch]
+            if any(p.dtype == object for p in parts):
+                merged = np.empty(bucket, dtype=object)
+                offset = 0
+                for p in parts:
+                    merged[offset:offset + len(p)] = p
+                    offset += len(p)
+                # repeat the last cell by reference: padding is read-only
+                # and trimmed before emit (per-slot assignment — a slice
+                # assign would broadcast an ndarray cell elementwise)
+                last_cell = parts[-1][-1]
+                for k in range(offset, bucket):
+                    merged[k] = last_cell
+                cols[name] = merged
+            else:
+                if pad:
+                    parts.append(np.repeat(parts[-1][-1:], pad, axis=0))
+                cols[name] = np.concatenate(parts)
+        return DataTable(cols, dict(first.meta)), bucket
+
+    def _dispatch(self, batch: list, rows: int, window: deque) -> None:
+        from mmlspark_tpu.core import plan
+        now = time.monotonic()
+        packed, bucket = self._pack(batch, rows)
+        for r in batch:
+            r._mark_dispatched(now)
+        pending = plan.transform_async(self.stages, packed, self.cache_host)
+        window.append((pending, batch, rows, bucket, now))
+
+    def _drain_one(self, window: deque) -> None:
+        pending, batch, rows, bucket, t0 = window.popleft()
+        try:
+            out = pending.result()
+        except BaseException as e:  # noqa: BLE001 — relayed per request
+            _log.warning("ServeBatcher[%s]: batch of %d failed: %s",
+                         self.name, rows, e)
+            for r in batch:
+                if r._fail(e):
+                    self.stats.record_failed()
+            return
+        done = time.monotonic()
+        # pending.shapes is what the device actually saw (one entry per
+        # uploaded chunk) — if bucket quantization ever regresses, the
+        # distinct-shape count grows past the ladder and the perf gate
+        # trips; a host-path dispatch contributes no shapes
+        self.stats.record_batch(bucket, rows, (done - t0) * 1e3,
+                                pending.shapes)
+        if len(out) != bucket:
+            # a row-count-changing stage breaks the per-request split:
+            # offsets would shift and neighbors would silently receive
+            # each other's rows. Fail the WHOLE batch — wrong-but-
+            # plausible results are worse than a typed error
+            err = BadRequest(
+                f"model {self.name!r}: transform changed the row count "
+                f"({bucket} in, {len(out)} out) — row-preserving models "
+                "only; per-request results cannot be attributed")
+            for r in batch:
+                if r._fail(err):
+                    self.stats.record_failed()
+            return
+        offset = 0
+        for r in batch:
+            piece = out.take(np.arange(offset, offset + r.n_rows))
+            offset += r.n_rows
+            if r._resolve(piece):
+                self.stats.record_done(
+                    (done - r._submitted) * 1e3,
+                    ((r._dispatched_at or done) - r._submitted) * 1e3)
+
+    def _run(self) -> None:
+        window: deque = deque()
+        while not self._abort:
+            batch, expired, rows = self._collect(time.monotonic())
+            for r in expired:
+                if r._fail(DeadlineExceeded(self.name,
+                                            r.deadline_ms or 0.0,
+                                            "queued")):
+                    self.stats.record_expired()
+            if batch:
+                try:
+                    self._dispatch(batch, rows, window)
+                except BaseException as e:  # noqa: BLE001 — per-request
+                    for r in batch:
+                        if r._fail(e):
+                            self.stats.record_failed()
+                if len(window) >= self.config.max_inflight:
+                    self._drain_one(window)
+                continue
+            if window:
+                # idle: finish outstanding batches promptly
+                self._drain_one(window)
+                continue
+            with self._cv:
+                if self._queue:
+                    continue  # raced with a submit
+                if self._closed or self._abort:
+                    break
+                # untimed: every path that adds work or shuts down
+                # notifies under this condition (submit, close), and this
+                # wait is only reached with the queue empty — queued-
+                # deadline expiry never needs a timer here because a
+                # non-empty queue never reaches the wait
+                self._cv.wait()
+        # already-dispatched batches complete even on abort (the device
+        # work is in flight; answering it costs only the drain)
+        while window:
+            self._drain_one(window)
+        # abort path: fail whatever the drain never dispatched
+        leftovers: list[ServeRequest] = []
+        with self._cv:
+            leftovers.extend(self._queue)
+            self._queue.clear()
+        for r in leftovers:
+            r._fail(ServerClosed(f"model {self.name!r} closed"))
+
+    # -- warmup --
+
+    def warm(self, padded: DataTable) -> None:
+        """Compile (and cache) the program for this padded batch size by
+        executing it through the SAME dispatch path requests take.
+        Blocking; runs on the loader's thread, not the dispatch loop, and
+        records nothing in the request stats."""
+        from mmlspark_tpu.core import plan
+        plan.transform_async(self.stages, padded, self.cache_host).result()
+
+    # -- lifecycle --
+
+    def close(self, drain: bool = True) -> None:
+        """Stop admission; ``drain=True`` answers every admitted request
+        before the worker exits, ``drain=False`` fails queued requests
+        with :class:`ServerClosed`. Idempotent; joins the worker."""
+        with self._cv:
+            self._closed = True
+            if not drain:
+                self._abort = True
+            self._cv.notify_all()
+        self._thread.join(timeout=self.config.drain_timeout_s)
+        if self._thread.is_alive():  # pragma: no cover - defensive
+            _log.warning("ServeBatcher[%s] did not stop within %.1fs",
+                         self.name, self.config.drain_timeout_s)
+
+    def compiled_programs(self) -> int | None:
+        """XLA executables compiled for this model's serving entry — read
+        from the cached jitted composites' own compile caches (the
+        compile-counter hook the bucket-ladder tests assert against).
+        ``None`` when the jit object doesn't expose its cache size (older
+        jax) — callers fall back to ``stats.dispatch_shapes``."""
+        host_dict = getattr(self.cache_host, "__dict__", {})
+        store = host_dict.get("_plan_cache")
+        if not store:
+            return 0
+        # snapshot under the plan lock: the dispatch thread inserts/evicts
+        # entries concurrently, and iterating a mutating dict raises
+        lock = host_dict.get("_plan_lock")
+        if lock is not None:
+            with lock:
+                entries = list(store.values())
+        else:  # pragma: no cover - cache always created with its lock
+            entries = list(store.values())
+        total = 0
+        for _tokens, compiled, _pinned in entries:
+            size_of = getattr(compiled[0], "_cache_size", None)
+            if size_of is None:
+                return None
+            total += int(size_of())
+        return total

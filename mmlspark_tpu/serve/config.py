@@ -1,0 +1,60 @@
+"""Serving configuration — the bucket ladder and admission bounds."""
+
+from __future__ import annotations
+
+import dataclasses
+
+from mmlspark_tpu.serve.errors import BadRequest
+
+DEFAULT_BUCKETS = (1, 8, 32, 128)
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Knobs of one :class:`~mmlspark_tpu.serve.ModelServer`.
+
+    ``buckets`` is the fixed ladder request batches are padded onto. On
+    TPU every distinct input shape is a fresh XLA compilation, so the
+    batcher never dispatches a raw coalesced size: it packs whole requests
+    up to the largest bucket and pads to the smallest bucket that fits —
+    at most ``len(buckets)`` compiled programs per (model, entry layout).
+    A denser ladder wastes less padding compute per dispatch; a sparser
+    one compiles (and warms) fewer programs. The entry *layout* (per-row
+    shape AND dtype) is part of the program identity: clients that send
+    e.g. uint8 image bytes where warmup used float32 pay one extra
+    compile per bucket on first contact — warm with an ``example`` (or a
+    ``--schema``) matching the production dtype. See docs/serving.md.
+    """
+
+    buckets: tuple = DEFAULT_BUCKETS
+    max_queue: int = 128        # queued requests per model; admission bound
+    deadline_ms: float | None = None  # default per-request deadline
+    max_inflight: int = 2       # dispatched-but-undrained batches (HBM and
+    #                             latency bound on the async window)
+    warmup: bool = True         # compile every bucket at load time
+    stats_window: int = 4096    # per-model latency reservoir bound
+    drain_timeout_s: float = 30.0  # close(drain=True) join bound
+
+    def __post_init__(self):
+        buckets = tuple(sorted({int(b) for b in self.buckets}))
+        if not buckets or buckets[0] < 1:
+            raise ValueError(f"buckets must be positive ints: {self.buckets}")
+        object.__setattr__(self, "buckets", buckets)
+        if self.max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1: {self.max_queue}")
+        if self.max_inflight < 1:
+            raise ValueError(
+                f"max_inflight must be >= 1: {self.max_inflight}")
+
+    @property
+    def max_bucket(self) -> int:
+        return self.buckets[-1]
+
+    def bucket_for(self, rows: int, model: str = "?") -> int:
+        """Smallest bucket admitting ``rows`` rows."""
+        for b in self.buckets:
+            if rows <= b:
+                return b
+        raise BadRequest(
+            f"model {model!r}: request of {rows} rows exceeds the largest "
+            f"bucket {self.max_bucket} (requests are never split)")

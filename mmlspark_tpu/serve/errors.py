@@ -1,0 +1,79 @@
+"""Typed serving errors — the admission/deadline contract surface.
+
+Every rejection a client can see is a distinct type, so callers (and the
+HTTP front end's status mapping) dispatch on type, never on message text.
+"""
+
+from __future__ import annotations
+
+
+class ServeError(Exception):
+    """Base of every serving-layer error."""
+
+
+class Overloaded(ServeError):
+    """Admission rejected: the model's request queue is full.
+
+    Backpressure, not failure — the client should retry with backoff or
+    shed load. Carries the observed depth so callers can log honestly.
+    """
+
+    def __init__(self, model: str, queued: int, max_queue: int):
+        super().__init__(
+            f"model {model!r} overloaded: {queued} requests queued "
+            f"(max_queue={max_queue})")
+        self.model = model
+        self.queued = queued
+        self.max_queue = max_queue
+
+
+class DeadlineExceeded(ServeError):
+    """The request's deadline expired before a result was delivered.
+
+    Raised both for queue-expiry (the batcher cancels the request before
+    dispatch) and for client-side expiry mid-flight; in either case the
+    caller gets ONLY this error, never a partial result.
+    """
+
+    def __init__(self, model: str, deadline_ms: float, where: str):
+        super().__init__(
+            f"model {model!r}: deadline of {deadline_ms:.0f} ms exceeded "
+            f"({where})")
+        self.model = model
+        self.deadline_ms = deadline_ms
+        self.where = where  # "queued" | "in-flight"
+
+
+class BadRequest(ServeError):
+    """Malformed request: empty, larger than the biggest bucket, or
+    column-incompatible with the served model."""
+
+
+class ModelNotFound(ServeError):
+    """No model registered under the requested name."""
+
+    def __init__(self, name: str, available: list[str]):
+        super().__init__(
+            f"no model {name!r}; serving: {sorted(available)}")
+        self.name = name
+        self.available = list(available)
+
+
+class ServerClosed(ServeError):
+    """Submission after shutdown began (new work is rejected during
+    drain)."""
+
+
+class ModelLoadError(ServeError):
+    """The pre-flight analyzer rejected the model at load time.
+
+    Raised before any device work (no compile, no transfer); ``report``
+    is the full :class:`~mmlspark_tpu.analysis.AnalysisReport`.
+    """
+
+    def __init__(self, name: str, report):
+        errors = "\n  ".join(str(d) for d in report.errors)
+        super().__init__(
+            f"model {name!r} failed pre-flight analysis:\n  {errors}")
+        self.name = name
+        self.report = report

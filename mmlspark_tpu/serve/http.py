@@ -1,0 +1,267 @@
+"""Stdlib-only HTTP front end for :class:`~mmlspark_tpu.serve.ModelServer`.
+
+Protocol (details + examples in docs/serving.md):
+
+* ``POST /v1/models/<name>:predict`` — body is either
+
+  - JSON: ``{"rows": [{col: value, ...}, ...], "deadline_ms": 250,
+    "columns": ["scores"]}``; response ``{"model": ..., "rows": [...]}``;
+  - an Arrow IPC stream (``Content-Type:
+    application/vnd.apache.arrow.stream``), marshalled through the same
+    ``DataTable.from_arrow``/``to_arrow`` codec as the Spark offload
+    bridge; the response is an Arrow stream when the ``Accept`` header
+    asks for one, JSON otherwise. Deadline via ``X-Deadline-Ms``.
+
+* ``GET /healthz`` — liveness; ``GET /v1/models`` — the model list;
+  ``GET /v1/stats`` — every model's :class:`ServerStats` snapshot.
+
+Typed serving errors map to status codes: ``Overloaded`` → 429,
+``DeadlineExceeded`` → 504, ``ModelNotFound`` → 404, ``BadRequest`` (and
+malformed bodies) → 400, ``ServerClosed`` → 503.
+
+Each HTTP request blocks its handler thread in ``ModelServer.predict`` —
+the ``ThreadingHTTPServer`` below is exactly the concurrency source the
+dynamic batcher coalesces across.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+import numpy as np
+
+from mmlspark_tpu.core.logging_utils import get_logger
+from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.serve.errors import (
+    BadRequest, DeadlineExceeded, ModelNotFound, Overloaded, ServeError,
+    ServerClosed,
+)
+from mmlspark_tpu.serve.server import ModelServer
+
+_log = get_logger(__name__)
+
+ARROW_CONTENT_TYPE = "application/vnd.apache.arrow.stream"
+
+_STATUS = {
+    Overloaded: 429,
+    DeadlineExceeded: 504,
+    ModelNotFound: 404,
+    BadRequest: 400,
+    ServerClosed: 503,
+}
+
+
+def _json_safe(v: Any) -> Any:
+    """Cell → JSON-representable value (numpy unwrapped, arrays listed)."""
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, dict):
+        return {k: _json_safe(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_json_safe(x) for x in v]
+    if isinstance(v, bytes):
+        return v.hex()
+    if isinstance(v, float) and not np.isfinite(v):
+        return None
+    return v
+
+
+def table_to_json_rows(table: DataTable,
+                       columns: list[str] | None = None) -> list[dict]:
+    names = list(columns) if columns else table.columns
+    return [{k: _json_safe(row[k]) for k in names}
+            for row in table.iter_rows()]
+
+
+def _client_deadline(value: Any, where: str) -> float | None:
+    """Coerce a client-supplied deadline; malformed input is the client's
+    fault (400), never a 500."""
+    if value is None:
+        return None
+    try:
+        return float(value)
+    except (TypeError, ValueError) as e:
+        raise BadRequest(
+            f"malformed deadline in {where}: {value!r} (want a number "
+            "of milliseconds)") from e
+
+
+def _require_pyarrow():
+    try:
+        import pyarrow  # noqa: F401
+        return pyarrow
+    except ImportError as e:
+        raise BadRequest(
+            "Arrow bodies need pyarrow installed on the serving host "
+            "(pip install mmlspark-tpu[arrow])") from e
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "mmlspark-tpu-serve"
+
+    # the ThreadingHTTPServer subclass below carries .model_server
+    @property
+    def _ms(self) -> ModelServer:
+        return self.server.model_server  # type: ignore[attr-defined]
+
+    def log_message(self, fmt: str, *args: Any) -> None:
+        _log.debug("http %s — %s", self.address_string(), fmt % args)
+
+    # -- responses --
+
+    def _send(self, status: int, body: bytes,
+              content_type: str = "application/json") -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, status: int, payload: Any) -> None:
+        self._send(status, json.dumps(payload).encode("utf-8"))
+
+    def _send_error_typed(self, exc: BaseException) -> None:
+        status = 500
+        for etype, code in _STATUS.items():
+            if isinstance(exc, etype):
+                status = code
+                break
+        self._send_json(status, {"error": type(exc).__name__,
+                                 "message": str(exc)})
+
+    # -- routes --
+
+    def do_GET(self) -> None:  # noqa: N802 - http.server contract
+        try:
+            if self.path == "/healthz":
+                self._send_json(200, {"status": "ok",
+                                      "models": self._ms.models()})
+            elif self.path == "/v1/models":
+                self._send_json(200, {"models": self._ms.models()})
+            elif self.path == "/v1/stats":
+                self._send_json(200, self._ms.snapshot())
+            else:
+                self._send_json(404, {"error": "NotFound",
+                                      "message": self.path})
+        except BaseException as e:  # noqa: BLE001 — typed mapping
+            self._send_error_typed(e)
+
+    def do_POST(self) -> None:  # noqa: N802 - http.server contract
+        try:
+            # always consume the body first: responding with unread bytes
+            # on a keep-alive (HTTP/1.1) connection desyncs the stream —
+            # the leftover body would parse as the next request line
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length)
+            if not (self.path.startswith("/v1/models/")
+                    and self.path.endswith(":predict")):
+                self._send_json(404, {"error": "NotFound",
+                                      "message": self.path})
+                return
+            name = self.path[len("/v1/models/"):-len(":predict")]
+            ctype = (self.headers.get("Content-Type") or "").split(";")[0]
+            if ctype == ARROW_CONTENT_TYPE:
+                self._predict_arrow(name, body)
+            else:
+                self._predict_json(name, body)
+        except BaseException as e:  # noqa: BLE001 — typed mapping
+            self._send_error_typed(e)
+
+    # -- predict bodies --
+
+    def _predict_json(self, name: str, body: bytes) -> None:
+        try:
+            payload = json.loads(body or b"{}")
+            rows = payload["rows"]
+        except (ValueError, KeyError, TypeError) as e:
+            raise BadRequest(f"malformed JSON predict body: {e}") from e
+        if not isinstance(rows, list) or not rows:
+            raise BadRequest("predict body needs a non-empty 'rows' list")
+        # list cells become vectors; "dtype" (default float32) picks the
+        # wire dtype so e.g. uint8-warmed image models can be hit without
+        # compiling a second per-bucket program family (entry dtype is
+        # part of the program identity — docs/serving.md)
+        dtype_name = payload.get("dtype") or "float32"
+        try:
+            dtype = np.dtype(dtype_name)
+        except TypeError as e:
+            raise BadRequest(f"unknown dtype {dtype_name!r}") from e
+        try:
+            table = DataTable.from_rows([
+                {k: (np.asarray(v, dtype) if isinstance(v, list) else v)
+                 for k, v in r.items()} for r in rows])
+        except Exception as e:  # client data, not a server fault → 400
+            raise BadRequest(f"uncoercible predict rows: {e}") from e
+        out = self._ms.predict(
+            name, table,
+            deadline_ms=_client_deadline(payload.get("deadline_ms"),
+                                         "'deadline_ms'"))
+        columns = payload.get("columns")
+        if columns:
+            missing = [c for c in columns if c not in out]
+            if missing:
+                raise BadRequest(
+                    f"unknown response columns {missing}; available: "
+                    f"{out.columns}")
+        self._send_json(200, {
+            "model": name,
+            "rows": table_to_json_rows(out, columns),
+        })
+
+    def _predict_arrow(self, name: str, body: bytes) -> None:
+        pa = _require_pyarrow()
+        try:
+            reader = pa.ipc.open_stream(io.BytesIO(body))
+            batches = list(reader)
+        except Exception as e:
+            raise BadRequest(f"malformed Arrow stream: {e}") from e
+        if not batches:
+            raise BadRequest("empty Arrow stream")
+        table = DataTable.from_arrow(batches[0])
+        for rb in batches[1:]:
+            table = table.concat(DataTable.from_arrow(rb))
+        out = self._ms.predict(
+            name, table,
+            deadline_ms=_client_deadline(
+                self.headers.get("X-Deadline-Ms"), "X-Deadline-Ms"))
+        if ARROW_CONTENT_TYPE in (self.headers.get("Accept") or ""):
+            sink = io.BytesIO()
+            arrow_out = out.to_arrow()
+            with pa.ipc.new_stream(sink, arrow_out.schema) as writer:
+                writer.write_table(arrow_out)
+            self._send(200, sink.getvalue(), ARROW_CONTENT_TYPE)
+        else:
+            self._send_json(200, {"model": name,
+                                  "rows": table_to_json_rows(out)})
+
+
+class ServeHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer bound to a :class:`ModelServer`."""
+
+    daemon_threads = True
+
+    def __init__(self, model_server: ModelServer, address: tuple):
+        self.model_server = model_server
+        super().__init__(address, _Handler)
+
+
+def start_http_server(model_server: ModelServer, host: str = "0.0.0.0",
+                      port: int = 8000,
+                      background: bool = True) -> ServeHTTPServer:
+    """Bind and start serving. ``background=True`` runs ``serve_forever``
+    on a daemon thread and returns the bound server (``.server_address``
+    has the ephemeral port when 0 was requested); shut down with
+    ``httpd.shutdown(); httpd.server_close()``."""
+    httpd = ServeHTTPServer(model_server, (host, port))
+    if background:
+        t = threading.Thread(target=httpd.serve_forever,
+                             name="ServeHTTP", daemon=True)
+        t.start()
+    return httpd

@@ -1,0 +1,291 @@
+"""ModelServer — load, validate, warm, and serve fitted models.
+
+Load path: a served model is any fitted table→table transformer
+(``PipelineModel``, ``JaxModel``, …) or a raw :class:`ModelBundle` (wrapped
+in a ``JaxModel`` on the spot). Every load runs the PR 2 pre-flight
+analyzer first — a model that cannot survive ``analysis.analyze`` fails
+the load with :class:`ModelLoadError` *before any device work* (no
+compile, no transfer), mirroring transformSchema-at-submit in the
+reference. Loads with a concrete input schema (given, or derived from the
+bundle's ``input_spec``) also warm the bucket ladder: one compiled program
+per (model, bucket) exists before the first request arrives.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Iterable, Mapping
+
+import numpy as np
+
+from mmlspark_tpu.core.logging_utils import get_logger
+from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.serve.batcher import DynamicBatcher, ServeRequest
+from mmlspark_tpu.serve.config import ServeConfig
+from mmlspark_tpu.serve.errors import (
+    BadRequest, ModelLoadError, ModelNotFound, ServerClosed,
+)
+from mmlspark_tpu.serve.stats import ServerStats
+
+_log = get_logger(__name__)
+
+
+def _as_stages(model: Any) -> tuple[list, Any, Any]:
+    """(stage list, cache_host, model) for any servable object.
+
+    A ``ModelBundle`` is wrapped in a ``JaxModel`` reading column
+    ``"input"`` and writing ``"scores"`` (the CLI's bundle-file path);
+    a ``PipelineModel`` serves its fitted stages through its own
+    compiled-segment cache, so online and offline execution share one
+    compile + param upload.
+    """
+    from mmlspark_tpu.models.bundle import ModelBundle
+    if isinstance(model, ModelBundle):
+        from mmlspark_tpu.models.jax_model import JaxModel
+        model = JaxModel(model=model, input_col="input",
+                         output_col="scores")
+    stages = getattr(model, "stages", None)
+    if stages is not None and not callable(stages):
+        return list(stages), model, model
+    if not hasattr(model, "transform"):
+        raise BadRequest(
+            f"not a servable model: {type(model).__name__} (needs "
+            ".transform or a ModelBundle)")
+    return [model], model, model
+
+
+def _derived_schema(stages: list) -> Any | None:
+    """A concrete input schema derivable from the model itself: a leading
+    ``JaxModel`` pins its input column to the bundle's ``input_spec``
+    (as the flat vector ``coerce_input_matrix`` accepts)."""
+    from mmlspark_tpu.analysis.info import ColumnInfo, TableSchema
+    from mmlspark_tpu.models.jax_model import JaxModel
+    if not stages or not isinstance(stages[0], JaxModel):
+        return None
+    bundle = stages[0].model
+    if bundle is None:
+        return None
+    size = int(np.prod(tuple(bundle.input_spec)))
+    return TableSchema({stages[0].input_col: ColumnInfo.vector(
+        size, "float32")})
+
+
+def _example_rows(schema: Any, n: int) -> DataTable | None:
+    """Synthesize an ``n``-row table realizing ``schema`` — the warmup
+    input. None when any column's layout is not concrete enough to build
+    honest rows (warmup is then skipped; first request pays the compile)."""
+    from mmlspark_tpu.analysis.info import (
+        KIND_IMAGE, KIND_SCALAR, KIND_TEXT, KIND_VECTOR,
+    )
+    cols: dict[str, Any] = {}
+    meta: dict[str, dict] = {}
+    for name, info in schema.columns.items():
+        if info.kind == KIND_IMAGE:
+            shape = info.concrete_shape
+            if shape is None or len(shape) != 3:
+                return None
+            from mmlspark_tpu.core.schema import make_image
+            cols[name] = [make_image(f"warmup{i}",
+                                     np.zeros(shape, np.uint8))
+                          for i in range(n)]
+            meta[name] = {"is_image": True}
+        elif info.kind == KIND_VECTOR:
+            size = info.row_size
+            if size is None:
+                return None
+            dt = np.uint8 if info.dtype == "uint8" else np.float32
+            cols[name] = [np.zeros(size, dt) for _ in range(n)]
+        elif info.kind == KIND_SCALAR:
+            dt = np.dtype(info.dtype or "float64")
+            cols[name] = np.zeros(n, dt)
+        elif info.kind == KIND_TEXT:
+            cols[name] = [""] * n
+        else:
+            return None
+    if not cols:
+        return None
+    table = DataTable(cols)
+    for name, m in meta.items():
+        table = table.with_meta(name, **m)
+    return table
+
+
+class _ModelEntry:
+    def __init__(self, name: str, model: Any, batcher: DynamicBatcher,
+                 schema: Any | None):
+        self.name = name
+        self.model = model
+        self.batcher = batcher
+        self.schema = schema
+
+
+class ModelServer:
+    """Serves one or more fitted models through per-model dynamic batchers.
+
+    Thread-safe: :meth:`submit`/:meth:`predict` may be called from any
+    number of client threads (the HTTP front end is one such client).
+    """
+
+    def __init__(self, config: ServeConfig | None = None):
+        self.config = config or ServeConfig()
+        self._models: dict[str, _ModelEntry] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+
+    # -- loading --
+
+    def add_model(self, name: str, model: Any,
+                  schema: Any | None = None,
+                  example: DataTable | None = None) -> None:
+        """Register ``model`` under ``name``.
+
+        1. **Validate** with the pre-flight analyzer over ``schema`` (or a
+           schema derived from the model's own input contract, or an
+           inexact empty schema) — error diagnostics raise
+           :class:`ModelLoadError` before any device work.
+        2. **Warm** the bucket ladder when concrete example rows are
+           available (``example``, or rows synthesized from the schema):
+           one compiled program per bucket exists before the first
+           request.
+        3. **Start** the model's dispatch loop.
+        """
+        from mmlspark_tpu.analysis import TableSchema, analyze
+
+        stages, cache_host, model = _as_stages(model)
+        if schema is None:
+            schema = _derived_schema(stages)
+        check_schema = schema if schema is not None \
+            else TableSchema({}, exact=False)
+        report = analyze(model, check_schema)
+        if not report.ok:
+            raise ModelLoadError(name, report)
+
+        stats = ServerStats(self.config.stats_window)
+        batcher = DynamicBatcher(name, stages, cache_host, self.config,
+                                 stats)
+        try:
+            if self.config.warmup:
+                warm = example
+                if warm is None and schema is not None:
+                    warm = _example_rows(schema, 1)
+                if warm is not None and len(warm):
+                    self._warm(batcher, warm)
+                else:
+                    _log.info("serve[%s]: no concrete input layout — "
+                              "skipping warmup (first request per bucket "
+                              "pays the compile)", name)
+        except BaseException:
+            batcher.close(drain=False)
+            raise
+        with self._lock:
+            if self._closed:
+                batcher.close(drain=False)
+                raise ServerClosed("server is closed")
+            old = self._models.get(name)
+            self._models[name] = _ModelEntry(name, model, batcher, schema)
+        if old is not None:
+            old.batcher.close(drain=True)
+        _log.info("serve[%s]: loaded (%d stage(s), buckets=%s)", name,
+                  len(stages), self.config.buckets)
+
+    def _warm(self, batcher: DynamicBatcher, example: DataTable) -> None:
+        """Compile every bucket by running one padded batch per rung
+        through the SAME dispatch path requests take."""
+        row = example.take(np.arange(1))
+        for bucket in self.config.buckets:
+            padded = row if bucket == 1 else row.concat(
+                row.take(np.zeros(bucket - 1, dtype=np.int64)))
+            batcher.warm(padded)
+
+    # -- request surface --
+
+    def _entry(self, name: str) -> _ModelEntry:
+        with self._lock:
+            entry = self._models.get(name)
+            if entry is None:
+                raise ModelNotFound(name, list(self._models))
+            return entry
+
+    def submit(self, name: str, table: DataTable,
+               deadline_ms: float | None = None) -> ServeRequest:
+        """Admit a request; returns the awaitable handle. ``deadline_ms``
+        defaults to the server-wide ``ServeConfig.deadline_ms``."""
+        if deadline_ms is None:
+            deadline_ms = self.config.deadline_ms
+        return self._entry(name).batcher.submit(table, deadline_ms)
+
+    def predict(self, name: str, table: DataTable,
+                deadline_ms: float | None = None,
+                timeout: float | None = None) -> DataTable:
+        """Blocking submit+wait."""
+        return self.submit(name, table, deadline_ms).result(timeout)
+
+    # -- introspection --
+
+    def models(self) -> list[str]:
+        with self._lock:
+            return sorted(self._models)
+
+    def stats(self, name: str) -> ServerStats:
+        return self._entry(name).batcher.stats
+
+    def compiled_programs(self, name: str) -> int | None:
+        return self._entry(name).batcher.compiled_programs()
+
+    def snapshot(self) -> dict:
+        """All models' stats in one JSON-safe dict (the /v1/stats body)."""
+        with self._lock:
+            entries = list(self._models.values())
+        out = {}
+        for e in entries:
+            snap = e.batcher.stats.snapshot()
+            snap["queued"] = e.batcher.queued
+            programs = e.batcher.compiled_programs()
+            if programs is not None:
+                snap["programs_compiled"] = programs
+            out[e.name] = snap
+        return out
+
+    # -- lifecycle --
+
+    def close(self, drain: bool = True) -> None:
+        """Shut down every model's batcher. ``drain=True`` (default)
+        answers all admitted requests first; no threads survive."""
+        with self._lock:
+            self._closed = True
+            entries = list(self._models.values())
+        for e in entries:
+            e.batcher.close(drain=drain)
+
+    def __enter__(self) -> "ModelServer":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+
+class Client:
+    """In-process client: the deterministic test/bench surface, mirroring
+    what the HTTP front end does without sockets."""
+
+    def __init__(self, server: ModelServer):
+        self.server = server
+
+    def predict(self, model: str,
+                rows: DataTable | Iterable[Mapping[str, Any]],
+                deadline_ms: float | None = None,
+                columns: Iterable[str] | None = None,
+                timeout: float | None = None) -> DataTable:
+        if not isinstance(rows, DataTable):
+            rows = DataTable.from_rows(list(rows))
+        out = self.server.predict(model, rows, deadline_ms, timeout)
+        if columns is not None:
+            out = out.select(*columns)
+        return out
+
+    def predict_async(self, model: str,
+                      rows: DataTable | Iterable[Mapping[str, Any]],
+                      deadline_ms: float | None = None) -> ServeRequest:
+        if not isinstance(rows, DataTable):
+            rows = DataTable.from_rows(list(rows))
+        return self.server.submit(model, rows, deadline_ms)

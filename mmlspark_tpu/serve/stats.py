@@ -1,0 +1,122 @@
+"""ServerStats — honest per-model serving metrics.
+
+Same accounting discipline as ``Trainer.input_stats``: every number is
+counted or timed at the seam where it happens (admission, pack, dispatch,
+drain, resolve), nothing is inferred, and the snapshot says exactly what
+was measured. Metrics glossary in docs/serving.md.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+import numpy as np
+
+
+def _percentiles(values) -> dict | None:
+    if not values:
+        return None
+    arr = np.asarray(values, dtype=np.float64)
+    p50, p95, p99 = np.percentile(arr, [50, 95, 99])
+    return {"p50": round(float(p50), 3), "p95": round(float(p95), 3),
+            "p99": round(float(p99), 3), "n": int(arr.size)}
+
+
+class ServerStats:
+    """Thread-safe metrics surface of one served model."""
+
+    def __init__(self, window: int = 4096):
+        self._lock = threading.Lock()
+        # request-side counters (admission → terminal state)
+        self.admitted = 0
+        self.completed = 0
+        self.rejected_overload = 0   # Overloaded at submit
+        self.expired_deadline = 0    # cancelled in queue, before dispatch
+        self.timed_out = 0           # client gave up post-admission
+        self.failed = 0              # dispatch/model error relayed
+        # batch-side counters
+        self.batches = 0
+        self.rows_dispatched = 0
+        self.rows_padded = 0         # padding rows (bucket - occupancy)
+        # bounded reservoirs (latest `window` observations)
+        self._e2e_ms: deque = deque(maxlen=window)
+        self._queue_ms: deque = deque(maxlen=window)
+        self._device_ms: deque = deque(maxlen=window)
+        self._occupancy: deque = deque(maxlen=window)
+        self._bucket_batches: dict[int, int] = {}
+        # distinct batch shapes OBSERVED entering the device (reported by
+        # the dispatch handle, one per uploaded chunk — not the intended
+        # bucket label): for a fixed program each new shape is one XLA
+        # compile, so this set is the recompile observable independent of
+        # jit internals
+        self.dispatch_shapes: set = set()
+
+    # -- request side --
+
+    def record_admitted(self) -> None:
+        with self._lock:
+            self.admitted += 1
+
+    def record_rejected(self) -> None:
+        with self._lock:
+            self.rejected_overload += 1
+
+    def record_expired(self) -> None:
+        with self._lock:
+            self.expired_deadline += 1
+
+    def record_timeout(self) -> None:
+        with self._lock:
+            self.timed_out += 1
+
+    def record_failed(self) -> None:
+        with self._lock:
+            self.failed += 1
+
+    def record_done(self, e2e_ms: float, queue_ms: float) -> None:
+        with self._lock:
+            self.completed += 1
+            self._e2e_ms.append(e2e_ms)
+            self._queue_ms.append(queue_ms)
+
+    # -- batch side --
+
+    def record_batch(self, bucket: int, occupancy: int, device_ms: float,
+                     shapes: tuple = ()) -> None:
+        with self._lock:
+            self.batches += 1
+            self.rows_dispatched += occupancy
+            self.rows_padded += max(bucket - occupancy, 0)
+            self._device_ms.append(device_ms)
+            self._occupancy.append(occupancy)
+            self._bucket_batches[bucket] = (
+                self._bucket_batches.get(bucket, 0) + 1)
+            for s in shapes:
+                self.dispatch_shapes.add(tuple(s))
+
+    # -- presentation --
+
+    def snapshot(self) -> dict:
+        """One JSON-safe dict of everything measured so far."""
+        with self._lock:
+            occ = list(self._occupancy)
+            mean_occ = (round(float(np.mean(occ)), 3) if occ else None)
+            return {
+                "admitted": self.admitted,
+                "completed": self.completed,
+                "rejected_overload": self.rejected_overload,
+                "expired_deadline": self.expired_deadline,
+                "timed_out": self.timed_out,
+                "failed": self.failed,
+                "batches": self.batches,
+                "rows_dispatched": self.rows_dispatched,
+                "rows_padded": self.rows_padded,
+                "batch_occupancy_mean": mean_occ,
+                "occupancy_by_bucket": dict(
+                    sorted(self._bucket_batches.items())),
+                "e2e_ms": _percentiles(self._e2e_ms),
+                "queue_wait_ms": _percentiles(self._queue_ms),
+                "device_ms": _percentiles(self._device_ms),
+                "distinct_batch_shapes": len(self.dispatch_shapes),
+            }

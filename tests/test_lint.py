@@ -87,6 +87,16 @@ def train(batches, state, step_masked):
     return state, history, x, y, final
 
 
+def serve_loop(batches, dispatch_async):
+    results = []
+    for b in batches:
+        outs = dispatch_async(b)
+        results.append(np.asarray(outs))              # JX106
+        v = float(outs)                               # JX106
+        w = outs.item()                               # JX106
+    return results, v, w
+
+
 def host_side_is_fine(x):
     # not jitted: host syncs here are intentional and unflagged
     return float(np.asarray(x).sum())
@@ -105,7 +115,7 @@ def test_fixture_yields_exactly_the_seeded_findings():
     want = sorted(
         (rule, i + 1)
         for i, text in enumerate(lines)
-        for rule in ("JX101", "JX102", "JX103", "JX104", "JX105")
+        for rule in ("JX101", "JX102", "JX103", "JX104", "JX105", "JX106")
         if f"# {rule}" in text)
     assert got == want, (got, want)
 
@@ -155,6 +165,53 @@ def test_jx105_ignores_non_step_calls():
            "        total += float(v)\n"
            "    return total\n")
     assert lint_source(src, "x.py") == []
+
+
+def test_jx106_windowed_drain_is_clean():
+    # the sanctioned serve idiom (serve/batcher.py): push the dispatched
+    # handle through a bounded window and fetch the OLDEST entry — the
+    # fetch target comes off the window, not the fresh dispatch, so
+    # packing of batch i+1 overlaps compute of batch i
+    src = ("import numpy as np\n"
+           "from collections import deque\n"
+           "def serve(batches, transform_async):\n"
+           "    window = deque()\n"
+           "    for b in batches:\n"
+           "        pending = transform_async(b)\n"
+           "        window.append(pending)\n"
+           "        if len(window) >= 2:\n"
+           "            oldest = window.popleft()\n"
+           "            out = np.asarray(oldest)\n"
+           "    return [np.asarray(p) for p in window]\n")
+    assert lint_source(src, "x.py") == []
+    # the anti-pattern: immediate full-batch fetch of the fresh dispatch
+    src_sync = ("import numpy as np\n"
+                "def serve(batches, transform_async):\n"
+                "    out = []\n"
+                "    for b in batches:\n"
+                "        pending = transform_async(b)\n"
+                "        out.append(np.asarray(pending))\n"
+                "    return out\n")
+    assert [f.rule for f in lint_source(src_sync, "x.py")] == ["JX106"]
+
+
+def test_jx106_pragma_suppresses_and_ignores_plain_calls():
+    src = ("import numpy as np\n"
+           "def serve(batches, dispatch):\n"
+           "    for b in batches:\n"
+           "        outs = dispatch(b)\n"
+           "        v = float(outs)  # lint-jax: allow(JX106)\n"
+           "    return v\n")
+    assert lint_source(src, "x.py") == []
+    # fetches on values from non-dispatch calls are host bookkeeping
+    src_ok = ("import numpy as np\n"
+              "def walk(rows, score):\n"
+              "    total = 0.0\n"
+              "    for r in rows:\n"
+              "        v = score(r)\n"
+              "        total += float(np.asarray(v))\n"
+              "    return total\n")
+    assert lint_source(src_ok, "x.py") == []
 
 
 def test_pragma_suppresses():

@@ -187,6 +187,88 @@ class TestPretrainedFlow:
         assert bundle.name == "ConvNet_CIFAR10"
 
 
+class TestConcurrentDownload:
+    """Two server workers loading the same model must not corrupt the
+    cache: the fetch holds a per-entry file lock and publishes the
+    verified file with an atomic rename (fast: manifests are built by
+    hand, no model training)."""
+
+    @staticmethod
+    def _tiny_repo(tmp_path, payload=b"x" * 65536):
+        import hashlib
+        import json as _json
+
+        from mmlspark_tpu.data.downloader import MANIFEST_NAME, ModelSchema
+        repo = tmp_path / "repo"
+        repo.mkdir()
+        (repo / "tiny.model").write_bytes(payload)
+        entry = ModelSchema(
+            name="tiny", uri="tiny.model",
+            hash=hashlib.sha256(payload).hexdigest(), size=len(payload))
+        (repo / MANIFEST_NAME).write_text(
+            _json.dumps([entry.to_json()]))
+        return str(repo), entry, payload
+
+    def test_two_threads_fetch_one_clean_cache_entry(self, tmp_path):
+        import hashlib
+        import threading
+
+        repo, entry, payload = self._tiny_repo(tmp_path)
+        cache = str(tmp_path / "cache")
+        dl = ModelDownloader(repo, cache_dir=cache)
+        paths, errors = [], []
+
+        def fetch():
+            try:
+                paths.append(dl.download(entry))
+            except BaseException as e:  # noqa: BLE001 - surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=fetch) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, errors
+        assert len(set(paths)) == 1
+        with open(paths[0], "rb") as f:
+            assert hashlib.sha256(f.read()).hexdigest() == entry.hash
+        # no half-written temp files survive the race
+        leftovers = [n for n in os.listdir(cache) if ".tmp-" in n]
+        assert leftovers == [], leftovers
+
+    def test_atomic_publication_never_exposes_partial_files(self,
+                                                            tmp_path):
+        # a reader polling the destination path during the fetch must only
+        # ever see the complete, hash-verified payload
+        import hashlib
+        import threading
+
+        repo, entry, payload = self._tiny_repo(tmp_path,
+                                               payload=b"y" * (1 << 20))
+        cache = str(tmp_path / "cache")
+        dl = ModelDownloader(repo, cache_dir=cache)
+        dest = dl._cache_path(entry)
+        seen, stop = [], threading.Event()
+
+        def watch():
+            while not stop.is_set():
+                if os.path.exists(dest):
+                    with open(dest, "rb") as f:
+                        seen.append(len(f.read()))
+
+        t = threading.Thread(target=watch)
+        t.start()
+        try:
+            dl.download(entry)
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        assert all(n == len(payload) for n in seen), (
+            f"observed partial cache entries of sizes "
+            f"{sorted(set(n for n in seen if n != len(payload)))}")
+
+
 @pytest.mark.slow  # 224-scale full-size bundles
 class TestFullScaleBundles:
     def test_resnet50_publish_download_featurize_224(self, tmp_path):

@@ -1,8 +1,11 @@
 """Tier-1 wiring of tools/perf_smoke.py: the planner must fuse the
 canonical image pipeline into exactly one H2D upload and one async D2H
-fetch round per minibatch (counted at the planner's crossing seams), and
-the train input pipeline must actually commit batches ahead of
-consumption (counted at the DeviceLoader's producer/consumer seams)."""
+fetch round per minibatch (counted at the planner's crossing seams), the
+train input pipeline must actually commit batches ahead of consumption
+(counted at the DeviceLoader's producer/consumer seams), and the model
+server must quantize a request burst onto its bucket ladder (compiles
+bounded by the ladder, mean occupancy > 1 — counted at the jit compile
+cache and the dispatch-shape seam)."""
 
 import os
 import sys
@@ -10,7 +13,7 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
 
 from perf_smoke import (  # noqa: E402
-    check_fused_crossings, check_train_prefetch,
+    check_fused_crossings, check_serve_batching, check_train_prefetch,
 )
 
 
@@ -26,3 +29,11 @@ def test_train_loader_commits_ahead_of_consumption():
     assert result["committed_ahead_max"] >= result["prefetch_depth"]
     assert result["batches"] == result["steps"]
     assert 0.0 <= result["input_bound_fraction"] <= 1.0
+
+
+def test_serve_burst_compiles_bounded_and_coalesces():
+    result = check_serve_batching()
+    assert result["programs_compiled"] is None \
+        or result["programs_compiled"] <= len(result["buckets"])
+    assert result["distinct_batch_shapes"] <= len(result["buckets"])
+    assert result["batch_occupancy_mean"] > 1.0

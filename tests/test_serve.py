@@ -1,0 +1,502 @@
+"""Serving semantics: the online model server must be *boring* —
+
+* served outputs are bit-identical to offline ``PipelineModel.transform``
+  for the same rows, regardless of how requests were packed into buckets;
+* a burst of mixed-size requests compiles at most ``len(buckets)``
+  programs (asserted via the jit compile-cache counter hook);
+* overload and deadline paths return typed errors (``Overloaded``,
+  ``DeadlineExceeded``) — never a partial result;
+* shutdown drains: every admitted request is answered, and no batcher
+  thread survives ``close()``.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from mmlspark_tpu.core.pipeline import PipelineModel
+from mmlspark_tpu.core.schema import make_image
+from mmlspark_tpu.core.stage import LambdaTransformer
+from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.models.bundle import ModelBundle
+from mmlspark_tpu.models.jax_model import JaxModel
+from mmlspark_tpu.models.zoo import MLP, get_model
+from mmlspark_tpu.serve import (
+    THREAD_PREFIX, BadRequest, Client, DeadlineExceeded, ModelLoadError,
+    ModelNotFound, ModelServer, Overloaded, ServeConfig, ServerClosed,
+)
+from mmlspark_tpu.stages.image import ImageTransformer, UnrollImage
+
+
+def mlp_bundle(in_dim=6, out_dim=4, seed=0):
+    module = MLP(features=(8,), num_outputs=out_dim)
+    params = module.init(jax.random.PRNGKey(seed),
+                         np.zeros((1, in_dim), np.float32))["params"]
+    return ModelBundle(
+        module=module,
+        params=jax.tree_util.tree_map(np.asarray, params),
+        input_spec=(in_dim,),
+        output_names=("features", "logits"))
+
+
+def vector_table(rows):
+    return DataTable({"x": list(rows)})
+
+
+def image_pipeline(seed=0):
+    """The canonical fused chain: resize → unroll → score (3 device
+    stages, ONE compiled program through the planner)."""
+    stages = [
+        ImageTransformer().resize(32, 32),
+        UnrollImage(input_col="image", output_col="image_vec"),
+        JaxModel(model=get_model("ConvNet_CIFAR10", widths=(8, 16),
+                                 dense_width=32, seed=seed),
+                 input_col="image_vec", output_col="scores"),
+    ]
+    return PipelineModel(stages)
+
+
+def image_table(n, hw=40, seed=0):
+    r = np.random.default_rng(seed)
+    return DataTable({"image": [
+        make_image(f"p{k}", r.integers(0, 255, (hw, hw, 3)))
+        for k in range(n)]})
+
+
+def sleepy_model(delay_s, out_col="out"):
+    """Host-path model whose transform takes a known wall time."""
+    def fn(table):
+        time.sleep(delay_s)
+        return table.with_column(
+            out_col, np.asarray(table["x"], dtype=object))
+    return LambdaTransformer(fn=fn)
+
+
+# ---- parity: served == offline, regardless of packing ----
+
+
+class TestParity:
+    def test_single_stage_bit_identical_across_packings(self):
+        jm = JaxModel(model=mlp_bundle(), input_col="x",
+                      output_col="scores")
+        rng = np.random.default_rng(0)
+        rows = rng.normal(size=(40, 6)).astype(np.float32)
+        offline = jm.transform(vector_table(rows))
+
+        with ModelServer(ServeConfig(buckets=(1, 4, 16),
+                                     max_queue=128)) as server:
+            server.add_model("mlp", jm, example=vector_table(rows[:1]))
+            # mixed request sizes force every packing shape
+            sizes = [1, 2, 3, 5, 1, 4, 7, 1, 16, 2, 3, 5]
+            handles, spans = [], []
+            off = 0
+            for n in sizes:
+                if off + n > len(rows):
+                    off = 0
+                handles.append(server.submit(
+                    "mlp", vector_table(rows[off:off + n])))
+                spans.append((off, n))
+                off += n
+            for h, (off, n) in zip(handles, spans):
+                out = h.result(timeout=60)
+                assert len(out) == n
+                for k in range(n):
+                    assert np.array_equal(
+                        np.asarray(out["scores"][k]),
+                        np.asarray(offline["scores"][off + k]))
+
+    def test_fused_pipeline_bit_identical_across_packings(self):
+        pm = image_pipeline()
+        table = image_table(24)
+        offline = pm.transform(table)
+        with ModelServer(ServeConfig(buckets=(1, 4, 16),
+                                     max_queue=64)) as server:
+            server.add_model("pipe", pm, example=table.take(np.arange(1)))
+            handles = [
+                server.submit("pipe", table.take(np.arange(i, i + n)))
+                for i, n in [(0, 1), (1, 3), (4, 5), (9, 1), (10, 7),
+                             (17, 2), (19, 5)]]
+            outs = [h.result(timeout=120) for h in handles]
+        row = 0
+        for out in outs:
+            for k in range(len(out)):
+                assert np.array_equal(np.asarray(out["scores"][k]),
+                                      np.asarray(offline["scores"][row]))
+                row += 1
+        assert row == 24
+
+    def test_host_only_model_serves_through_fallback(self):
+        # a pure-host transformer serves through the same batcher (no
+        # async dispatch, same semantics)
+        model = sleepy_model(0.0)
+        rows = np.arange(6, dtype=np.float64)
+        with ModelServer(ServeConfig(buckets=(1, 4),
+                                     max_queue=16)) as server:
+            server.add_model("host", model)
+            out = server.predict("host", vector_table(rows[:3]),
+                                 timeout=30)
+            assert list(out["out"]) == list(rows[:3])
+
+
+# ---- the bucket ladder bounds compilation ----
+
+
+class TestCompileBound:
+    def test_warmup_compiles_exactly_the_ladder(self):
+        jm = JaxModel(model=mlp_bundle(), input_col="x",
+                      output_col="scores")
+        buckets = (1, 4, 16)
+        with ModelServer(ServeConfig(buckets=buckets)) as server:
+            server.add_model("mlp", jm, example=vector_table(
+                np.zeros((1, 6), np.float32)))
+            programs = server.compiled_programs("mlp")
+            # one program per *distinct dp-rounded* bucket shape: under
+            # the 8-virtual-device test mesh buckets 1 and 4 both round
+            # to one 8-row shard shape, so the count can be below
+            # len(buckets) — never above it
+            assert programs is None or 1 <= programs <= len(buckets)
+
+    def test_mixed_size_burst_compiles_at_most_len_buckets(self):
+        jm = JaxModel(model=mlp_bundle(), input_col="x",
+                      output_col="scores")
+        rng = np.random.default_rng(1)
+        rows = rng.normal(size=(64, 6)).astype(np.float32)
+        buckets = (1, 4, 16)
+        with ModelServer(ServeConfig(buckets=buckets,
+                                     max_queue=256)) as server:
+            server.add_model("mlp", jm, example=vector_table(rows[:1]))
+            sizes = [1, 2, 3, 4, 5, 8, 13, 16, 1, 6, 11, 2, 9, 16, 7, 1]
+            handles = [server.submit("mlp", vector_table(
+                rows[:n])) for n in sizes]
+            for h in handles:
+                h.result(timeout=60)
+            programs = server.compiled_programs("mlp")
+            snap = server.stats("mlp").snapshot()
+        # the compile-counter hook: the jitted composite's own cache
+        assert programs is None or programs <= len(buckets), programs
+        # and the seam-counted observable: distinct dispatched shapes
+        assert snap["distinct_batch_shapes"] <= len(buckets)
+
+
+# ---- admission control and deadlines ----
+
+
+class TestAdmission:
+    def test_queue_full_returns_typed_overloaded(self):
+        model = sleepy_model(0.15)
+        with ModelServer(ServeConfig(buckets=(1,), max_queue=2,
+                                     warmup=False)) as server:
+            server.add_model("slow", model)
+            accepted, rejected = [], 0
+            for i in range(8):
+                try:
+                    accepted.append(server.submit(
+                        "slow", vector_table(np.arange(1.0))))
+                except Overloaded as e:
+                    rejected += 1
+                    assert e.model == "slow" and e.max_queue == 2
+            assert rejected >= 1, "queue never filled"
+            for h in accepted:
+                assert len(h.result(timeout=30)) == 1
+            snap = server.stats("slow").snapshot()
+            assert snap["rejected_overload"] == rejected
+            assert snap["completed"] == len(accepted)
+
+    def test_deadline_expiry_in_queue_is_cancelled_before_dispatch(self):
+        model = sleepy_model(0.3)
+        with ModelServer(ServeConfig(buckets=(1,), max_queue=8,
+                                     warmup=False)) as server:
+            server.add_model("slow", model)
+            first = server.submit("slow", vector_table(np.arange(1.0)))
+            # wait until the first request is actually dispatched, so the
+            # second provably sits in the queue past its deadline
+            deadline = time.monotonic() + 5
+            while first._dispatched_at is None:
+                assert time.monotonic() < deadline
+                time.sleep(0.005)
+            doomed = server.submit("slow", vector_table(np.arange(1.0)),
+                                   deadline_ms=50)
+            # don't await `doomed` yet: the BATCHER must observe the
+            # expiry at pack time and cancel before dispatch
+            assert len(first.result(timeout=30)) == 1
+            wait_until = time.monotonic() + 5
+            while not doomed.done:
+                assert time.monotonic() < wait_until
+                time.sleep(0.005)
+            with pytest.raises(DeadlineExceeded) as exc:
+                doomed.result(timeout=1)
+            assert exc.value.where == "queued"
+            snap = server.stats("slow").snapshot()
+            assert snap["expired_deadline"] == 1
+            assert doomed._dispatched_at is None  # cancelled pre-dispatch
+
+    def test_inflight_deadline_returns_timeout_never_partial(self):
+        model = sleepy_model(0.3)
+        with ModelServer(ServeConfig(buckets=(1,), max_queue=8,
+                                     warmup=False)) as server:
+            server.add_model("slow", model)
+            h = server.submit("slow", vector_table(np.arange(1.0)),
+                              deadline_ms=100)
+            with pytest.raises(DeadlineExceeded) as exc:
+                h.result()
+            assert exc.value.where in ("queued", "in-flight")
+            # the batch completes later; its result must be discarded —
+            # re-asking can only re-raise, never hand back data
+            time.sleep(0.4)
+            with pytest.raises(DeadlineExceeded):
+                h.result()
+            snap = server.stats("slow").snapshot()
+            assert snap["timed_out"] >= 1
+
+    def test_row_count_changing_model_fails_batch_never_misattributes(
+            self):
+        # a model that drops rows breaks the per-request split: offsets
+        # would shift and neighbors would silently get each other's rows.
+        # The whole batch must fail with a typed error instead
+        def drop_first(table):
+            import numpy as _np
+            keep = _np.arange(1, len(table)) if len(table) > 1 \
+                else _np.arange(len(table))
+            return table.take(keep).with_column(
+                "out", np.asarray(table["x"][len(table) - len(keep):],
+                                  dtype=object))
+        model = LambdaTransformer(fn=drop_first)
+        with ModelServer(ServeConfig(buckets=(4,), max_queue=8,
+                                     warmup=False)) as server:
+            server.add_model("dropper", model)
+            handles = [server.submit("dropper",
+                                     vector_table(np.arange(2.0)))
+                       for _ in range(2)]
+            for h in handles:
+                with pytest.raises(BadRequest, match="row count"):
+                    h.result(timeout=30)
+            assert server.stats("dropper").snapshot()["failed"] == 2
+
+    def test_client_timeout_is_terminal_not_a_hang(self):
+        # a give-up is final: repeat result() calls re-raise immediately
+        # instead of blocking forever on an event the discarded
+        # resolution will never set (and timed_out counts the transition
+        # once, not every retry)
+        model = sleepy_model(0.3)
+        with ModelServer(ServeConfig(buckets=(1,), max_queue=8,
+                                     warmup=False)) as server:
+            server.add_model("slow", model)
+            h = server.submit("slow", vector_table(np.arange(1.0)))
+            with pytest.raises(TimeoutError):
+                h.result(timeout=0.05)
+            t0 = time.monotonic()
+            with pytest.raises(TimeoutError):
+                h.result()  # no timeout arg: must NOT wait forever
+            assert time.monotonic() - t0 < 1.0
+            time.sleep(0.4)  # batch completes; result stays discarded
+            with pytest.raises(TimeoutError):
+                h.result()
+            assert server.stats("slow").snapshot()["timed_out"] == 1
+
+    @pytest.mark.parametrize("bad_rows", [
+        lambda rng: DataTable({"wrong": [rng.normal(
+            size=6).astype(np.float32)]}),     # wrong column name
+        lambda rng: DataTable({"x": [rng.normal(
+            size=100).astype(np.float32)]}),   # same column, wrong width
+    ], ids=["wrong-column", "wrong-shape"])
+    def test_mismatched_request_fails_alone(self, bad_rows):
+        # a request with the wrong columns OR the wrong per-row layout is
+        # never packed with (and can never fail) well-formed neighbors
+        jm = JaxModel(model=mlp_bundle(), input_col="x",
+                      output_col="scores")
+        rng = np.random.default_rng(7)
+        rows = rng.normal(size=(4, 6)).astype(np.float32)
+        with ModelServer(ServeConfig(buckets=(1, 8), max_queue=16,
+                                     warmup=False)) as server:
+            server.add_model("mlp", jm)
+            good1 = server.submit("mlp", vector_table(rows[:2]))
+            bad = server.submit("mlp", bad_rows(rng))
+            good2 = server.submit("mlp", vector_table(rows[3:]))
+            assert len(good1.result(timeout=30)) == 2
+            assert len(good2.result(timeout=30)) == 1
+            with pytest.raises(Exception) as exc:
+                bad.result(timeout=30)
+            assert not isinstance(exc.value, (DeadlineExceeded,
+                                              TimeoutError))
+
+    def test_bad_requests_are_typed(self):
+        jm = JaxModel(model=mlp_bundle(), input_col="x",
+                      output_col="scores")
+        with ModelServer(ServeConfig(buckets=(1, 4),
+                                     warmup=False)) as server:
+            server.add_model("mlp", jm)
+            with pytest.raises(BadRequest):  # empty
+                server.submit("mlp", DataTable({"x": []}))
+            with pytest.raises(BadRequest):  # larger than the top bucket
+                server.submit("mlp", vector_table(
+                    np.zeros((5, 6), np.float32)))
+            with pytest.raises(ModelNotFound):
+                server.submit("nope", vector_table(
+                    np.zeros((1, 6), np.float32)))
+
+
+# ---- lifecycle ----
+
+
+class TestLifecycle:
+    def test_drain_on_shutdown_answers_all_admitted(self):
+        model = sleepy_model(0.02)
+        server = ModelServer(ServeConfig(buckets=(1, 4), max_queue=64,
+                                         warmup=False))
+        server.add_model("slow", model)
+        handles = [server.submit("slow", vector_table(np.arange(1.0)))
+                   for _ in range(10)]
+        server.close(drain=True)  # blocks until the worker drained
+        for h in handles:
+            assert len(h.result(timeout=1)) == 1
+        snap = server.stats("slow").snapshot()
+        assert snap["completed"] == 10
+        with pytest.raises(ServerClosed):
+            server.submit("slow", vector_table(np.arange(1.0)))
+
+    def test_abort_close_fails_queued_with_server_closed(self):
+        model = sleepy_model(0.2)
+        server = ModelServer(ServeConfig(buckets=(1,), max_queue=16,
+                                         warmup=False))
+        server.add_model("slow", model)
+        handles = [server.submit("slow", vector_table(np.arange(1.0)))
+                   for _ in range(6)]
+        server.close(drain=False)
+        outcomes = []
+        for h in handles:
+            try:
+                h.result(timeout=5)
+                outcomes.append("ok")
+            except ServerClosed:
+                outcomes.append("closed")
+        assert "closed" in outcomes  # queued work was failed, not served
+
+    def test_no_leaked_threads_after_close(self):
+        def serve_threads():
+            return [t.name for t in threading.enumerate()
+                    if t.name.startswith(THREAD_PREFIX)]
+
+        assert serve_threads() == []
+        jm = JaxModel(model=mlp_bundle(), input_col="x",
+                      output_col="scores")
+        server = ModelServer(ServeConfig(buckets=(1, 4)))
+        server.add_model("mlp", jm,
+                         example=vector_table(np.zeros((1, 6), np.float32)))
+        server.predict("mlp", vector_table(np.zeros((2, 6), np.float32)),
+                       timeout=30)
+        assert serve_threads() != []
+        server.close()
+        assert serve_threads() == []
+
+
+# ---- load-time validation (the analyzer gate) ----
+
+
+class TestLoadValidation:
+    def test_model_not_set_fails_load_fast(self):
+        with ModelServer(ServeConfig(warmup=False)) as server:
+            with pytest.raises(ModelLoadError) as exc:
+                server.add_model("broken", JaxModel(
+                    input_col="x", output_col="scores"))
+            assert "model-not-set" in str(exc.value)
+            assert server.models() == []
+
+    def test_schema_size_mismatch_fails_load_fast(self):
+        from mmlspark_tpu.analysis import ColumnInfo, TableSchema
+        jm = JaxModel(model=mlp_bundle(in_dim=6), input_col="x",
+                      output_col="scores")
+        schema = TableSchema({"x": ColumnInfo.vector(5, "float32")})
+        with ModelServer(ServeConfig(warmup=False)) as server:
+            with pytest.raises(ModelLoadError) as exc:
+                server.add_model("mlp", jm, schema=schema)
+            assert "input-size-mismatch" in str(exc.value)
+
+
+# ---- the HTTP front end ----
+
+
+@pytest.fixture()
+def http_mlp_server():
+    from mmlspark_tpu.serve.http import start_http_server
+    server = ModelServer(ServeConfig(buckets=(1, 4, 16), max_queue=64))
+    server.add_model("mlp", mlp_bundle())  # bundle wrap: input → scores
+    httpd = start_http_server(server, host="127.0.0.1", port=0)
+    yield server, f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+    httpd.server_close()
+    server.close()
+
+
+def _post_json(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode("utf-8"),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+class TestHTTP:
+    def test_json_predict_matches_offline(self, http_mlp_server):
+        server, base = http_mlp_server
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(3, 6)).astype(np.float32)
+        status, body = _post_json(
+            f"{base}/v1/models/mlp:predict",
+            {"rows": [{"input": r.tolist()} for r in x],
+             "columns": ["scores"]})
+        assert status == 200 and len(body["rows"]) == 3
+        jm = JaxModel(model=mlp_bundle(), input_col="input",
+                      output_col="scores")
+        ref = jm.transform(DataTable({"input": list(x)}))
+        for k in range(3):
+            assert np.allclose(body["rows"][k]["scores"],
+                               np.asarray(ref["scores"][k]), atol=1e-6)
+
+    def test_health_models_and_stats_endpoints(self, http_mlp_server):
+        _server, base = http_mlp_server
+        with urllib.request.urlopen(f"{base}/healthz") as r:
+            assert json.loads(r.read())["status"] == "ok"
+        with urllib.request.urlopen(f"{base}/v1/models") as r:
+            assert json.loads(r.read())["models"] == ["mlp"]
+        with urllib.request.urlopen(f"{base}/v1/stats") as r:
+            stats = json.loads(r.read())
+        assert "mlp" in stats and "admitted" in stats["mlp"]
+
+    def test_unknown_model_is_404_and_bad_body_is_400(self,
+                                                      http_mlp_server):
+        _server, base = http_mlp_server
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post_json(f"{base}/v1/models/nope:predict",
+                       {"rows": [{"input": [0.0] * 6}]})
+        assert exc.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post_json(f"{base}/v1/models/mlp:predict", {"rows": []})
+        assert exc.value.code == 400
+
+    def test_arrow_round_trip(self, http_mlp_server):
+        pa = pytest.importorskip("pyarrow")
+        import io
+        _server, base = http_mlp_server
+        rng = np.random.default_rng(4)
+        x = rng.normal(size=(2, 6)).astype(np.float32)
+        arrow = DataTable({"input": list(x)}).to_arrow()
+        sink = io.BytesIO()
+        with pa.ipc.new_stream(sink, arrow.schema) as writer:
+            writer.write_table(arrow)
+        ctype = "application/vnd.apache.arrow.stream"
+        req = urllib.request.Request(
+            f"{base}/v1/models/mlp:predict", data=sink.getvalue(),
+            headers={"Content-Type": ctype, "Accept": ctype})
+        with urllib.request.urlopen(req) as resp:
+            assert resp.status == 200
+            out = DataTable.from_arrow(
+                pa.ipc.open_stream(io.BytesIO(resp.read())).read_all()
+                .combine_chunks().to_batches()[0])
+        assert "scores" in out and len(out) == 2

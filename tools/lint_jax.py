@@ -23,6 +23,15 @@ jax initialization) catching the mistakes that cost the most on TPU:
   completion mid-pipeline, stalling the prefetch window every time it
   runs. Record the device scalar and resolve it one step later (the
   lagged-fetch sites in ``train/loop.py`` carry the pragma).
+* **JX106 blocking device fetch in a serve dispatch loop** —
+  ``np.asarray``/``float()``/``int()``/``.item()``/``.tolist()`` on the
+  output of a ``*dispatch*``/``*_async`` call inside the loop that issued
+  it: the fetch blocks the dispatch loop on that batch's device
+  completion, serializing host packing with device compute and forfeiting
+  the overlap the serving batcher exists for. Push the dispatched handle
+  through the bounded in-flight window and drain the *oldest* entry (or
+  fetch after the loop) — the discipline of
+  ``mmlspark_tpu/serve/batcher.py``.
 
 Intentional exceptions are suppressed two ways, both documented in
 docs/static_analysis.md:
@@ -62,10 +71,24 @@ RULES = {
     "JX104": "mutable default value in a Param declaration",
     "JX105": "blocking scalar fetch on a step output inside the step loop; "
              "record the device scalar and resolve it one step later",
+    "JX106": "blocking device fetch on a dispatched batch inside a serve "
+             "dispatch loop; drain through the bounded in-flight window "
+             "(or after the loop)",
 }
 
 # the callee-name hint marking a train-step call whose outputs JX105 tracks
 _STEP_HINT = "step"
+
+
+def _is_step_call(name: str) -> bool:
+    return _STEP_HINT in name.lower()
+
+
+def _is_dispatch_call(name: str) -> bool:
+    """JX106's taint source: an async batch dispatch — ``*dispatch*`` or
+    the ``*_async`` naming convention (``transform_async`` & co)."""
+    low = name.lower()
+    return "dispatch" in low or low.endswith("_async")
 
 _JIT_NAMES = {"jit", "pjit"}
 _NUMPY_ALIASES = {"np", "numpy", "onp"}
@@ -161,27 +184,38 @@ class _Linter(ast.NodeVisitor):
         self._loop_body(node)
 
     def _loop_body(self, node: ast.AST) -> None:
-        self._lint_step_loop(node)
+        # JX105: blocking scalar coercion on train-step outputs
+        self._lint_fetch_loop(node, _is_step_call, "JX105",
+                              "a step output", "mid-pipeline",
+                              flag_np=False)
+        # JX106: blocking device fetch on serve-dispatch outputs (also
+        # catches np.asarray — a full-batch fetch, not just a scalar)
+        self._lint_fetch_loop(node, _is_dispatch_call, "JX106",
+                              "a dispatched batch",
+                              "inside the serve dispatch loop",
+                              flag_np=True)
         self.loop_depth += 1
         self.generic_visit(node)
         self.loop_depth -= 1
 
-    # -- JX105: blocking scalar coercion on step outputs in the loop --
+    # -- JX105 / JX106: blocking fetches on pipelined outputs in a loop --
 
-    def _lint_step_loop(self, loop: ast.AST) -> None:
-        """Taint names bound from ``*step*(...)`` calls anywhere in this
-        loop's subtree (``state, metrics = self.step_masked(...)``),
-        propagate through plain/subscript aliasing (``pending =
-        metrics["loss"]``), and flag blocking coercions on tainted values
-        inside the loop. Host fetches after the loop drains are fine —
-        only the in-loop sync stalls the pipeline."""
+    def _lint_fetch_loop(self, loop: ast.AST, is_source, rule: str,
+                         noun: str, where: str, flag_np: bool) -> None:
+        """Taint names bound from source calls (``is_source`` over the
+        callee name) anywhere in this loop's subtree (``state, metrics =
+        self.step_masked(...)``), propagate through plain/subscript
+        aliasing (``pending = metrics["loss"]``), and flag blocking
+        coercions on tainted values inside the loop. Host fetches after
+        the loop drains are fine — only the in-loop sync stalls the
+        pipeline."""
         tainted: set[str] = set()
         for node in ast.walk(loop):
             if not (isinstance(node, ast.Assign)
                     and isinstance(node.value, ast.Call)):
                 continue
             fname = _callee_name(node.value.func)
-            if fname and _STEP_HINT in fname.lower():
+            if fname and is_source(fname):
                 for target in node.targets:
                     elts = (target.elts if isinstance(target, ast.Tuple)
                             else [target])
@@ -210,22 +244,30 @@ class _Linter(ast.NodeVisitor):
                 expr = expr.value
             return isinstance(expr, ast.Name) and expr.id in tainted
 
+        fix = RULES[rule].split("; ")[1]
         for node in ast.walk(loop):
             if not isinstance(node, ast.Call):
                 continue
             func = node.func
             if (isinstance(func, ast.Name) and func.id in ("float", "int")
                     and node.args and tainted_value(node.args[0])):
-                self._emit(node, "JX105",
-                           f"{func.id}() on a step output blocks the host "
-                           "mid-pipeline; " + RULES["JX105"].split("; ")[1])
+                self._emit(node, rule,
+                           f"{func.id}() on {noun} blocks the host "
+                           f"{where}; {fix}")
             elif (isinstance(func, ast.Attribute)
                     and func.attr in ("item", "tolist")
                     and tainted_value(func.value)):
-                self._emit(node, "JX105",
-                           f".{func.attr}() on a step output blocks the "
-                           "host mid-pipeline; "
-                           + RULES["JX105"].split("; ")[1])
+                self._emit(node, rule,
+                           f".{func.attr}() on {noun} blocks the "
+                           f"host {where}; {fix}")
+            elif (flag_np and isinstance(func, ast.Attribute)
+                    and func.attr in _HOST_NP_CALLS
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id in _NUMPY_ALIASES
+                    and node.args and tainted_value(node.args[0])):
+                self._emit(node, rule,
+                           f"np.{func.attr}() on {noun} blocks the "
+                           f"host {where}; {fix}")
 
     def visit_Call(self, node: ast.Call) -> None:
         if _is_jit_func(node.func) and self.loop_depth > 0:

@@ -1,7 +1,7 @@
 """perf_smoke — fast, CPU-safe check that the perf subsystems actually
 engage.
 
-Two gates, both counted at instrumented seams (no timing, so they cannot
+Three gates, all counted at instrumented seams (no timing, so they cannot
 flake on a loaded CI box):
 
 * **pipeline fusion** — the planner executes the canonical image pipeline
@@ -14,6 +14,12 @@ flake on a loaded CI box):
   consumption: ``committed_ahead_max >= prefetch_depth``, every batch
   flows through exactly once, and the input-wait/step-time decomposition
   is reported.
+* **serve dynamic batching** — a burst of concurrent single-row requests
+  through the model server compiles at most ``len(buckets)`` programs
+  (bucket quantization holds: no per-shape recompile, counted at the
+  jitted composite's own compile cache AND at the dispatch-shape seam)
+  and coalesces to a mean batch occupancy > 1 (the batcher actually
+  batches under load).
 
 The same checks run in tier-1 as tests/test_perf_smoke.py; this entry
 point is the ``BENCH_FAST=1``-style standalone for CI wiring:
@@ -124,15 +130,83 @@ def check_train_prefetch() -> dict:
     }
 
 
+def check_serve_batching() -> dict:
+    """Burst the model server with concurrent single-row requests; raise
+    AssertionError unless bucket quantization bounded the compiles and
+    requests actually coalesced."""
+    from mmlspark_tpu.core import plan
+    from mmlspark_tpu.data.table import DataTable
+    from mmlspark_tpu.models.jax_model import JaxModel
+    from mmlspark_tpu.models.zoo import get_model
+    from mmlspark_tpu.serve import ModelServer, ServeConfig
+
+    buckets, n_req = (1, 8, 32), 64
+    bundle = get_model("ConvNet_CIFAR10", widths=(8, 16), dense_width=32)
+    jm = JaxModel(model=bundle, input_col="image", output_col="scores")
+    rng = np.random.default_rng(0)
+    rows = rng.integers(0, 255, (n_req, 32 * 32 * 3)).astype(np.uint8)
+
+    server = ModelServer(ServeConfig(buckets=buckets, max_queue=n_req,
+                                     deadline_ms=None))
+    try:
+        # example rows warm the full ladder at load: every bucket's
+        # program exists before the first request
+        server.add_model("cnn", jm,
+                         example=DataTable({"image": [rows[0]]}))
+        warmed = server.compiled_programs("cnn")
+        # count the burst's H2D uploads at the planner's own seam: the
+        # distinct upload shapes are the ground-truth recompile surface,
+        # independent of anything the serve layer reports about itself
+        with plan.count_crossings() as crossings:
+            handles = [server.submit("cnn",
+                                     DataTable({"image": [rows[i]]}))
+                       for i in range(n_req)]
+            outs = [h.result(timeout=300) for h in handles]
+        snap = server.stats("cnn").snapshot()
+        programs = server.compiled_programs("cnn")
+    finally:
+        server.close()
+
+    assert all(len(o) == 1 and "scores" in o for o in outs)
+    if programs is not None:  # the compile-counter hook (jit cache size)
+        assert programs <= len(buckets), (
+            f"{programs} XLA programs compiled for a {len(buckets)}-bucket "
+            "ladder — requests are recompiling per shape instead of "
+            "quantizing to the ladder")
+    assert snap["distinct_batch_shapes"] <= len(buckets), (
+        f"{snap['distinct_batch_shapes']} distinct batch shapes dispatched "
+        f"for a {len(buckets)}-bucket ladder")
+    assert len(crossings.upload_shapes) <= len(buckets), (
+        f"{len(crossings.upload_shapes)} distinct upload shapes at the "
+        f"planner seam ({sorted(crossings.upload_shapes)}) for a "
+        f"{len(buckets)}-bucket ladder — per-shape recompiles")
+    occ = snap["batch_occupancy_mean"]
+    assert occ is not None and occ > 1.0, (
+        f"mean batch occupancy {occ} under a {n_req}-request burst — the "
+        "dynamic batcher is not coalescing")
+    assert snap["completed"] == n_req
+    return {
+        "buckets": list(buckets),
+        "requests": n_req,
+        "programs_warmed": warmed,
+        "programs_compiled": programs,
+        "distinct_batch_shapes": snap["distinct_batch_shapes"],
+        "distinct_upload_shapes": len(crossings.upload_shapes),
+        "batches": snap["batches"],
+        "batch_occupancy_mean": occ,
+    }
+
+
 def main() -> int:
     try:
         result = check_fused_crossings()
         train = check_train_prefetch()
+        serve = check_serve_batching()
     except AssertionError as e:
         print(json.dumps({"perf_smoke": "FAIL", "reason": str(e)}))
         return 1
     print(json.dumps({"perf_smoke": "OK", **result,
-                      "train_prefetch": train}))
+                      "train_prefetch": train, "serve": serve}))
     return 0
 
 

@@ -1,0 +1,131 @@
+"""serve — online model-server CLI.
+
+Usage::
+
+    python tools/serve.py <model-path> [--name NAME] [--host H] [--port P]
+        [--buckets 1,8,32,128] [--max-queue N] [--deadline-ms D]
+        [--schema schema.json] [--no-warmup]
+
+``<model-path>`` is any of
+
+* a directory saved with ``stage.save()`` (``metadata.json`` inside) — a
+  ``PipelineModel`` or any fitted transformer;
+* a single ``ModelBundle`` file (``tools/build_model_repo.py`` output) —
+  wrapped in a ``JaxModel`` reading column ``input``, writing ``scores``;
+* a model *repository* directory (``MANIFEST.json`` inside) — every
+  manifest entry is loaded and served under its manifest name.
+
+Every model is validated by the pre-flight analyzer at load time (the
+load fails fast — exit 2 with the diagnostics — before any device work),
+and the bucket ladder is warmed when a concrete input schema is known
+(``--schema``, or derived from the bundle's input_spec).
+
+``--schema`` takes the same JSON column-spec file as ``tools/analyze.py``.
+
+Prints one JSON line when serving starts; Ctrl-C drains in-flight
+requests and exits.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _load_models(path: str, name: str | None) -> list[tuple[str, object]]:
+    """[(serve name, model object), ...] for any supported model path."""
+    from mmlspark_tpu.core.stage import PipelineStage
+    from mmlspark_tpu.data.downloader import (
+        MANIFEST_NAME, Repository, load_bundle_file,
+    )
+
+    if os.path.isdir(path):
+        if os.path.exists(os.path.join(path, "metadata.json")):
+            stage = PipelineStage.load(path)
+            return [(name or os.path.basename(os.path.normpath(path)),
+                     stage)]
+        if os.path.exists(os.path.join(path, MANIFEST_NAME)):
+            repo = Repository(path)
+            out = []
+            for entry in repo.read_manifest():
+                bundle = load_bundle_file(os.path.join(path, entry.uri))
+                out.append((entry.name, bundle))
+            return out
+        raise SystemExit(
+            f"{path}: neither a saved stage (metadata.json) nor a model "
+            f"repository ({MANIFEST_NAME})")
+    bundle = load_bundle_file(path)
+    return [(name or bundle.name, bundle)]
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="serve", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("model", help="saved stage dir, bundle file, or "
+                                  "model-repo dir")
+    ap.add_argument("--name", default=None,
+                    help="serve name (default: dir/bundle name)")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--buckets", default="1,8,32,128",
+                    help="comma-separated batch bucket ladder")
+    ap.add_argument("--max-queue", type=int, default=128,
+                    help="queued requests per model before Overloaded")
+    ap.add_argument("--deadline-ms", type=float, default=1000.0,
+                    help="default per-request deadline (0 = none)")
+    ap.add_argument("--schema", default=None,
+                    help="JSON column-spec file (tools/analyze.py format) "
+                         "used for validation + bucket warmup")
+    ap.add_argument("--no-warmup", action="store_true",
+                    help="skip compiling the bucket ladder at load")
+    args = ap.parse_args(argv if argv is not None else sys.argv[1:])
+
+    from mmlspark_tpu.serve import ModelLoadError, ModelServer, ServeConfig
+    from mmlspark_tpu.serve.http import start_http_server
+
+    schema = None
+    if args.schema:
+        from mmlspark_tpu.analysis import TableSchema
+        with open(args.schema, "r", encoding="utf-8") as fh:
+            schema = TableSchema.from_spec(json.load(fh))
+
+    config = ServeConfig(
+        buckets=tuple(int(b) for b in args.buckets.split(",")),
+        max_queue=args.max_queue,
+        deadline_ms=args.deadline_ms or None,
+        warmup=not args.no_warmup)
+    server = ModelServer(config)
+    try:
+        for model_name, model in _load_models(args.model, args.name):
+            server.add_model(model_name, model, schema=schema)
+    except ModelLoadError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+
+    httpd = start_http_server(server, args.host, args.port,
+                              background=False)
+    print(json.dumps({
+        "serving": server.models(),
+        "host": httpd.server_address[0],
+        "port": httpd.server_address[1],
+        "buckets": list(config.buckets),
+        "max_queue": config.max_queue,
+        "deadline_ms": config.deadline_ms,
+    }), flush=True)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+        server.close(drain=True)  # answer everything admitted
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
