@@ -493,12 +493,33 @@ def main() -> None:
         cur = warm
         for s in stages:
             cur = s.transform(cur)
+        # the timed fused pass runs UNTRACED (tracer-on would bias the
+        # fused-vs-unfused A/B with span/counter work the baseline never
+        # pays); a separate small traced pass below cross-checks that the
+        # obs registry reads EXACTLY what the seam-patching counter reads
+        # (one substrate — docs/observability.md), so every PERF_NOTES
+        # round double-checks the numbers the runtime exports
         with plan_lib.count_crossings() as cnt:
             t0 = time.perf_counter()
             pm.transform(ptable)
             fused_dt = time.perf_counter() - t0
         pipe_crossings = {"fused_h2d": cnt.uploads, "fused_d2h": cnt.fetches,
                           "fused_h2d_mb": round(cnt.upload_bytes / 2**20, 2)}
+        from mmlspark_tpu import obs
+        obs.registry().reset()
+        obs.enable()
+        try:
+            with plan_lib.count_crossings() as chk:
+                pm.transform(warm)  # untimed: the obs-agreement pass
+        finally:
+            obs.disable()
+        obs_counters = obs.registry().snapshot()["counters"]
+        obs.clear()
+        obs.registry().reset()
+        pipe_crossings["obs_agrees"] = (
+            obs_counters.get("plan.h2d_uploads", 0) == chk.uploads
+            and obs_counters.get("plan.d2h_fetches", 0) == chk.fetches
+            and obs_counters.get("plan.h2d_bytes", 0) == chk.upload_bytes)
         with plan_lib.count_crossings() as cnt:
             t0 = time.perf_counter()
             cur = ptable

@@ -26,6 +26,22 @@ _DEFAULTS: dict[str, Any] = {
 
 _overrides: dict[str, Any] = {}
 
+# change listeners: fn(name) called after set()/reset() commits (name is
+# "*" for a full reset). How already-created consumers (loggers caching
+# their level, the obs runtime) honor later config changes without
+# polling — keep callbacks idempotent and exception-free
+_listeners: list[Callable[[str], None]] = []
+
+
+def subscribe(fn: Callable[[str], None]) -> None:
+    """Register a change listener (process lifetime; no unsubscribe)."""
+    _listeners.append(fn)
+
+
+def _notify(name: str) -> None:
+    for fn in list(_listeners):
+        fn(name)
+
 
 def get(name: str, default: Any = None) -> Any:
     if name in _overrides:
@@ -43,10 +59,13 @@ def get(name: str, default: Any = None) -> Any:
 
 def set(name: str, value: Any) -> None:  # noqa: A001 - config namespace
     _overrides[name] = value
+    _notify(name)
 
 
 def reset(name: str | None = None) -> None:
     if name is None:
         _overrides.clear()
+        _notify("*")
     else:
         _overrides.pop(name, None)
+        _notify(name)

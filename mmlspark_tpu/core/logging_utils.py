@@ -5,6 +5,11 @@ and the ``Timer`` wrapper stage's measurement core
 (pipeline-stages/src/main/scala/Timer.scala:54-123). The pipeline-visible
 ``TimerStage`` lives in ``mmlspark_tpu.stages.misc``; this module provides
 the timing primitive and the logger factory.
+
+When the obs tracer is enabled (docs/observability.md), :func:`timed`
+additionally records a span and a per-label duration histogram into the
+shared registry — log output is byte-identical either way, so enabling
+observability never changes what operators grep for.
 """
 
 from __future__ import annotations
@@ -16,6 +21,23 @@ from typing import Iterator
 
 from mmlspark_tpu.core import config
 
+# loggers this factory configured, re-leveled when config changes (a
+# logger created at import time must honor a later
+# ``config.set("log_level", ...)`` — the level is a live setting, not a
+# first-call snapshot)
+_configured: set[str] = set()
+
+
+def _apply_log_level(changed: str) -> None:
+    if changed not in ("log_level", "*"):
+        return
+    level = config.get("log_level")
+    for name in list(_configured):
+        logging.getLogger(name).setLevel(level)
+
+
+config.subscribe(_apply_log_level)
+
 
 def get_logger(name: str = "mmlspark_tpu") -> logging.Logger:
     logger = logging.getLogger(name)
@@ -26,6 +48,7 @@ def get_logger(name: str = "mmlspark_tpu") -> logging.Logger:
         logger.addHandler(handler)
         logger.setLevel(config.get("log_level"))
         logger.propagate = False
+    _configured.add(name)
     return logger
 
 
@@ -33,13 +56,30 @@ def get_logger(name: str = "mmlspark_tpu") -> logging.Logger:
 def timed(label: str, logger: logging.Logger | None = None,
           rows: int | None = None) -> Iterator[dict]:
     """Context manager measuring wall time; yields a dict that receives
-    ``elapsed_s`` on exit. Logs when the ``timings`` config flag is on."""
+    ``elapsed_s`` on exit. Logs when the ``timings`` config flag is on.
+
+    Routed through obs when tracing is enabled: the block becomes a span
+    (category ``timed``) and the duration lands in the shared
+    ``timed_s{label=...}`` histogram, so every pre-obs `timed` call site
+    (fused segments, trainer epochs, bridge chunks) shows up on the
+    exported timeline without re-instrumentation."""
+    from mmlspark_tpu.obs import runtime as _obs_rt
+    from mmlspark_tpu.obs.metrics import registry as _obs_registry
+    from mmlspark_tpu.obs.spans import span as _obs_span
+
     record: dict = {"label": label}
     t0 = time.perf_counter()
+    obs_span = _obs_span(label, "timed",
+                         None if rows is None else {"rows": rows})
+    obs_span.__enter__()
     try:
         yield record
     finally:
         record["elapsed_s"] = time.perf_counter() - t0
+        obs_span.__exit__(None, None, None)
+        if _obs_rt._enabled:
+            _obs_registry().histogram(
+                "timed_s", label=label).observe(record["elapsed_s"])
         if config.get("timings") and logger is not None:
             extra = f" ({rows} rows)" if rows is not None else ""
             logger.info("%s took %.3fs%s", label, record["elapsed_s"], extra)

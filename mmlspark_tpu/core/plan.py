@@ -39,6 +39,9 @@ from mmlspark_tpu.core.logging_utils import get_logger, timed
 from mmlspark_tpu.core.schema import is_image_column
 from mmlspark_tpu.core.stage import ArrayMeta, DeviceOp, DeviceStage
 from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.obs import runtime as _obs_rt
+from mmlspark_tpu.obs.metrics import registry as _obs_registry
+from mmlspark_tpu.obs.spans import span as _obs_span
 
 _log = get_logger(__name__)
 
@@ -66,16 +69,32 @@ def minibatches(batch: np.ndarray, size: int
 
 # ---- the H2D / D2H crossing points. Every device entry and exit of the
 #      minibatch pipeline goes through these two functions, so crossing
-#      counts are observable (tools/perf_smoke.py monkeypatches them) ----
+#      counts are observable: tools/perf_smoke.py monkeypatches them, and
+#      the obs registry counts them (plan.h2d_uploads / plan.h2d_bytes /
+#      plan.d2h_fetches, plus one plan.h2d_shapes series per distinct
+#      upload shape — the recompile observable) when tracing is on ----
 
 def _upload(chunk: np.ndarray, target: Any) -> Any:
     """ONE host→device transfer of one minibatch."""
     import jax
+    if _obs_rt._enabled:
+        nbytes = int(getattr(chunk, "nbytes", 0))
+        shape = getattr(chunk, "shape", None)
+        reg = _obs_registry()
+        reg.counter("plan.h2d_uploads").add()
+        reg.counter("plan.h2d_bytes").add(nbytes)
+        if shape is not None:
+            reg.counter("plan.h2d_shapes",
+                        shape=str(tuple(shape))).add()
+        with _obs_span("plan/h2d", "plan", {"bytes": nbytes}):
+            return jax.device_put(chunk, target)
     return jax.device_put(chunk, target)
 
 
 def _issue_fetch(outs: tuple) -> None:
     """ONE async device→host fetch round for one minibatch's outputs."""
+    if _obs_rt._enabled:
+        _obs_registry().counter("plan.d2h_fetches").add()
     for o in outs:
         o.copy_to_host_async()
 
@@ -144,14 +163,24 @@ def _windowed_dispatch(fn: Callable, dev_params: Any, batch: np.ndarray,
 
     def drain_one() -> None:
         outs, valid = window.popleft()
-        pieces.append([np.asarray(o)[:valid] for o in outs])
+        with _obs_span("plan/d2h", "plan"):
+            host = [np.asarray(o)[:valid] for o in outs]
+        if _obs_rt._enabled:
+            _obs_registry().counter("plan.d2h_bytes").add(
+                sum(int(h.nbytes) for h in host))
+        pieces.append(host)
 
     for chunk, valid in minibatches(batch, size):
         shapes.append(tuple(chunk.shape))
-        outs = fn(dev_params, _upload(chunk, target))
-        if not isinstance(outs, tuple):
-            outs = (outs,)
-        _issue_fetch(outs)
+        # labels built only when tracing: the disabled path allocates
+        # nothing beyond the span() call itself (perf_smoke's < 2% gate)
+        labels = ({"shape": str(tuple(chunk.shape))}
+                  if _obs_rt._enabled else None)
+        with _obs_span("plan/dispatch", "plan", labels):
+            outs = fn(dev_params, _upload(chunk, target))
+            if not isinstance(outs, tuple):
+                outs = (outs,)
+            _issue_fetch(outs)
         window.append((outs, valid))
         # drain to inflight-1 so at most max_inflight minibatch outputs are
         # ever device-resident (the documented HBM bound)
@@ -446,6 +475,15 @@ def _compile_segment(seg: _Segment) -> tuple:
     (single-device meshes take the plain-placement fast path — sharded
     transfers cost a round-trip per shard through remote-device
     tunnels, PERF_NOTES round 2)."""
+    if _obs_rt._enabled:
+        names = "→".join(type(s).__name__ for s in seg.stages)
+        _obs_registry().counter("plan.segment_compiles").add()
+        with _obs_span("plan/compile_segment", "plan", {"stages": names}):
+            return _compile_segment_inner(seg)
+    return _compile_segment_inner(seg)
+
+
+def _compile_segment_inner(seg: "_Segment") -> tuple:
     import jax
 
     from mmlspark_tpu.parallel import mesh as mesh_lib
@@ -645,8 +683,10 @@ def dispatch_segment(seg: _Segment, table: DataTable,
     fn, dev_params, target, dp = _cached_segment(seg, cache_host)
     bound, max_inflight = _segment_minibatch(seg)
     size = dp_rounded_minibatch(min(bound, len(batch)), dp, len(batch))
-    pieces, shapes, drain_rest = _windowed_dispatch(
-        fn, dev_params, batch, size, target, max_inflight)
+    labels = {"rows": len(batch)} if _obs_rt._enabled else None
+    with _obs_span("plan/serve_dispatch", "plan", labels):
+        pieces, shapes, drain_rest = _windowed_dispatch(
+            fn, dev_params, batch, size, target, max_inflight)
 
     def finish() -> DataTable:
         drain_rest()

@@ -38,6 +38,9 @@ from mmlspark_tpu.core import fs as _fs
 from mmlspark_tpu.core.logging_utils import get_logger
 from mmlspark_tpu.core.schema import make_image, mark_image_column
 from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.obs import runtime as _obs_rt
+from mmlspark_tpu.obs.metrics import registry as _obs_registry
+from mmlspark_tpu.obs.spans import span as _obs_span
 
 _log = get_logger(__name__)
 
@@ -216,15 +219,20 @@ def _decode_chunk(raw: DataTable, drop_invalid: bool, image_col: str,
         return (p, decode_image(b))
 
     records = list(zip(raw["path"], raw["bytes"]))
-    if len(records) > 1 and pool is not None:
-        decoded = list(pool.map(decode_one, records))
-    elif len(records) > 1 and num_threads > 1:
-        # one-shot callers (read_images) still get a pool for this chunk;
-        # num_threads <= 1 stays strictly sequential
-        with ThreadPoolExecutor(max_workers=num_threads) as one_shot:
-            decoded = list(one_shot.map(decode_one, records))
-    else:
-        decoded = [decode_one(r) for r in records]
+    # decode-pool span: one interval per chunk on the pulling thread (the
+    # train-input producer when streaming), so a timeline shows decode
+    # pressure against assemble/commit/step directly
+    with _obs_span("data/decode_chunk", "data",
+                   {"rows": len(records)} if _obs_rt._enabled else None):
+        if len(records) > 1 and pool is not None:
+            decoded = list(pool.map(decode_one, records))
+        elif len(records) > 1 and num_threads > 1:
+            # one-shot callers (read_images) still get a pool for this
+            # chunk; num_threads <= 1 stays strictly sequential
+            with ThreadPoolExecutor(max_workers=num_threads) as one_shot:
+                decoded = list(one_shot.map(decode_one, records))
+        else:
+            decoded = [decode_one(r) for r in records]
 
     images, n_bad = [], 0
     for p, arr in decoded:
@@ -238,6 +246,11 @@ def _decode_chunk(raw: DataTable, drop_invalid: bool, image_col: str,
         _log.warning("read_images: %d/%d files failed to decode%s",
                      n_bad, len(decoded),
                      " (dropped)" if drop_invalid else " (kept as None)")
+    if _obs_rt._enabled:
+        reg = _obs_registry()
+        reg.counter("data.images_decoded").add(len(decoded) - n_bad)
+        if n_bad:
+            reg.counter("data.decode_failures").add(n_bad)
     table = DataTable({image_col: images})
     return mark_image_column(table, image_col)
 

@@ -1,0 +1,65 @@
+"""Unified observability — structured tracing, metrics, timeline export.
+
+The reference's observability story is wall-clock logging (the ``Timer``
+stage, reference: pipeline-stages/src/main/scala/Timer.scala:54-123). This
+repo's hot paths — the fused device plan (``core/plan.py``), the
+prefetching train input pipeline (``train/input.py``), and the
+dynamic-batching server (``serve/``) — each grew their own accounting;
+this package is the ONE telemetry substrate they all record into, in the
+spirit of Dapper-style span tracing and the XProf/Perfetto device
+timeline:
+
+* :mod:`~mmlspark_tpu.obs.metrics` — a process-wide, thread-safe
+  **metrics registry**: counters, gauges, and windowed histograms
+  (p50/p95/p99), labeled (model/stage/bucket/loader).
+* :mod:`~mmlspark_tpu.obs.spans` — a **structured span/event tracer**:
+  nested spans with wall + thread timestamps into a bounded ring buffer.
+  Disabled (the default) it is a single module-level flag check returning
+  a shared null context — no allocation, no locking.
+* :mod:`~mmlspark_tpu.obs.export` — **exporters**: a JSON metrics
+  snapshot and Chrome-trace/Perfetto ``trace_event`` JSON; host spans can
+  additionally enter ``jax.profiler`` annotations
+  (``enable(device_annotations=True)``) so an XProf capture interleaves
+  them with the device timeline.
+* :mod:`~mmlspark_tpu.obs.runtime` — enable/disable plus the jit
+  compile-cache hook (promoted here from the serve layer).
+
+Everything is CPU-safe and jax-free at import time. See
+docs/observability.md for the architecture and the instrumented seams.
+"""
+
+from mmlspark_tpu.obs.events import EventRecord, SpanRecord  # noqa: F401
+from mmlspark_tpu.obs.metrics import (  # noqa: F401
+    Counter, Gauge, Histogram, MetricsRegistry, registry,
+)
+from mmlspark_tpu.obs.runtime import (  # noqa: F401
+    clear, compiled_programs, disable, enable, enabled,
+)
+from mmlspark_tpu.obs.runtime import spans as captured  # noqa: F401
+from mmlspark_tpu.obs.spans import event, span  # noqa: F401
+from mmlspark_tpu.obs.export import (  # noqa: F401
+    chrome_trace, metrics_snapshot, write_chrome_trace, write_snapshot,
+)
+
+__all__ = [
+    "Counter",
+    "EventRecord",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "SpanRecord",
+    "captured",
+    "chrome_trace",
+    "clear",
+    "compiled_programs",
+    "disable",
+    "enable",
+    "enabled",
+    "event",
+    "metrics_snapshot",
+    "registry",
+    "span",
+    "spans",
+    "write_chrome_trace",
+    "write_snapshot",
+]

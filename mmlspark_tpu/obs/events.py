@@ -1,0 +1,75 @@
+"""Trace records — the data the span tracer writes and exporters read.
+
+Plain slotted records (no dataclass machinery on the hot path) holding
+wall timestamps in integer nanoseconds (``time.perf_counter_ns`` epoch —
+monotonic, comparable across threads of one process) plus the thread
+identity Chrome-trace lanes group by.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class SpanRecord:
+    """One completed span: a named, labeled interval on one thread."""
+
+    __slots__ = ("name", "cat", "start_ns", "dur_ns", "tid", "thread_name",
+                 "span_id", "parent_id", "depth", "labels")
+
+    def __init__(self, name: str, cat: str, start_ns: int, dur_ns: int,
+                 tid: int, thread_name: str, span_id: int,
+                 parent_id: int | None, depth: int,
+                 labels: dict | None):
+        self.name = name
+        self.cat = cat
+        self.start_ns = start_ns
+        self.dur_ns = dur_ns
+        self.tid = tid
+        self.thread_name = thread_name
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.depth = depth
+        self.labels = labels
+
+    @property
+    def end_ns(self) -> int:
+        return self.start_ns + self.dur_ns
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "name": self.name, "cat": self.cat,
+            "start_ns": self.start_ns, "dur_ns": self.dur_ns,
+            "tid": self.tid, "thread_name": self.thread_name,
+            "span_id": self.span_id, "parent_id": self.parent_id,
+            "depth": self.depth, "labels": self.labels or {},
+        }
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"SpanRecord({self.name!r}, cat={self.cat!r}, "
+                f"dur={self.dur_ns / 1e6:.3f}ms, depth={self.depth})")
+
+
+class EventRecord:
+    """One instant event (a point, not an interval) on one thread."""
+
+    __slots__ = ("name", "cat", "ts_ns", "tid", "thread_name", "labels")
+
+    def __init__(self, name: str, cat: str, ts_ns: int, tid: int,
+                 thread_name: str, labels: dict | None):
+        self.name = name
+        self.cat = cat
+        self.ts_ns = ts_ns
+        self.tid = tid
+        self.thread_name = thread_name
+        self.labels = labels
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "name": self.name, "cat": self.cat, "ts_ns": self.ts_ns,
+            "tid": self.tid, "thread_name": self.thread_name,
+            "labels": self.labels or {},
+        }
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"EventRecord({self.name!r}, cat={self.cat!r})"

@@ -1,0 +1,113 @@
+"""Exporters: JSON metrics snapshot + Chrome-trace timeline.
+
+* :func:`metrics_snapshot` — the process-wide registry as one JSON-safe
+  dict (the ``/metrics`` endpoint body, merged with per-model serve
+  stats by the HTTP front end).
+* :func:`chrome_trace` — captured spans/events as ``trace_event`` JSON
+  (the Trace Event Format consumed by ``chrome://tracing`` and
+  Perfetto's legacy importer): complete ``"ph": "X"`` events with
+  microsecond ``ts``/``dur``, one ``tid`` lane per thread, span labels
+  in ``args``. Thread-name metadata events give lanes readable names.
+  Host spans recorded while ``enable(device_annotations=True)`` also
+  entered ``jax.profiler`` annotations, so a simultaneous XProf capture
+  carries the same names on its device timeline — load both traces in
+  Perfetto to correlate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+from mmlspark_tpu.obs import runtime as _rt
+from mmlspark_tpu.obs.events import EventRecord, SpanRecord
+from mmlspark_tpu.obs.metrics import registry
+
+
+def metrics_snapshot() -> dict:
+    """The default registry + tracer state, JSON-safe."""
+    return {
+        "enabled": _rt.enabled(),
+        "captured_spans": _rt.captured_count(),
+        "metrics": registry().snapshot(),
+    }
+
+
+def _args(labels: dict | None) -> dict:
+    if not labels:
+        return {}
+    return {str(k): (v if isinstance(v, (int, float, str, bool))
+                     or v is None else str(v))
+            for k, v in labels.items()}
+
+
+def chrome_trace(records: list | None = None) -> dict:
+    """``{"traceEvents": [...]}`` for the given records (default: the
+    runtime ring buffer). Spans become complete events (``ph: "X"``)
+    whose nesting Perfetto derives from interval containment per
+    ``tid``; instants become ``ph: "i"`` thread-scoped events."""
+    if records is None:
+        records = _rt.spans()
+    pid = os.getpid()
+    events: list[dict] = []
+    thread_names: dict[int, str] = {}
+    for r in records:
+        thread_names.setdefault(r.tid, r.thread_name)
+        if isinstance(r, SpanRecord):
+            events.append({
+                "name": r.name, "cat": r.cat, "ph": "X",
+                "ts": r.start_ns / 1e3, "dur": r.dur_ns / 1e3,
+                "pid": pid, "tid": r.tid,
+                "args": {**_args(r.labels), "span_id": r.span_id,
+                         **({"parent_id": r.parent_id}
+                            if r.parent_id is not None else {})},
+            })
+        elif isinstance(r, EventRecord):
+            events.append({
+                "name": r.name, "cat": r.cat, "ph": "i", "s": "t",
+                "ts": r.ts_ns / 1e3, "pid": pid, "tid": r.tid,
+                "args": _args(r.labels),
+            })
+    for tid, tname in thread_names.items():
+        events.append({
+            "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+            "args": {"name": tname},
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(path: str, records: list | None = None) -> str:
+    """Serialize :func:`chrome_trace` to ``path``; returns the path."""
+    payload = chrome_trace(records)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh)
+    return path
+
+
+def write_snapshot(path: str) -> str:
+    """Serialize :func:`metrics_snapshot` to ``path``; returns the path."""
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(metrics_snapshot(), fh, indent=2, default=str)
+    return path
+
+
+def summarize_spans(records: list | None = None,
+                    top: int = 20) -> list[dict]:
+    """Aggregate spans by name: calls, total/mean ms — the CLI's text
+    timeline (``tools/trace.py render``)."""
+    if records is None:
+        records = _rt.spans()
+    agg: dict[str, dict[str, Any]] = {}
+    for r in records:
+        if not isinstance(r, SpanRecord):
+            continue
+        row = agg.setdefault(r.name, {"name": r.name, "cat": r.cat,
+                                      "calls": 0, "total_ms": 0.0})
+        row["calls"] += 1
+        row["total_ms"] += r.dur_ns / 1e6
+    rows = sorted(agg.values(), key=lambda d: -d["total_ms"])[:top]
+    for row in rows:
+        row["total_ms"] = round(row["total_ms"], 3)
+        row["mean_ms"] = round(row["total_ms"] / row["calls"], 3)
+    return rows

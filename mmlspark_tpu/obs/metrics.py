@@ -1,0 +1,240 @@
+"""Process-wide, thread-safe metrics registry.
+
+Three primitive kinds, all labelable (``model=...``, ``stage=...``,
+``bucket=...``):
+
+* :class:`Counter` — monotonically increasing totals (crossings, bytes,
+  compiles, requests). Accepts float increments so accumulated seconds
+  fit the same primitive.
+* :class:`Gauge` — last-written value (queue depth, input-bound
+  fraction).
+* :class:`Histogram` — bounded-window observation reservoir with
+  p50/p95/p99 plus lifetime count/sum (latencies, occupancy). The window
+  bounds memory on long-lived processes; ``count``/``sum`` stay exact.
+
+A :class:`MetricsRegistry` interns metrics by ``(name, labels)`` so every
+call site asking for the same series gets the SAME object — recording is
+then lock-per-metric, never a registry-wide lock. The module-level
+:func:`registry` is the process-wide default every instrumented layer
+records into; subsystems that need instance-local lifetimes (e.g. one
+:class:`~mmlspark_tpu.serve.stats.ServerStats` per loaded model) build
+their own private ``MetricsRegistry`` from the same primitives.
+
+Recording is always allowed whether or not tracing is enabled — the
+*instrumented call sites* gate themselves on ``obs.enabled()`` so the
+disabled path stays a flag check (see docs/observability.md).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Iterator
+
+import numpy as np
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+def format_series(name: str, labels: tuple) -> str:
+    """``name{k=v,...}`` — the snapshot key (Prometheus-style)."""
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={v}" for k, v in labels)
+    return f"{name}{{{inner}}}"
+
+
+class Counter:
+    """Monotonic total. ``add`` is thread-safe; negative deltas raise."""
+
+    __slots__ = ("name", "labels", "_lock", "_value")
+
+    def __init__(self, name: str, labels: tuple = ()):
+        self.name = name
+        self.labels = labels
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def add(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name!r}: negative add {n}")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Last-written value (thread-safe set/add)."""
+
+    __slots__ = ("name", "labels", "_lock", "_value")
+
+    def __init__(self, name: str, labels: tuple = ()):
+        self.name = name
+        self.labels = labels
+        self._lock = threading.Lock()
+        self._value: float | None = None
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def add(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value = (self._value or 0.0) + n
+
+    @property
+    def value(self) -> float | None:
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Windowed observation reservoir with exact lifetime count/sum.
+
+    ``percentiles()`` interpolates p50/p95/p99 over the latest ``window``
+    observations exactly the way the pre-obs serve stats did
+    (``np.percentile`` linear interpolation), so re-backed snapshots are
+    value-identical.
+    """
+
+    __slots__ = ("name", "labels", "window", "_lock", "_values", "_count",
+                 "_sum")
+
+    def __init__(self, name: str, labels: tuple = (), window: int = 4096):
+        self.name = name
+        self.labels = labels
+        self.window = int(window)
+        self._lock = threading.Lock()
+        self._values: deque = deque(maxlen=self.window)
+        self._count = 0
+        self._sum = 0.0
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            self._values.append(v)
+            self._count += 1
+            self._sum += v
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def values(self) -> list[float]:
+        """The current window (oldest first)."""
+        with self._lock:
+            return list(self._values)
+
+    def mean(self, ndigits: int | None = 3) -> float | None:
+        """Mean over the WINDOW; None before any observation (the
+        pre-traffic-snapshot safety contract)."""
+        with self._lock:
+            if not self._values:
+                return None
+            m = float(np.mean(self._values))
+        return round(m, ndigits) if ndigits is not None else m
+
+    def percentiles(self, ndigits: int | None = 3) -> dict | None:
+        """``{"p50":, "p95":, "p99":, "n":}`` over the window; None when
+        empty — callers never divide by zero or percentile an empty
+        array."""
+        with self._lock:
+            if not self._values:
+                return None
+            arr = np.asarray(self._values, dtype=np.float64)
+        p50, p95, p99 = np.percentile(arr, [50, 95, 99])
+        if ndigits is not None:
+            p50, p95, p99 = (round(float(p), ndigits)
+                             for p in (p50, p95, p99))
+        return {"p50": float(p50), "p95": float(p95), "p99": float(p99),
+                "n": int(arr.size)}
+
+
+class MetricsRegistry:
+    """Interning factory + snapshot surface for one metrics namespace."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: dict[tuple, Any] = {}
+
+    def _get(self, kind: type, name: str, labels: dict,
+             **kwargs: Any) -> Any:
+        key = (kind.__name__, name, _label_key(labels))
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = kind(name, _label_key(labels), **kwargs)
+                self._metrics[key] = m
+            return m
+
+    def counter(self, name: str, **labels: Any) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str, **labels: Any) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(self, name: str, window: int = 4096,
+                  **labels: Any) -> Histogram:
+        return self._get(Histogram, name, labels, window=window)
+
+    def iter_metrics(self) -> Iterator[Any]:
+        with self._lock:
+            items = list(self._metrics.values())
+        yield from items
+
+    def series(self, name: str) -> list[Any]:
+        """Every metric registered under ``name`` (one per label set)."""
+        return [m for m in self.iter_metrics() if m.name == name]
+
+    def value(self, name: str, **labels: Any) -> float | None:
+        """Read a counter/gauge value without creating the series."""
+        key_c = ("Counter", name, _label_key(labels))
+        key_g = ("Gauge", name, _label_key(labels))
+        with self._lock:
+            m = self._metrics.get(key_c) or self._metrics.get(key_g)
+        return None if m is None else m.value
+
+    def snapshot(self) -> dict:
+        """One JSON-safe dict: ``{"counters": {...}, "gauges": {...},
+        "histograms": {...}}`` keyed ``name{label=value,...}``."""
+        out: dict = {"counters": {}, "gauges": {}, "histograms": {}}
+        for m in self.iter_metrics():
+            key = format_series(m.name, m.labels)
+            if isinstance(m, Counter):
+                v = m.value
+                out["counters"][key] = int(v) if v == int(v) else v
+            elif isinstance(m, Gauge):
+                out["gauges"][key] = m.value
+            elif isinstance(m, Histogram):
+                out["histograms"][key] = {
+                    "count": m.count,
+                    "sum": round(m.sum, 6),
+                    "mean_window": m.mean(),
+                    "percentiles": m.percentiles(),
+                }
+        return out
+
+    def reset(self) -> None:
+        """Drop every registered series (test isolation)."""
+        with self._lock:
+            self._metrics.clear()
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    """The process-wide default registry every instrumented seam uses."""
+    return _REGISTRY

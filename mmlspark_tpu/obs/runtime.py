@@ -1,0 +1,137 @@
+"""Obs runtime state: the enable flag, the span ring buffer, and the jit
+compile-cache hook.
+
+The tracer is OFF by default. Every instrumented seam (plan crossings,
+train input, serve dispatch, decode pools) guards itself with one read of
+this module's ``_enabled`` flag, so production paths that never enable
+observability pay a single attribute load + branch per seam — no
+allocation, no lock (the ``< 2%`` disabled-overhead gate in
+``tools/perf_smoke.py:check_obs_overhead``).
+
+Enable programmatically (``obs.enable()``), or from the environment with
+``MMLSPARK_TPU_OBS=1`` (read once at import through ``core.config``).
+
+The **compile-cache hook** lives here too: reading an XLA program count
+off a jitted callable's own compile cache was serve-local in PR 4
+(``DynamicBatcher.compiled_programs``); it is the process-wide recompile
+observable every layer wants, so :func:`jit_cache_size` /
+:func:`compiled_programs` are owned by obs and the serve layer delegates.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any
+
+from mmlspark_tpu.core import config
+from mmlspark_tpu.obs.events import EventRecord, SpanRecord
+
+DEFAULT_BUFFER = 65536
+
+# single module-level flag instrumented seams check; mutate only through
+# enable()/disable()
+_enabled = False
+# when True, spans additionally enter jax.profiler.TraceAnnotation so an
+# XProf/Perfetto capture interleaves host spans with the device timeline
+_device_annotations = False
+# bounded ring buffer of completed SpanRecord/EventRecord (oldest evicted)
+_buffer: deque = deque(maxlen=DEFAULT_BUFFER)
+_lock = threading.Lock()
+
+
+def enable(buffer_size: int = DEFAULT_BUFFER,
+           device_annotations: bool = False) -> None:
+    """Turn the tracer on. Idempotent; a changed ``buffer_size`` rebuilds
+    the ring buffer (keeping the newest records that fit)."""
+    global _enabled, _device_annotations, _buffer
+    with _lock:
+        if _buffer.maxlen != buffer_size:
+            _buffer = deque(_buffer, maxlen=int(buffer_size))
+        _device_annotations = bool(device_annotations)
+        _enabled = True
+
+
+def disable() -> None:
+    """Turn the tracer off (records already captured stay readable)."""
+    global _enabled
+    with _lock:
+        _enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def clear() -> None:
+    """Drop captured spans/events (metrics live in obs.metrics; clear
+    those via ``obs.registry().reset()``)."""
+    with _lock:
+        _buffer.clear()
+
+
+def record(item: SpanRecord | EventRecord) -> None:
+    """Append one finished record (deque.append is atomic under the GIL;
+    the ring bound makes the buffer safe to leave enabled forever)."""
+    _buffer.append(item)
+
+
+def spans() -> list:
+    """Snapshot of captured records, oldest first. (``list(deque)`` is a
+    single atomic C call — safe against concurrent ``record()``; a plain
+    comprehension over the live deque would raise ``RuntimeError`` when
+    another thread appends mid-iteration.)"""
+    return list(_buffer)
+
+
+def captured_count() -> int:
+    """O(1) record count (no buffer copy — the /metrics poll path)."""
+    return len(_buffer)
+
+
+def span_records() -> list[SpanRecord]:
+    return [r for r in spans() if isinstance(r, SpanRecord)]
+
+
+# ---- the jit compile-cache hook (promoted from serve/batcher.py) ----
+
+def jit_cache_size(jitted: Any) -> int | None:
+    """XLA executables in one jitted callable's compile cache; ``None``
+    when the jit object doesn't expose it (older jax)."""
+    size_of = getattr(jitted, "_cache_size", None)
+    if size_of is None:
+        return None
+    return int(size_of())
+
+
+def compiled_programs(cache_host: Any) -> int | None:
+    """Total XLA executables across ``cache_host``'s compiled-segment
+    cache (``core.plan._cached_segment``'s store) — the recompile
+    observable behind the serve bucket-ladder gate and ``tools/trace.py``.
+    ``None`` when any cached jit doesn't expose its cache size; ``0`` for
+    a host that never compiled a segment."""
+    host_dict = getattr(cache_host, "__dict__", {})
+    store = host_dict.get("_plan_cache")
+    if not store:
+        return 0
+    # snapshot under the plan lock: dispatch threads insert/evict entries
+    # concurrently, and iterating a mutating dict raises
+    lock = host_dict.get("_plan_lock")
+    if lock is not None:
+        with lock:
+            entries = list(store.values())
+    else:  # pragma: no cover - cache always created with its lock
+        entries = list(store.values())
+    total = 0
+    for _tokens, compiled, _pinned in entries:
+        size = jit_cache_size(compiled[0])
+        if size is None:
+            return None
+        total += size
+    return total
+
+
+# honor MMLSPARK_TPU_OBS=1 (or config.set("obs", True) before first
+# import) — the env-var path for tracing a production run without code
+if config.get("obs", False):  # pragma: no cover - env-dependent
+    enable()

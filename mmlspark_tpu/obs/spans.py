@@ -1,0 +1,122 @@
+"""The span/event tracer — nested, thread-aware, near-zero when off.
+
+Usage at an instrumented seam::
+
+    from mmlspark_tpu.obs import span, event
+
+    with span("plan/fused_segment", "plan", {"rows": n}):
+        ...
+    event("serve/overloaded", "serve")
+
+Disabled (the default), :func:`span` is ONE module-flag check returning a
+shared null context — no record, no allocation beyond the call itself.
+Enabled, each span captures wall-clock start/duration
+(``time.perf_counter_ns``), the owning thread, and its parent span on
+that thread (a thread-local stack), then lands in the bounded ring
+buffer (:mod:`~mmlspark_tpu.obs.runtime`). Exceptions propagate —
+tracing never swallows an error — and the span still records, so a
+timeline shows where a run died.
+
+With ``enable(device_annotations=True)`` each span also enters
+``jax.profiler.TraceAnnotation`` (via ``utils/profiling.annotate``), so
+an XProf/Perfetto device capture shows the same names on its host track,
+interleaved with the device ops dispatched under them.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Any
+
+from mmlspark_tpu.obs import runtime as _rt
+from mmlspark_tpu.obs.events import EventRecord, SpanRecord
+
+_tls = threading.local()
+_ids = itertools.count(1)  # CPython-atomic id source
+
+
+class _NullSpan:
+    """Shared do-nothing context for the disabled path."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> None:
+        return None
+
+    def __exit__(self, *exc: Any) -> bool:
+        return False
+
+
+_NULL = _NullSpan()
+
+
+def _annotation(name: str):
+    """A jax profiler annotation, or None when jax is unavailable — the
+    tracer must stay importable and usable on host-only processes."""
+    try:
+        from mmlspark_tpu.utils.profiling import annotate
+        return annotate(name)
+    except Exception:  # pragma: no cover - jax present throughout CI
+        return None
+
+
+class _Span:
+    __slots__ = ("name", "cat", "labels", "_t0", "_span_id", "_parent",
+                 "_depth", "_annot")
+
+    def __init__(self, name: str, cat: str, labels: dict | None):
+        self.name = name
+        self.cat = cat
+        self.labels = labels
+
+    def __enter__(self) -> "_Span":
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        self._span_id = next(_ids)
+        self._parent = stack[-1] if stack else None
+        self._depth = len(stack)
+        stack.append(self._span_id)
+        self._annot = None
+        if _rt._device_annotations:
+            annot = _annotation(self.name)
+            if annot is not None:
+                annot.__enter__()
+                self._annot = annot
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        dur = time.perf_counter_ns() - self._t0
+        if self._annot is not None:
+            self._annot.__exit__(*exc)
+        stack = _tls.stack
+        if stack and stack[-1] == self._span_id:
+            stack.pop()
+        th = threading.current_thread()
+        _rt.record(SpanRecord(self.name, self.cat, self._t0, dur,
+                              th.ident or 0, th.name, self._span_id,
+                              self._parent, self._depth, self.labels))
+        return False
+
+
+def span(name: str, cat: str = "host",
+         labels: dict | None = None) -> Any:
+    """Context manager tracing one interval; a shared no-op when the
+    tracer is disabled (``labels`` is a plain dict parameter, not
+    ``**kwargs``, so the disabled call allocates nothing)."""
+    if not _rt._enabled:
+        return _NULL
+    return _Span(name, cat, labels)
+
+
+def event(name: str, cat: str = "host",
+          labels: dict | None = None) -> None:
+    """Record one instant event (no interval); no-op when disabled."""
+    if not _rt._enabled:
+        return
+    th = threading.current_thread()
+    _rt.record(EventRecord(name, cat, time.perf_counter_ns(),
+                           th.ident or 0, th.name, labels))

@@ -37,6 +37,9 @@ import numpy as np
 
 from mmlspark_tpu.core.logging_utils import get_logger
 from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.obs import runtime as _obs_rt
+from mmlspark_tpu.obs.spans import event as _obs_event
+from mmlspark_tpu.obs.spans import span as _obs_span
 from mmlspark_tpu.serve.config import ServeConfig
 from mmlspark_tpu.serve.errors import (
     BadRequest, DeadlineExceeded, Overloaded, ServerClosed,
@@ -204,7 +207,7 @@ class DynamicBatcher:
         self.stages = list(stages)
         self.cache_host = cache_host
         self.config = config
-        self.stats = stats or ServerStats(config.stats_window)
+        self.stats = stats or ServerStats(config.stats_window, model=name)
         self._cv = threading.Condition()
         self._queue: deque[ServeRequest] = deque()
         self._closed = False     # admission stopped (drain in progress)
@@ -224,17 +227,22 @@ class DynamicBatcher:
         if n > self.config.max_bucket:
             self.config.bucket_for(n, self.name)  # raises BadRequest
         req = ServeRequest(self.name, table, deadline_ms, self.stats)
-        with self._cv:
-            if self._closed:
-                raise ServerClosed(
-                    f"model {self.name!r} is shutting down")
-            if len(self._queue) >= self.config.max_queue:
-                self.stats.record_rejected()
-                raise Overloaded(self.name, len(self._queue),
-                                 self.config.max_queue)
-            self._queue.append(req)
-            self.stats.record_admitted()
-            self._cv.notify()
+        labels = ({"model": self.name, "rows": n}
+                  if _obs_rt._enabled else None)
+        with _obs_span("serve/admit", "serve", labels):
+            with self._cv:
+                if self._closed:
+                    raise ServerClosed(
+                        f"model {self.name!r} is shutting down")
+                if len(self._queue) >= self.config.max_queue:
+                    self.stats.record_rejected()
+                    _obs_event("serve/overloaded", "serve",
+                               {"model": self.name})
+                    raise Overloaded(self.name, len(self._queue),
+                                     self.config.max_queue)
+                self._queue.append(req)
+                self.stats.record_admitted()
+                self._cv.notify()
         return req
 
     @property
@@ -306,16 +314,30 @@ class DynamicBatcher:
     def _dispatch(self, batch: list, rows: int, window: deque) -> None:
         from mmlspark_tpu.core import plan
         now = time.monotonic()
-        packed, bucket = self._pack(batch, rows)
+        # coalesce/pack + async dispatch spans: the packing work is what
+        # overlaps device compute of the previous batch, so the timeline
+        # shows the overlap (or its absence) directly
+        on = _obs_rt._enabled
+        with _obs_span("serve/pack", "serve",
+                       {"model": self.name, "requests": len(batch),
+                        "rows": rows} if on else None):
+            packed, bucket = self._pack(batch, rows)
         for r in batch:
             r._mark_dispatched(now)
-        pending = plan.transform_async(self.stages, packed, self.cache_host)
+        with _obs_span("serve/dispatch", "serve",
+                       {"model": self.name, "bucket": bucket}
+                       if on else None):
+            pending = plan.transform_async(self.stages, packed,
+                                           self.cache_host)
         window.append((pending, batch, rows, bucket, now))
 
     def _drain_one(self, window: deque) -> None:
         pending, batch, rows, bucket, t0 = window.popleft()
         try:
-            out = pending.result()
+            with _obs_span("serve/drain", "serve",
+                           {"model": self.name, "bucket": bucket}
+                           if _obs_rt._enabled else None):
+                out = pending.result()
         except BaseException as e:  # noqa: BLE001 — relayed per request
             _log.warning("ServeBatcher[%s]: batch of %d failed: %s",
                          self.name, rows, e)
@@ -425,27 +447,10 @@ class DynamicBatcher:
                          self.name, self.config.drain_timeout_s)
 
     def compiled_programs(self) -> int | None:
-        """XLA executables compiled for this model's serving entry — read
-        from the cached jitted composites' own compile caches (the
-        compile-counter hook the bucket-ladder tests assert against).
-        ``None`` when the jit object doesn't expose its cache size (older
-        jax) — callers fall back to ``stats.dispatch_shapes``."""
-        host_dict = getattr(self.cache_host, "__dict__", {})
-        store = host_dict.get("_plan_cache")
-        if not store:
-            return 0
-        # snapshot under the plan lock: the dispatch thread inserts/evicts
-        # entries concurrently, and iterating a mutating dict raises
-        lock = host_dict.get("_plan_lock")
-        if lock is not None:
-            with lock:
-                entries = list(store.values())
-        else:  # pragma: no cover - cache always created with its lock
-            entries = list(store.values())
-        total = 0
-        for _tokens, compiled, _pinned in entries:
-            size_of = getattr(compiled[0], "_cache_size", None)
-            if size_of is None:
-                return None
-            total += int(size_of())
-        return total
+        """XLA executables compiled for this model's serving entry — the
+        jit compile-cache hook, now owned by the obs subsystem
+        (:func:`mmlspark_tpu.obs.runtime.compiled_programs`) since every
+        layer wants the same recompile observable. ``None`` when the jit
+        object doesn't expose its cache size (older jax) — callers fall
+        back to ``stats.dispatch_shapes``."""
+        return _obs_rt.compiled_programs(self.cache_host)

@@ -13,7 +13,12 @@ Protocol (details + examples in docs/serving.md):
     asks for one, JSON otherwise. Deadline via ``X-Deadline-Ms``.
 
 * ``GET /healthz`` — liveness; ``GET /v1/models`` — the model list;
-  ``GET /v1/stats`` — every model's :class:`ServerStats` snapshot.
+  ``GET /v1/stats`` — every model's :class:`ServerStats` snapshot;
+  ``GET /metrics`` — the obs metrics view (process-wide registry merged
+  with every model's stats snapshot — docs/observability.md);
+  ``GET /trace`` — the captured span buffer as Chrome-trace
+  ``trace_event`` JSON (empty unless ``obs.enable()`` was called, e.g.
+  ``tools/serve.py --obs`` or ``MMLSPARK_TPU_OBS=1``).
 
 Typed serving errors map to status codes: ``Overloaded`` → 429,
 ``DeadlineExceeded`` → 504, ``ModelNotFound`` → 404, ``BadRequest`` (and
@@ -147,6 +152,15 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, {"models": self._ms.models()})
             elif self.path == "/v1/stats":
                 self._send_json(200, self._ms.snapshot())
+            elif self.path == "/metrics":
+                from mmlspark_tpu.obs import export as obs_export
+                self._send_json(200, {
+                    **obs_export.metrics_snapshot(),
+                    "models": self._ms.snapshot(),
+                })
+            elif self.path == "/trace":
+                from mmlspark_tpu.obs import export as obs_export
+                self._send_json(200, obs_export.chrome_trace())
             else:
                 self._send_json(404, {"error": "NotFound",
                                       "message": self.path})

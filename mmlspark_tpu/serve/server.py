@@ -160,7 +160,7 @@ class ModelServer:
         if not report.ok:
             raise ModelLoadError(name, report)
 
-        stats = ServerStats(self.config.stats_window)
+        stats = ServerStats(self.config.stats_window, model=name)
         batcher = DynamicBatcher(name, stages, cache_host, self.config,
                                  stats)
         try:

@@ -1,122 +1,171 @@
-"""ServerStats — honest per-model serving metrics.
+"""ServerStats — honest per-model serving metrics, obs-backed.
 
 Same accounting discipline as ``Trainer.input_stats``: every number is
 counted or timed at the seam where it happens (admission, pack, dispatch,
 drain, resolve), nothing is inferred, and the snapshot says exactly what
 was measured. Metrics glossary in docs/serving.md.
+
+Since the obs subsystem (docs/observability.md) the storage is the shared
+telemetry primitives — :class:`~mmlspark_tpu.obs.metrics.Counter` and
+windowed :class:`~mmlspark_tpu.obs.metrics.Histogram` in a per-model
+:class:`~mmlspark_tpu.obs.metrics.MetricsRegistry`, labeled
+``model=<name>``/``bucket=<n>`` — instead of a private deque-and-int
+class. ``snapshot()`` keys and values are unchanged (the percentile
+interpolation and rounding are the histogram's own), and the per-instance
+registry keeps one server's numbers isolated from another's (and from the
+process-wide registry plan/train record into; the ``/metrics`` endpoint
+merges both views).
 """
 
 from __future__ import annotations
 
 import threading
-from collections import deque
 
-import numpy as np
-
-
-def _percentiles(values) -> dict | None:
-    if not values:
-        return None
-    arr = np.asarray(values, dtype=np.float64)
-    p50, p95, p99 = np.percentile(arr, [50, 95, 99])
-    return {"p50": round(float(p50), 3), "p95": round(float(p95), 3),
-            "p99": round(float(p99), 3), "n": int(arr.size)}
+from mmlspark_tpu.obs.metrics import MetricsRegistry
 
 
 class ServerStats:
     """Thread-safe metrics surface of one served model."""
 
-    def __init__(self, window: int = 4096):
-        self._lock = threading.Lock()
+    def __init__(self, window: int = 4096, model: str = ""):
+        self.model = model
+        # per-instance registry: a reloaded model (or a second server in
+        # the same process/test) starts from zero, never from a prior
+        # instance's interned series
+        self.registry = MetricsRegistry()
+        self._window = int(window)
+        lbl = {"model": model} if model else {}
+        self._lbl = lbl
+        reg = self.registry
         # request-side counters (admission → terminal state)
-        self.admitted = 0
-        self.completed = 0
-        self.rejected_overload = 0   # Overloaded at submit
-        self.expired_deadline = 0    # cancelled in queue, before dispatch
-        self.timed_out = 0           # client gave up post-admission
-        self.failed = 0              # dispatch/model error relayed
+        self._admitted = reg.counter("serve.admitted", **lbl)
+        self._completed = reg.counter("serve.completed", **lbl)
+        self._rejected = reg.counter("serve.rejected_overload", **lbl)
+        self._expired = reg.counter("serve.expired_deadline", **lbl)
+        self._timed_out = reg.counter("serve.timed_out", **lbl)
+        self._failed = reg.counter("serve.failed", **lbl)
         # batch-side counters
-        self.batches = 0
-        self.rows_dispatched = 0
-        self.rows_padded = 0         # padding rows (bucket - occupancy)
+        self._batches = reg.counter("serve.batches", **lbl)
+        self._rows_dispatched = reg.counter("serve.rows_dispatched", **lbl)
+        self._rows_padded = reg.counter("serve.rows_padded", **lbl)
         # bounded reservoirs (latest `window` observations)
-        self._e2e_ms: deque = deque(maxlen=window)
-        self._queue_ms: deque = deque(maxlen=window)
-        self._device_ms: deque = deque(maxlen=window)
-        self._occupancy: deque = deque(maxlen=window)
-        self._bucket_batches: dict[int, int] = {}
+        self._e2e_ms = reg.histogram("serve.e2e_ms", window=window, **lbl)
+        self._queue_ms = reg.histogram("serve.queue_wait_ms",
+                                       window=window, **lbl)
+        self._device_ms = reg.histogram("serve.device_ms",
+                                        window=window, **lbl)
+        self._occupancy = reg.histogram("serve.batch_occupancy",
+                                        window=window, **lbl)
         # distinct batch shapes OBSERVED entering the device (reported by
         # the dispatch handle, one per uploaded chunk — not the intended
         # bucket label): for a fixed program each new shape is one XLA
         # compile, so this set is the recompile observable independent of
         # jit internals
+        self._shape_lock = threading.Lock()
         self.dispatch_shapes: set = set()
+
+    # back-compat int views of the counters (the pre-obs attributes)
+
+    @property
+    def admitted(self) -> int:
+        return int(self._admitted.value)
+
+    @property
+    def completed(self) -> int:
+        return int(self._completed.value)
+
+    @property
+    def rejected_overload(self) -> int:
+        return int(self._rejected.value)
+
+    @property
+    def expired_deadline(self) -> int:
+        return int(self._expired.value)
+
+    @property
+    def timed_out(self) -> int:
+        return int(self._timed_out.value)
+
+    @property
+    def failed(self) -> int:
+        return int(self._failed.value)
+
+    @property
+    def batches(self) -> int:
+        return int(self._batches.value)
+
+    @property
+    def rows_dispatched(self) -> int:
+        return int(self._rows_dispatched.value)
+
+    @property
+    def rows_padded(self) -> int:
+        return int(self._rows_padded.value)
 
     # -- request side --
 
     def record_admitted(self) -> None:
-        with self._lock:
-            self.admitted += 1
+        self._admitted.add()
 
     def record_rejected(self) -> None:
-        with self._lock:
-            self.rejected_overload += 1
+        self._rejected.add()
 
     def record_expired(self) -> None:
-        with self._lock:
-            self.expired_deadline += 1
+        self._expired.add()
 
     def record_timeout(self) -> None:
-        with self._lock:
-            self.timed_out += 1
+        self._timed_out.add()
 
     def record_failed(self) -> None:
-        with self._lock:
-            self.failed += 1
+        self._failed.add()
 
     def record_done(self, e2e_ms: float, queue_ms: float) -> None:
-        with self._lock:
-            self.completed += 1
-            self._e2e_ms.append(e2e_ms)
-            self._queue_ms.append(queue_ms)
+        self._completed.add()
+        self._e2e_ms.observe(e2e_ms)
+        self._queue_ms.observe(queue_ms)
 
     # -- batch side --
 
     def record_batch(self, bucket: int, occupancy: int, device_ms: float,
                      shapes: tuple = ()) -> None:
-        with self._lock:
-            self.batches += 1
-            self.rows_dispatched += occupancy
-            self.rows_padded += max(bucket - occupancy, 0)
-            self._device_ms.append(device_ms)
-            self._occupancy.append(occupancy)
-            self._bucket_batches[bucket] = (
-                self._bucket_batches.get(bucket, 0) + 1)
-            for s in shapes:
-                self.dispatch_shapes.add(tuple(s))
+        self._batches.add()
+        self._rows_dispatched.add(occupancy)
+        self._rows_padded.add(max(bucket - occupancy, 0))
+        self._device_ms.observe(device_ms)
+        self._occupancy.observe(occupancy)
+        self.registry.counter("serve.bucket_batches",
+                              bucket=int(bucket), **self._lbl).add()
+        if shapes:
+            with self._shape_lock:
+                for s in shapes:
+                    self.dispatch_shapes.add(tuple(s))
 
     # -- presentation --
 
     def snapshot(self) -> dict:
-        """One JSON-safe dict of everything measured so far."""
-        with self._lock:
-            occ = list(self._occupancy)
-            mean_occ = (round(float(np.mean(occ)), 3) if occ else None)
-            return {
-                "admitted": self.admitted,
-                "completed": self.completed,
-                "rejected_overload": self.rejected_overload,
-                "expired_deadline": self.expired_deadline,
-                "timed_out": self.timed_out,
-                "failed": self.failed,
-                "batches": self.batches,
-                "rows_dispatched": self.rows_dispatched,
-                "rows_padded": self.rows_padded,
-                "batch_occupancy_mean": mean_occ,
-                "occupancy_by_bucket": dict(
-                    sorted(self._bucket_batches.items())),
-                "e2e_ms": _percentiles(self._e2e_ms),
-                "queue_wait_ms": _percentiles(self._queue_ms),
-                "device_ms": _percentiles(self._device_ms),
-                "distinct_batch_shapes": len(self.dispatch_shapes),
-            }
+        """One JSON-safe dict of everything measured so far. Safe before
+        any traffic: empty histograms report ``None`` (never a
+        zero-division or an empty-array percentile)."""
+        buckets = {
+            int(dict(c.labels)["bucket"]): int(c.value)
+            for c in self.registry.series("serve.bucket_batches")
+        }
+        with self._shape_lock:
+            n_shapes = len(self.dispatch_shapes)
+        return {
+            "admitted": self.admitted,
+            "completed": self.completed,
+            "rejected_overload": self.rejected_overload,
+            "expired_deadline": self.expired_deadline,
+            "timed_out": self.timed_out,
+            "failed": self.failed,
+            "batches": self.batches,
+            "rows_dispatched": self.rows_dispatched,
+            "rows_padded": self.rows_padded,
+            "batch_occupancy_mean": self._occupancy.mean(),
+            "occupancy_by_bucket": dict(sorted(buckets.items())),
+            "e2e_ms": self._e2e_ms.percentiles(),
+            "queue_wait_ms": self._queue_ms.percentiles(),
+            "device_ms": self._device_ms.percentiles(),
+            "distinct_batch_shapes": n_shapes,
+        }

@@ -29,6 +29,9 @@ from mmlspark_tpu.core.stage import (
     Transformer,
 )
 from mmlspark_tpu.data.table import DataTable, to_py_scalar
+from mmlspark_tpu.obs import runtime as _obs_rt
+from mmlspark_tpu.obs.metrics import registry as _obs_registry
+from mmlspark_tpu.obs.spans import span as _obs_span
 
 _log = get_logger("stages.utility")
 
@@ -180,7 +183,12 @@ class ClassBalancerModel(Transformer, HasInputCol, HasOutputCol):
 
 class Timer(Estimator):
     """Wraps a stage and logs wall-time of its fit/transform
-    (reference: Timer.scala:54-123)."""
+    (reference: Timer.scala:54-123).
+
+    Routed through obs when tracing is on: fit/transform become spans and
+    land in per-stage ``stage_fit_s``/``stage_transform_s`` histograms in
+    the shared registry. Log lines are identical whether or not the
+    tracer is enabled."""
 
     stage = Param(default=None, doc="the wrapped stage", is_complex=True)
     log_to_console = Param(default=True, doc="print timing lines", type_=bool)
@@ -196,9 +204,17 @@ class Timer(Estimator):
             return stage.fit(table) if isinstance(stage, Estimator) else stage
         t0 = time.perf_counter()
         if isinstance(stage, Estimator):
-            model = stage.fit(table)
-            self._log(f"fit {type(stage).__name__} on {len(table)} rows took "
-                      f"{time.perf_counter() - t0:.3f}s")
+            name = type(stage).__name__
+            on = _obs_rt._enabled
+            with _obs_span(f"Timer[{name}].fit" if on else "", "timed",
+                           {"rows": len(table)} if on else None):
+                model = stage.fit(table)
+            elapsed = time.perf_counter() - t0
+            if _obs_rt._enabled:
+                _obs_registry().histogram("stage_fit_s",
+                                          stage=name).observe(elapsed)
+            self._log(f"fit {name} on {len(table)} rows took "
+                      f"{elapsed:.3f}s")
         else:
             model = stage
         return TimerModel(stage=model, log_to_console=self.log_to_console,
@@ -217,12 +233,20 @@ class TimerModel(Transformer):
     def transform(self, table: DataTable) -> DataTable:
         if self.disable:
             return self.stage.transform(table)
+        name = type(self.stage).__name__
         t0 = time.perf_counter()
-        out = self.stage.transform(table)
+        on = _obs_rt._enabled
+        with _obs_span(f"Timer[{name}].transform" if on else "", "timed",
+                       {"rows": len(table)} if on else None):
+            out = self.stage.transform(table)
+        elapsed = time.perf_counter() - t0
+        if _obs_rt._enabled:
+            _obs_registry().histogram("stage_transform_s",
+                                      stage=name).observe(elapsed)
         if self.log_to_console:
             _log.info(
-                f"transform {type(self.stage).__name__} on {len(table)} rows "
-                f"took {time.perf_counter() - t0:.3f}s")
+                f"transform {name} on {len(table)} rows "
+                f"took {elapsed:.3f}s")
         return out
 
 

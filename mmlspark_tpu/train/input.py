@@ -43,6 +43,9 @@ import time
 from typing import Any, Callable, Iterable, Iterator
 
 from mmlspark_tpu.core.logging_utils import get_logger
+from mmlspark_tpu.obs import runtime as _obs_rt
+from mmlspark_tpu.obs.metrics import registry as _obs_registry
+from mmlspark_tpu.obs.spans import span as _annotate
 
 _log = get_logger(__name__)
 
@@ -50,16 +53,10 @@ THREAD_PREFIX = "DeviceLoader"
 
 _ITEM, _ERROR, _DONE = "item", "error", "done"
 
-
-def _annotate(name: str):
-    """Profiler span (utils/profiling.annotate), inert if jax is absent —
-    the loader must stay importable for host-only assembly tests."""
-    try:
-        from mmlspark_tpu.utils.profiling import annotate
-        return annotate(name)
-    except Exception:  # pragma: no cover - jax always present in CI
-        import contextlib
-        return contextlib.nullcontext()
+# loader spans go through the obs tracer (obs.span): disabled they are a
+# flag check; enabled they land in the ring buffer, and with
+# obs.enable(device_annotations=True) they ALSO enter
+# jax.profiler.TraceAnnotation — the pre-obs behavior, now opt-in
 
 
 class DeviceLoader:
@@ -124,12 +121,13 @@ class DeviceLoader:
             while not self._stop.is_set():
                 t0 = time.perf_counter()
                 try:
-                    item = next(self._source)
+                    with _annotate(f"{self.name}/assemble", "train"):
+                        item = next(self._source)
                 except StopIteration:
                     break
                 self.assemble_s += time.perf_counter() - t0
                 t0 = time.perf_counter()
-                with _annotate(f"{self.name}/commit"):
+                with _annotate(f"{self.name}/commit", "train"):
                     out = self._commit(item)
                 self.commit_s += time.perf_counter() - t0
                 self.committed += 1
@@ -161,7 +159,7 @@ class DeviceLoader:
             # The full assemble+commit time counts as input wait so the
             # prefetch on/off decomposition stays comparable
             t0 = time.perf_counter()
-            with _annotate(f"{self.name}/input"):
+            with _annotate(f"{self.name}/input", "train"):
                 item = next(self._source)  # StopIteration ends iteration
                 self.assemble_s += time.perf_counter() - t0
                 t1 = time.perf_counter()
@@ -174,7 +172,7 @@ class DeviceLoader:
         if self._done:
             raise StopIteration
         t0 = time.perf_counter()
-        with _annotate(f"{self.name}/wait"):
+        with _annotate(f"{self.name}/wait", "train"):
             tag, val = self._q.get()
         self.wait_s += time.perf_counter() - t0
         if tag is _DONE:
@@ -270,7 +268,7 @@ def input_stats(loader: DeviceLoader, loop_s: float) -> dict:
     fetches that drain the device pipeline."""
     wait = loader.wait_s
     loop_s = max(float(loop_s), 0.0)
-    return {
+    stats = {
         "prefetch_depth": loader.depth,
         "batches": loader.consumed,
         "committed_ahead_max": loader.max_ahead,
@@ -281,3 +279,14 @@ def input_stats(loader: DeviceLoader, loop_s: float) -> dict:
         "assemble_s": round(loader.assemble_s, 4),
         "commit_s": round(loader.commit_s, 4),
     }
+    if _obs_rt._enabled:
+        # publish the same numbers into the process-wide registry (one
+        # gauge per key, labeled by loader), so `Trainer.input_stats`
+        # and the /metrics exporter read identical values — the "one
+        # telemetry substrate" contract (docs/observability.md)
+        reg = _obs_registry()
+        for key, val in stats.items():
+            reg.gauge(f"train.input.{key}", loader=loader.name).set(val)
+        reg.counter("train.input.batches_total",
+                    loader=loader.name).add(loader.consumed)
+    return stats

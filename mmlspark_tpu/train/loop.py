@@ -24,6 +24,9 @@ from typing import Any, Callable, Iterator
 import numpy as np
 
 from mmlspark_tpu.core.logging_utils import get_logger, timed
+from mmlspark_tpu.obs import runtime as _obs_rt
+from mmlspark_tpu.obs.metrics import registry as _obs_registry
+from mmlspark_tpu.obs.spans import span as _obs_span
 from mmlspark_tpu.parallel import mesh as mesh_lib
 
 _log = get_logger(__name__)
@@ -685,8 +688,14 @@ class Trainer:
             with timed(f"Trainer[{type(self.module).__name__}]", _log,
                        len(x)):
                 for gs, i, (dx, dy, dw) in loader:
-                    self.state, metrics = self.step_masked(
-                        self.state, dx, dy, dw)
+                    # the span times step DISPATCH (async issue), not
+                    # device compute — the honest host-side number; the
+                    # wait surfaces in the loader's wait span instead
+                    with _obs_span("train/step", "train"):
+                        self.state, metrics = self.step_masked(
+                            self.state, dx, dy, dw)
+                    if _obs_rt._enabled:
+                        _obs_registry().counter("train.steps").add()
                     if i % cfg.log_every == 0:
                         if pending is not None:
                             self.history.append(float(pending))  # lint-jax: allow(JX105) — one-step-lagged fetch
@@ -877,8 +886,11 @@ class Trainer:
             with timed(f"Trainer[{type(self.module).__name__}:stream]",
                        _log):
                 for gs, (dx, dy, dw) in loader:
-                    self.state, metrics = self.step_masked(
-                        self.state, dx, dy, dw)
+                    with _obs_span("train/step", "train"):
+                        self.state, metrics = self.step_masked(
+                            self.state, dx, dy, dw)
+                    if _obs_rt._enabled:
+                        _obs_registry().counter("train.steps").add()
                     if (gs - 1) % cfg.log_every == 0:
                         if pending is not None:
                             self.history.append(float(pending))  # lint-jax: allow(JX105) — one-step-lagged fetch

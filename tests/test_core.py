@@ -256,3 +256,26 @@ def test_profiler_trace_writes_events(tmp_path):
     files = glob.glob(os.path.join(d, "**", "*"), recursive=True)
     assert any(f.endswith((".pb", ".json.gz", ".xplane.pb"))
                or "trace" in os.path.basename(f) for f in files), files
+
+
+def test_log_level_config_change_applies_to_existing_loggers():
+    """Regression: get_logger snapshotted the log level at first call, so
+    a later ``config.set("log_level", ...)`` silently did nothing for
+    already-created loggers (every module-level ``_log``)."""
+    import logging
+
+    from mmlspark_tpu.core import config
+    from mmlspark_tpu.core.logging_utils import get_logger
+
+    logger = get_logger("mmlspark_tpu.test_loglevel_regression")
+    assert logger.level == logging.INFO
+    try:
+        config.set("log_level", "DEBUG")
+        assert logger.level == logging.DEBUG, (
+            "config.set('log_level') must re-level existing loggers")
+        # a logger created AFTER the change picks the level up directly
+        late = get_logger("mmlspark_tpu.test_loglevel_regression2")
+        assert late.level == logging.DEBUG
+    finally:
+        config.reset("log_level")
+    assert logger.level == logging.INFO  # reset notifies too

@@ -1,0 +1,425 @@
+"""Obs subsystem suite: span tracer correctness (nesting, threads,
+enable/disable isolation), histogram percentiles vs numpy, Chrome-trace
+export validity, and the acceptance contract that obs counters EXACTLY
+equal the independently observed crossing/compile values the PR 1/PR 4
+tests assert at the planner's own seams — one telemetry substrate, not a
+second set of numbers."""
+
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from test_plan import image_table, mlp_bundle  # noqa: E402
+
+from mmlspark_tpu import obs
+from mmlspark_tpu.core import plan
+from mmlspark_tpu.core.pipeline import PipelineModel
+from mmlspark_tpu.core.schema import make_image
+from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.models.jax_model import JaxModel
+from mmlspark_tpu.obs.events import SpanRecord
+from mmlspark_tpu.stages.featurize import AssembleFeatures
+from mmlspark_tpu.stages.image import ImageTransformer, UnrollImage
+
+
+@pytest.fixture(autouse=True)
+def obs_isolated():
+    """Every test starts AND ends with the tracer off and all state
+    dropped — enabling obs in one test must never leak spans, counters,
+    or the enabled flag into the next (the flag-isolation contract)."""
+    obs.disable()
+    obs.clear()
+    obs.registry().reset()
+    yield
+    obs.disable()
+    obs.clear()
+    obs.registry().reset()
+
+
+# ---- span tracer ----
+
+def test_disabled_span_is_shared_null_and_records_nothing():
+    assert not obs.enabled()
+    s1, s2 = obs.span("a"), obs.span("b", "cat", {"k": 1})
+    assert s1 is s2  # one shared null context: no allocation when off
+    with s1:
+        pass
+    obs.event("instant")
+    assert obs.captured() == []
+
+
+def test_nested_spans_record_parentage_and_containment():
+    obs.enable()
+    with obs.span("outer", "t"):
+        with obs.span("mid", "t"):
+            with obs.span("inner", "t", {"k": "v"}):
+                pass
+        with obs.span("mid2", "t"):
+            pass
+    recs = {r.name: r for r in obs.captured()}
+    assert set(recs) == {"outer", "mid", "inner", "mid2"}
+    outer, mid, inner, mid2 = (recs[n]
+                               for n in ("outer", "mid", "inner", "mid2"))
+    assert outer.parent_id is None and outer.depth == 0
+    assert mid.parent_id == outer.span_id and mid.depth == 1
+    assert inner.parent_id == mid.span_id and inner.depth == 2
+    assert mid2.parent_id == outer.span_id and mid2.depth == 1
+    assert inner.labels == {"k": "v"}
+    # wall-clock containment: children lie inside their parent
+    for child, parent in ((mid, outer), (inner, mid), (mid2, outer)):
+        assert child.start_ns >= parent.start_ns
+        assert child.end_ns <= parent.end_ns
+    # siblings are ordered, not overlapping
+    assert mid.end_ns <= mid2.start_ns
+
+
+def test_span_records_survive_exceptions():
+    obs.enable()
+    with pytest.raises(ValueError):
+        with obs.span("dies", "t"):
+            raise ValueError("boom")
+    (rec,) = obs.captured()
+    assert rec.name == "dies" and rec.dur_ns >= 0
+    # the thread-local stack unwound: a new root span has no parent
+    with obs.span("next", "t"):
+        pass
+    assert [r.parent_id for r in obs.captured()] == [None, None]
+
+
+def test_threaded_spans_keep_independent_stacks():
+    obs.enable()
+    barrier = threading.Barrier(2)
+
+    def work(tag: str) -> None:
+        barrier.wait()
+        with obs.span(f"{tag}/outer", "t"):
+            with obs.span(f"{tag}/inner", "t"):
+                pass
+
+    threads = [threading.Thread(target=work, args=(t,), name=f"W{t}")
+               for t in ("a", "b")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    recs = {r.name: r for r in obs.captured()}
+    assert len(recs) == 4
+    for tag in ("a", "b"):
+        outer, inner = recs[f"{tag}/outer"], recs[f"{tag}/inner"]
+        # nesting resolved per-thread: never across threads
+        assert inner.parent_id == outer.span_id
+        assert outer.parent_id is None
+        assert inner.tid == outer.tid
+    assert recs["a/outer"].tid != recs["b/outer"].tid
+    assert recs["a/outer"].thread_name == "Wa"
+
+
+def test_enable_disable_toggles_capture():
+    obs.enable()
+    with obs.span("while-on", "t"):
+        pass
+    obs.disable()
+    with obs.span("while-off", "t"):
+        pass
+    names = [r.name for r in obs.captured()]
+    assert names == ["while-on"]  # captured records stay readable
+
+
+def test_ring_buffer_bounded():
+    obs.enable(buffer_size=16)
+    for k in range(64):
+        with obs.span(f"s{k}", "t"):
+            pass
+    recs = obs.captured()
+    assert len(recs) == 16
+    assert recs[0].name == "s48" and recs[-1].name == "s63"  # newest kept
+
+
+# ---- metrics registry ----
+
+def test_counter_gauge_interning_and_labels():
+    reg = obs.registry()
+    c1 = reg.counter("x.total", model="m", bucket=8)
+    c2 = reg.counter("x.total", bucket=8, model="m")  # order-insensitive
+    assert c1 is c2
+    c1.add(2)
+    c2.add(0.5)
+    assert reg.counter("x.total", model="m", bucket=8).value == 2.5
+    assert reg.counter("x.total", model="other").value == 0  # distinct
+    with pytest.raises(ValueError):
+        c1.add(-1)
+    g = reg.gauge("x.depth")
+    assert g.value is None
+    g.set(3)
+    g.add(1)
+    assert g.value == 4.0
+    snap = reg.snapshot()
+    assert snap["counters"]["x.total{bucket=8,model=m}"] == 2.5
+    assert snap["gauges"]["x.depth"] == 4.0
+
+
+def test_histogram_percentiles_match_numpy():
+    rng = np.random.default_rng(7)
+    values = rng.normal(size=500).tolist()
+    h = obs.registry().histogram("lat", window=1024)
+    for v in values:
+        h.observe(v)
+    p = h.percentiles(ndigits=None)
+    p50, p95, p99 = np.percentile(np.asarray(values), [50, 95, 99])
+    assert p["n"] == 500
+    assert p["p50"] == pytest.approx(float(p50))
+    assert p["p95"] == pytest.approx(float(p95))
+    assert p["p99"] == pytest.approx(float(p99))
+    assert h.count == 500 and h.sum == pytest.approx(sum(values))
+
+
+def test_histogram_window_bounds_memory_but_not_count():
+    h = obs.registry().histogram("w", window=8)
+    for v in range(100):
+        h.observe(v)
+    assert h.count == 100  # lifetime count exact
+    assert h.values() == list(range(92, 100))  # window keeps the newest
+    assert h.percentiles()["n"] == 8
+
+
+def test_empty_histogram_is_snapshot_safe():
+    h = obs.registry().histogram("never")
+    assert h.percentiles() is None and h.mean() is None
+    snap = obs.registry().snapshot()["histograms"]["never"]
+    assert snap["count"] == 0 and snap["percentiles"] is None
+    json.dumps(snap)
+
+
+# ---- Chrome-trace export ----
+
+def test_chrome_trace_is_valid_trace_event_json():
+    obs.enable()
+    with obs.span("parent", "plan", {"rows": 4}):
+        with obs.span("child", "plan"):
+            pass
+    obs.event("mark", "serve", {"model": "m"})
+    payload = json.loads(json.dumps(obs.chrome_trace()))  # JSON-safe
+    events = payload["traceEvents"]
+    complete = [e for e in events if e["ph"] == "X"]
+    instants = [e for e in events if e["ph"] == "i"]
+    meta = [e for e in events if e["ph"] == "M"]
+    assert len(complete) == 2 and len(instants) == 1 and len(meta) >= 1
+    for e in complete:
+        # the trace_event contract chrome://tracing / Perfetto require
+        assert set(e) >= {"name", "cat", "ph", "ts", "dur", "pid", "tid"}
+        assert isinstance(e["ts"], float) and e["dur"] >= 0
+    by_name = {e["name"]: e for e in complete}
+    parent, child = by_name["parent"], by_name["child"]
+    # nesting: same lane, child interval inside the parent's
+    assert child["tid"] == parent["tid"]
+    assert child["ts"] >= parent["ts"]
+    assert child["ts"] + child["dur"] <= parent["ts"] + parent["dur"]
+    assert child["args"]["parent_id"] == parent["args"]["span_id"]
+    assert parent["args"]["rows"] == 4
+    assert meta[0]["name"] == "thread_name"
+
+
+def test_summarize_spans_aggregates_by_name():
+    obs.enable()
+    for _ in range(3):
+        with obs.span("hot", "t"):
+            pass
+    with obs.span("cold", "t"):
+        pass
+    from mmlspark_tpu.obs.export import summarize_spans
+    rows = {r["name"]: r for r in summarize_spans()}
+    assert rows["hot"]["calls"] == 3 and rows["cold"]["calls"] == 1
+    assert rows["hot"]["total_ms"] >= rows["hot"]["mean_ms"]
+
+
+# ---- the acceptance contract: obs counters == the PR 1 seam counts ----
+
+def _registry_crossings() -> dict:
+    counters = obs.registry().snapshot()["counters"]
+    shapes = obs.registry().series("plan.h2d_shapes")
+    return {
+        "uploads": counters.get("plan.h2d_uploads", 0),
+        "fetches": counters.get("plan.d2h_fetches", 0),
+        "upload_bytes": counters.get("plan.h2d_bytes", 0),
+        "distinct_shapes": len(shapes),
+    }
+
+
+def parity_pipelines():
+    """The tests/test_plan.py parity scenarios, rebuilt here: every fused
+    shape the PR 1 suite pins, plus the host-fallback case that must
+    count ZERO crossings."""
+    return [
+        ("crop_flip_unroll",
+         [ImageTransformer().crop(2, 3, 16, 12).flip(-1),
+          UnrollImage(scale=1.0, offset=0.0)],
+         image_table()),
+        ("resize_unroll",
+         [ImageTransformer().resize(16, 12), UnrollImage()],
+         image_table(h=29, w=23)),
+        ("three_stage_model_tail_padding",
+         [ImageTransformer().flip(0),
+          AssembleFeatures(columns_to_featurize=["image"],
+                           allow_images=True,
+                           features_col="features").fit(
+              image_table(n=10, h=12, w=10)),
+          JaxModel(model=mlp_bundle(2 + 12 * 10 * 3),
+                   input_col="features", output_col="scores",
+                   minibatch_size=4, mesh_spec={"dp": 1})],
+         image_table(n=10, h=12, w=10)),
+        ("chained_models",
+         [JaxModel(model=mlp_bundle(6, out_dim=5, seed=1), input_col="x",
+                   output_col="h", minibatch_size=4),
+          JaxModel(model=mlp_bundle(5, out_dim=3, seed=2), input_col="h",
+                   output_col="scores", minibatch_size=4)],
+         DataTable({"x": list(np.random.default_rng(3).normal(
+             size=(9, 6)).astype(np.float32))})),
+        ("ragged_host_fallback",
+         [ImageTransformer().flip(1), UnrollImage()],
+         DataTable({"image": [
+             make_image(f"p{k}",
+                        np.random.default_rng(5).integers(
+                            0, 255, (10 + k, 8, 3)))
+             for k in range(5)]})),
+    ]
+
+
+@pytest.mark.parametrize("name,stages,table",
+                         parity_pipelines(),
+                         ids=[p[0] for p in parity_pipelines()])
+def test_obs_counters_equal_seam_counts_for_parity_pipelines(
+        name, stages, table):
+    """For every PR 1 parity pipeline the registry's crossing counters
+    must EXACTLY equal what the independent seam-patching counter
+    observes: crossings, bytes, and the distinct-upload-shape recompile
+    surface. (The ragged case pins the zero: a host fallback records no
+    phantom crossings.)"""
+    obs.enable()
+    with plan.count_crossings() as c:
+        PipelineModel(stages).transform(table)
+    got = _registry_crossings()
+    assert got["uploads"] == c.uploads
+    assert got["fetches"] == c.fetches
+    assert got["upload_bytes"] == c.upload_bytes
+    assert got["distinct_shapes"] == len(c.upload_shapes)
+    if name == "ragged_host_fallback":
+        assert got["uploads"] == 0 and got["upload_bytes"] == 0
+
+
+def test_obs_compile_counter_counts_segment_builds():
+    obs.enable()
+    table = image_table(n=6)
+    pm = PipelineModel([ImageTransformer().flip(1), UnrollImage()])
+    pm.transform(table)
+    first = obs.registry().value("plan.segment_compiles")
+    assert first == 1
+    pm.transform(table)  # cache hit: no new compile
+    assert obs.registry().value("plan.segment_compiles") == first
+    assert obs.compiled_programs(pm) == 1
+
+
+# ---- serve burst: one substrate across the PR 4 observables ----
+
+def test_serve_burst_obs_counters_match_pr4_observables():
+    """One serve burst: the registry's crossing/shape counters, the
+    obs-owned compile-cache hook, and the re-backed ServerStats snapshot
+    must all agree with the independently counted values the PR 4 tests
+    assert."""
+    from mmlspark_tpu.models.zoo import get_model
+    from mmlspark_tpu.serve import ModelServer, ServeConfig
+
+    buckets, n_req = (1, 8, 32), 48
+    bundle = get_model("ConvNet_CIFAR10", widths=(8, 16), dense_width=32)
+    jm = JaxModel(model=bundle, input_col="image", output_col="scores")
+    rng = np.random.default_rng(0)
+    rows = rng.integers(0, 255, (n_req, 32 * 32 * 3)).astype(np.uint8)
+
+    server = ModelServer(ServeConfig(buckets=buckets, max_queue=n_req,
+                                     deadline_ms=None))
+    try:
+        server.add_model("cnn", jm,
+                         example=DataTable({"image": [rows[0]]}))
+        obs.enable()  # after warmup: count the burst only
+        with plan.count_crossings() as c:
+            handles = [server.submit("cnn",
+                                     DataTable({"image": [rows[i]]}))
+                       for i in range(n_req)]
+            outs = [h.result(timeout=300) for h in handles]
+        snap = server.stats("cnn").snapshot()
+        programs = server.compiled_programs("cnn")
+        entry = server._entry("cnn")
+        obs_programs = obs.compiled_programs(entry.batcher.cache_host)
+    finally:
+        server.close()
+
+    assert all(len(o) == 1 and "scores" in o for o in outs)
+    got = _registry_crossings()
+    # crossings + bytes + recompile surface: registry == seam counter
+    assert got["uploads"] == c.uploads
+    assert got["fetches"] == c.fetches
+    assert got["upload_bytes"] == c.upload_bytes
+    assert got["distinct_shapes"] == len(c.upload_shapes)
+    assert got["distinct_shapes"] <= len(buckets)
+    # the compile hook is obs-owned and serve-delegated: same number
+    assert programs == obs_programs
+    if programs is not None:
+        assert programs <= len(buckets)
+    # re-backed ServerStats stays value-compatible under real traffic
+    assert snap["completed"] == n_req
+    assert snap["rows_dispatched"] == n_req
+    assert snap["distinct_batch_shapes"] <= len(buckets)
+    assert sum(snap["occupancy_by_bucket"].values()) == snap["batches"]
+    # serve spans landed on the timeline alongside the plan spans
+    cats = {r.cat for r in obs.captured() if isinstance(r, SpanRecord)}
+    assert "serve" in cats and "plan" in cats
+
+
+# ---- train: loader spans + input_stats as a registry view ----
+
+def test_trainer_input_stats_published_as_registry_view():
+    from mmlspark_tpu.models.zoo import MLP
+    from mmlspark_tpu.train.loop import TrainConfig, Trainer
+
+    obs.enable()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 6)).astype(np.float32)
+    y = rng.integers(0, 4, 64).astype(np.int64)
+    cfg = TrainConfig(batch_size=16, epochs=1, prefetch_depth=2,
+                      log_every=2)
+    tr = Trainer(MLP(features=(8,), num_outputs=4), cfg)
+    tr.fit_arrays(x, y)
+
+    stats = tr.input_stats
+    assert stats is not None and stats["batches"] == 4
+    reg = obs.registry()
+    # every input_stats key is a gauge in the shared registry with the
+    # SAME value — Trainer.input_stats is a view over the substrate
+    for key, val in stats.items():
+        g = reg.gauge(f"train.input.{key}", loader="fit_arrays")
+        assert g.value == val, (key, g.value, val)
+    assert reg.value("train.steps") == 4
+    names = {r.name for r in obs.captured() if isinstance(r, SpanRecord)}
+    assert "train/step" in names
+    assert "fit_arrays/commit" in names
+    assert "fit_arrays/wait" in names
+
+
+def test_decode_chunk_span_and_counters(tmp_path):
+    cv2 = pytest.importorskip("cv2")
+    from mmlspark_tpu.data.readers import read_images
+
+    img = np.zeros((8, 8, 3), np.uint8)
+    for k in range(3):
+        cv2.imwrite(str(tmp_path / f"im{k}.png"), img)
+    obs.enable()
+    out = read_images(str(tmp_path))
+    assert len(out) == 3
+    names = {r.name for r in obs.captured() if isinstance(r, SpanRecord)}
+    assert "data/decode_chunk" in names
+    assert obs.registry().value("data.images_decoded") == 3

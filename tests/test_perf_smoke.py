@@ -13,7 +13,8 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
 
 from perf_smoke import (  # noqa: E402
-    check_fused_crossings, check_serve_batching, check_train_prefetch,
+    check_fused_crossings, check_obs_overhead, check_serve_batching,
+    check_train_prefetch,
 )
 
 
@@ -29,6 +30,12 @@ def test_train_loader_commits_ahead_of_consumption():
     assert result["committed_ahead_max"] >= result["prefetch_depth"]
     assert result["batches"] == result["steps"]
     assert 0.0 <= result["input_bound_fraction"] <= 1.0
+
+
+def test_obs_disabled_path_overhead_bounded():
+    result = check_obs_overhead()
+    assert result["overhead_fraction_bound"] < result["max_fraction"]
+    assert result["spans_when_enabled"] > 0  # the seams actually exist
 
 
 def test_serve_burst_compiles_bounded_and_coalesces():

@@ -500,3 +500,48 @@ class TestHTTP:
                 pa.ipc.open_stream(io.BytesIO(resp.read())).read_all()
                 .combine_chunks().to_batches()[0])
         assert "scores" in out and len(out) == 2
+
+
+class TestStatsPreTraffic:
+    def test_snapshot_safe_before_any_traffic(self):
+        """Regression (obs satellite): a freshly created ServerStats —
+        e.g. /v1/stats polled right after a model loads, before the first
+        request — must snapshot cleanly: empty percentile windows report
+        None, never an empty-array percentile or a zero division."""
+        from mmlspark_tpu.serve.stats import ServerStats
+
+        snap = ServerStats(model="pre-traffic").snapshot()
+        assert snap["admitted"] == 0 and snap["completed"] == 0
+        assert snap["batches"] == 0 and snap["rows_dispatched"] == 0
+        assert snap["batch_occupancy_mean"] is None
+        assert snap["e2e_ms"] is None
+        assert snap["queue_wait_ms"] is None
+        assert snap["device_ms"] is None
+        assert snap["occupancy_by_bucket"] == {}
+        assert snap["distinct_batch_shapes"] == 0
+        import json
+        json.dumps(snap)  # JSON-safe as served by the HTTP front end
+
+    def test_snapshot_values_backed_by_obs_primitives(self):
+        """ServerStats is re-backed by the shared obs metrics — the
+        snapshot must stay value-compatible with the pre-obs class."""
+        from mmlspark_tpu.serve.stats import ServerStats
+
+        stats = ServerStats(window=8, model="m")
+        for k in range(3):
+            stats.record_admitted()
+        stats.record_done(e2e_ms=10.0, queue_ms=2.0)
+        stats.record_batch(bucket=8, occupancy=5, device_ms=4.0,
+                           shapes=((8, 6),))
+        stats.record_rejected()
+        snap = stats.snapshot()
+        assert snap["admitted"] == 3 and snap["completed"] == 1
+        assert snap["rejected_overload"] == 1
+        assert snap["rows_dispatched"] == 5 and snap["rows_padded"] == 3
+        assert snap["occupancy_by_bucket"] == {8: 1}
+        assert snap["batch_occupancy_mean"] == 5.0
+        assert snap["e2e_ms"]["p50"] == 10.0 and snap["e2e_ms"]["n"] == 1
+        assert snap["distinct_batch_shapes"] == 1
+        # the per-instance registry exposes the same series for /metrics
+        reg_snap = stats.registry.snapshot()
+        assert reg_snap["counters"]["serve.admitted{model=m}"] == 3

@@ -20,6 +20,12 @@ flake on a loaded CI box):
   jitted composite's own compile cache AND at the dispatch-shape seam)
   and coalesces to a mean batch occupancy > 1 (the batcher actually
   batches under load).
+* **obs disabled-path overhead** — the observability seams threaded
+  through the fused pipeline (docs/observability.md) must cost < 2% of
+  the microbench when the tracer is off. Gated on a measured analytic
+  bound (per-call disabled-seam cost × the number of seams one transform
+  actually hits, against the transform's own wall time) rather than an
+  A/B wall-clock diff, so a loaded CI box cannot flake it.
 
 The same checks run in tier-1 as tests/test_perf_smoke.py; this entry
 point is the ``BENCH_FAST=1``-style standalone for CI wiring:
@@ -40,9 +46,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np  # noqa: E402
 
 
-def check_fused_crossings() -> dict:
-    """Run the canonical pipeline; raise AssertionError on regression."""
-    from mmlspark_tpu.core import plan
+def canonical_pipeline(n: int = 48, minibatch: int = 16):
+    """(PipelineModel, table, n, minibatch) — the canonical fused image
+    pipeline (resize → unroll → score) every gate here runs against."""
     from mmlspark_tpu.core.pipeline import PipelineModel
     from mmlspark_tpu.core.schema import make_image
     from mmlspark_tpu.data.table import DataTable
@@ -50,19 +56,25 @@ def check_fused_crossings() -> dict:
     from mmlspark_tpu.models.zoo import get_model
     from mmlspark_tpu.stages.image import ImageTransformer, UnrollImage
 
-    n, minibatch = 48, 16
     rng = np.random.default_rng(0)
     table = DataTable({"image": [
         make_image(f"i{k}", rng.integers(0, 255, (40, 40, 3)))
         for k in range(n)]})
-
     stages = [
         ImageTransformer().resize(32, 32),
         UnrollImage(input_col="image", output_col="image_vec"),
         JaxModel(model=get_model("ConvNet_CIFAR10"), input_col="image_vec",
                  output_col="scores", minibatch_size=minibatch),
     ]
-    pm = PipelineModel(stages)
+    return PipelineModel(stages), table, n, minibatch
+
+
+def check_fused_crossings() -> dict:
+    """Run the canonical pipeline; raise AssertionError on regression."""
+    from mmlspark_tpu.core import plan
+
+    pm, table, n, minibatch = canonical_pipeline()
+    stages = pm.stages
 
     segments = plan.describe_plan(stages, table)
     kinds = [(kind, len(ss)) for kind, ss in segments]
@@ -197,16 +209,99 @@ def check_serve_batching() -> dict:
     }
 
 
+def check_obs_overhead(max_fraction: float = 0.02) -> dict:
+    """The obs seams' disabled-path cost on the fused-pipeline microbench
+    must stay under ``max_fraction`` (2%) of the transform itself.
+
+    Methodology (all measured, no A/B wall-clock diff to flake):
+
+    1. time one warm fused transform with the tracer OFF (median of 5);
+    2. run it once with the tracer ON and count what the seams actually
+       did — spans recorded and counter increments — giving the number
+       of disabled-path flag checks one transform performs;
+    3. measure the per-call cost of the disabled seam itself (a
+       ``span()`` call: one module-flag check + shared null context —
+       strictly an upper bound on a bare flag check) over 200k calls;
+    4. gate ``unit_cost × seam_calls / transform_time < max_fraction``.
+    """
+    import statistics
+    import time
+
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.obs.metrics import registry
+    from mmlspark_tpu.obs.spans import span as obs_span
+
+    assert not obs.enabled(), (
+        "check_obs_overhead must start with the tracer disabled")
+    pm, table, _n, _mb = canonical_pipeline()
+    pm.transform(table)  # compile + warm outside the timed passes
+
+    t_run = statistics.median(
+        _timed_once(pm, table, time) for _ in range(5))
+
+    # count the seams one transform hits: every span and every counter
+    # increment is one disabled-path flag check (plus the span-call
+    # overhead where a span exists — bounded below by pricing EVERY site
+    # at the span() unit cost, the more expensive of the two)
+    registry().reset()
+    obs.enable()
+    obs.clear()
+    try:
+        pm.transform(table)
+        n_spans = len(obs.captured())
+        counters = registry().snapshot()["counters"]
+        n_increments = int(
+            3 * counters.get("plan.h2d_uploads", 0)       # uploads+bytes+shape
+            + 2 * counters.get("plan.d2h_fetches", 0)     # fetch + d2h bytes
+            + counters.get("plan.segment_compiles", 0))
+    finally:
+        obs.disable()
+        obs.clear()
+        registry().reset()
+    # enter/exit both touch the seam; +8 for timed()'s lazy imports etc.
+    seam_calls = 2 * n_spans + n_increments + 8
+
+    reps = 200_000
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        obs_span("overhead-probe", "bench")
+    unit = (time.perf_counter() - t0) / reps
+
+    fraction = (unit * seam_calls) / t_run if t_run > 0 else 0.0
+    assert fraction < max_fraction, (
+        f"disabled-path obs overhead bound {fraction:.4%} exceeds "
+        f"{max_fraction:.0%} of the fused-pipeline microbench "
+        f"({seam_calls} seam calls × {unit * 1e9:.0f} ns vs "
+        f"{t_run * 1e3:.1f} ms transform) — an obs seam grew work on "
+        "the disabled path")
+    return {
+        "transform_ms": round(t_run * 1e3, 3),
+        "seam_calls": seam_calls,
+        "spans_when_enabled": n_spans,
+        "disabled_span_ns": round(unit * 1e9, 1),
+        "overhead_fraction_bound": round(fraction, 6),
+        "max_fraction": max_fraction,
+    }
+
+
+def _timed_once(pm, table, time_mod) -> float:
+    t0 = time_mod.perf_counter()
+    pm.transform(table)
+    return time_mod.perf_counter() - t0
+
+
 def main() -> int:
     try:
         result = check_fused_crossings()
         train = check_train_prefetch()
         serve = check_serve_batching()
+        obs_overhead = check_obs_overhead()
     except AssertionError as e:
         print(json.dumps({"perf_smoke": "FAIL", "reason": str(e)}))
         return 1
     print(json.dumps({"perf_smoke": "OK", **result,
-                      "train_prefetch": train, "serve": serve}))
+                      "train_prefetch": train, "serve": serve,
+                      "obs_overhead": obs_overhead}))
     return 0
 
 

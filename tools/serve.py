@@ -83,10 +83,18 @@ def main(argv: list[str] | None = None) -> int:
                          "used for validation + bucket warmup")
     ap.add_argument("--no-warmup", action="store_true",
                     help="skip compiling the bucket ladder at load")
+    ap.add_argument("--obs", action="store_true",
+                    help="enable the obs tracer (docs/observability.md): "
+                         "GET /metrics and /trace expose the registry "
+                         "snapshot and the Chrome-trace span timeline")
     args = ap.parse_args(argv if argv is not None else sys.argv[1:])
 
     from mmlspark_tpu.serve import ModelLoadError, ModelServer, ServeConfig
     from mmlspark_tpu.serve.http import start_http_server
+
+    if args.obs:
+        from mmlspark_tpu import obs
+        obs.enable()
 
     schema = None
     if args.schema:

@@ -1,0 +1,180 @@
+"""trace — capture/render an obs timeline + metrics snapshot from the CLI.
+
+Subcommands::
+
+    python tools/trace.py demo [--out-dir DIR] [--rows N]
+        Run the canonical fused image pipeline (resize → unroll → score,
+        the tools/perf_smoke.py scenario) with the obs tracer enabled;
+        write trace.json (Chrome-trace / Perfetto ``trace_event`` JSON)
+        and metrics.json (registry snapshot), and print a text summary.
+
+    python tools/trace.py pipeline <saved-stage-dir>
+        [--schema schema.json] [--rows N] [--out-dir DIR]
+        Load a saved PipelineModel / fitted transformer, synthesize
+        ``--rows`` input rows from the schema (``--schema`` takes the
+        tools/analyze.py JSON column spec; without it the schema is
+        derived from a leading JaxModel's input_spec), run one traced
+        transform, and write the same artifacts.
+
+    python tools/trace.py render <trace.json> [--top N]
+        Aggregate a previously written trace file into a per-span-name
+        table (calls, total/mean ms), longest first.
+
+Open trace.json in https://ui.perfetto.dev (or chrome://tracing). For a
+device-interleaved view capture ``utils/profiling.trace`` simultaneously
+— spans recorded under ``--device-annotations`` also enter
+``jax.profiler`` annotations, so both timelines carry the same names.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _write_artifacts(out_dir: str) -> dict:
+    from mmlspark_tpu import obs
+    os.makedirs(out_dir, exist_ok=True)
+    trace_path = obs.write_chrome_trace(os.path.join(out_dir, "trace.json"))
+    metrics_path = obs.write_snapshot(os.path.join(out_dir, "metrics.json"))
+    return {"trace": trace_path, "metrics": metrics_path,
+            "spans": len(obs.captured())}
+
+
+def _print_summary(rows: list[dict]) -> None:
+    if not rows:
+        print("(no spans captured)")
+        return
+    width = max(len(r["name"]) for r in rows)
+    print(f"{'span':<{width}}  {'calls':>6}  {'total ms':>10}  "
+          f"{'mean ms':>9}")
+    for r in rows:
+        print(f"{r['name']:<{width}}  {r['calls']:>6}  "
+              f"{r['total_ms']:>10.3f}  {r['mean_ms']:>9.3f}")
+
+
+def cmd_demo(args: argparse.Namespace) -> int:
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.core.pipeline import PipelineModel
+    from mmlspark_tpu.core.schema import make_image
+    from mmlspark_tpu.data.table import DataTable
+    from mmlspark_tpu.models.jax_model import JaxModel
+    from mmlspark_tpu.models.zoo import get_model
+    from mmlspark_tpu.obs.export import summarize_spans
+    from mmlspark_tpu.stages.image import ImageTransformer, UnrollImage
+
+    obs.enable(device_annotations=args.device_annotations)
+    rng = np.random.default_rng(0)
+    table = DataTable({"image": [
+        make_image(f"i{k}", rng.integers(0, 255, (40, 40, 3)))
+        for k in range(args.rows)]})
+    pm = PipelineModel([
+        ImageTransformer().resize(32, 32),
+        UnrollImage(input_col="image", output_col="image_vec"),
+        JaxModel(model=get_model("ConvNet_CIFAR10"), input_col="image_vec",
+                 output_col="scores", minibatch_size=16),
+    ])
+    out = pm.transform(table)
+    assert "scores" in out and len(out) == args.rows
+    artifacts = _write_artifacts(args.out_dir)
+    artifacts["compiled_programs"] = obs.compiled_programs(pm)
+    print(json.dumps({"demo": "ok", "rows": args.rows, **artifacts}))
+    _print_summary(summarize_spans(top=args.top))
+    return 0
+
+
+def cmd_pipeline(args: argparse.Namespace) -> int:
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.core.stage import PipelineStage
+    from mmlspark_tpu.obs.export import summarize_spans
+    from mmlspark_tpu.serve.server import _as_stages, _derived_schema, \
+        _example_rows
+
+    stage = PipelineStage.load(args.model)
+    stages, cache_host, _model = _as_stages(stage)
+    schema = None
+    if args.schema:
+        from mmlspark_tpu.analysis import TableSchema
+        with open(args.schema, "r", encoding="utf-8") as fh:
+            schema = TableSchema.from_spec(json.load(fh))
+    if schema is None:
+        schema = _derived_schema(stages)
+    if schema is None:
+        print(f"{args.model}: no input schema derivable — pass --schema "
+              "(tools/analyze.py JSON column spec)", file=sys.stderr)
+        return 2
+    table = _example_rows(schema, args.rows)
+    if table is None:
+        print("schema is not concrete enough to synthesize rows "
+              "(unknown shapes) — pass a fully concrete --schema",
+              file=sys.stderr)
+        return 2
+    obs.enable(device_annotations=args.device_annotations)
+    stage.transform(table)
+    artifacts = _write_artifacts(args.out_dir)
+    artifacts["compiled_programs"] = obs.compiled_programs(cache_host)
+    print(json.dumps({"pipeline": args.model, "rows": args.rows,
+                      **artifacts}))
+    _print_summary(summarize_spans(top=args.top))
+    return 0
+
+
+def cmd_render(args: argparse.Namespace) -> int:
+    with open(args.trace, "r", encoding="utf-8") as fh:
+        payload = json.load(fh)
+    events = payload.get("traceEvents", [])
+    agg: dict[str, dict] = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        row = agg.setdefault(ev["name"], {"name": ev["name"],
+                                          "calls": 0, "total_ms": 0.0})
+        row["calls"] += 1
+        row["total_ms"] += float(ev.get("dur", 0.0)) / 1e3
+    rows = sorted(agg.values(), key=lambda d: -d["total_ms"])[:args.top]
+    for row in rows:
+        row["total_ms"] = round(row["total_ms"], 3)
+        row["mean_ms"] = round(row["total_ms"] / row["calls"], 3)
+    _print_summary(rows)
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="trace", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    demo = sub.add_parser("demo", help="trace the canonical fused pipeline")
+    demo.add_argument("--rows", type=int, default=48)
+    pipe = sub.add_parser("pipeline", help="trace a saved model")
+    pipe.add_argument("model", help="saved stage dir (stage.save output)")
+    pipe.add_argument("--schema", default=None,
+                      help="JSON column spec (tools/analyze.py format)")
+    pipe.add_argument("--rows", type=int, default=32)
+    for p in (demo, pipe):
+        p.add_argument("--out-dir", default="./trace_out")
+        p.add_argument("--top", type=int, default=20)
+        p.add_argument("--device-annotations", action="store_true",
+                       help="also enter jax.profiler annotations (for a "
+                            "simultaneous XProf capture)")
+    rend = sub.add_parser("render", help="summarize a trace.json")
+    rend.add_argument("trace")
+    rend.add_argument("--top", type=int, default=20)
+
+    args = ap.parse_args(argv if argv is not None else sys.argv[1:])
+    if args.cmd == "demo":
+        return cmd_demo(args)
+    if args.cmd == "pipeline":
+        return cmd_pipeline(args)
+    return cmd_render(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
