@@ -6,6 +6,12 @@ and predict its device plan before any data moves.
   stage-indexed diagnostics and a device-plan audit (fusion boundaries,
   predicted H2D/D2H crossings, recompile hazards).
 * :class:`TableSchema` / :class:`ColumnInfo` — the abstract table values.
+* :mod:`~mmlspark_tpu.analysis.spmd` /
+  :mod:`~mmlspark_tpu.analysis.collectives` — the symbolic SPMD verifier
+  for the parallel layer and multi-chip plans: sharding-state
+  propagation through shard_map contracts, partial-sum escape and
+  capacity/divisibility hazards, collective-schedule extraction with
+  cross-host agreement and fence checks (docs/spmd_analysis.md).
 * ``tools/analyze.py`` is the CLI entry point; ``tools/lint_jax.py`` is
   the companion AST lint for JAX anti-patterns in the codebase itself.
 """
@@ -16,18 +22,38 @@ from mmlspark_tpu.analysis.analyzer import (  # noqa: F401
 from mmlspark_tpu.analysis.audit import (  # noqa: F401
     PlanAudit, PlanSegmentReport,
 )
+from mmlspark_tpu.analysis.collectives import (  # noqa: F401
+    CollectiveOp, CollectiveSchedule, SpmdFinding, compare_schedules,
+    extract_schedule,
+)
 from mmlspark_tpu.analysis.info import (  # noqa: F401
     ColumnInfo, SchemaError, TableSchema,
+)
+from mmlspark_tpu.analysis.spmd import (  # noqa: F401
+    PlanSpmdAudit, ShardState, SpmdReport, audit_plan_spmd, verify_function,
+    verify_parallel_layer, verify_repo,
 )
 
 __all__ = [
     "AnalysisReport",
+    "CollectiveOp",
+    "CollectiveSchedule",
     "ColumnInfo",
     "Diagnostic",
     "PlanAudit",
     "PlanSegmentReport",
+    "PlanSpmdAudit",
     "SchemaError",
+    "ShardState",
+    "SpmdFinding",
+    "SpmdReport",
     "TableSchema",
     "analyze",
+    "audit_plan_spmd",
     "check_stage_kinds",
+    "compare_schedules",
+    "extract_schedule",
+    "verify_function",
+    "verify_parallel_layer",
+    "verify_repo",
 ]

@@ -11,6 +11,14 @@ in for the concrete table, so the predicted plan is the executed plan by
 construction. Crossing arithmetic goes through
 ``core/plan.predict_segment_minibatches`` (the executor's dp-rounded
 minibatch sizing) — nothing here compiles, uploads, or fetches.
+
+The audit's **multi-chip mode** lives in
+:mod:`mmlspark_tpu.analysis.spmd` (:func:`spmd_audit` below delegates):
+the same symbolic segment replay, additionally verifying each fused
+segment's SPMD behavior — entry batch sharded over the data axes,
+minibatch walk divisible by the dp extent, and zero manual collectives
+in the composite (inference relies on XLA-inserted resharding only).
+See docs/spmd_analysis.md.
 """
 
 from __future__ import annotations
@@ -77,6 +85,15 @@ class PlanAudit:
             lines.append(f"crossings: {self.uploads} H2D upload(s), "
                          f"{self.fetches} D2H fetch round(s) predicted")
         return "\n".join(lines)
+
+
+def spmd_audit(stages: list, meta_of: Any, n_rows: int | None = None):
+    """The plan audit's multi-chip mode: delegate to
+    :func:`mmlspark_tpu.analysis.spmd.audit_plan_spmd` (lazy import —
+    the SPMD verifier pulls in jaxpr machinery this module's pure
+    report types must not depend on)."""
+    from mmlspark_tpu.analysis.spmd import audit_plan_spmd
+    return audit_plan_spmd(stages, meta_of, n_rows=n_rows)
 
 
 def standalone_crossings(stage: Any, schema: Any, n_rows: int | None

@@ -166,9 +166,13 @@ class ViT(nn.Module):
         runs, and a pp resume of a dp run just works); they are stacked
         on a leading layer axis at trace time and handed to
         :func:`~mmlspark_tpu.parallel.pipeline.pipeline_apply`, which
-        reshards them over ``pp`` inside its shard_map. The re-stack costs
-        one device-local copy of the block params per step — the price of
-        a single param layout across all execution paths. Gradients flow
+        pins the traced stack replicated
+        (:func:`~mmlspark_tpu.parallel.pipeline.commit_replicated` — the
+        GSPMD full-to-shard edge fed unpinned trace-built operands to
+        each shard multiplied by the dp extent) and reshards it over
+        ``pp`` inside its shard_map. The re-stack costs one device-local
+        copy of the block params per step — the price of a single param
+        layout across all execution paths. Gradients flow
         through the stack back to the per-block leaves (exact; the
         pipeline is collective-differentiable)."""
         from mmlspark_tpu.parallel.pipeline import (

@@ -6,6 +6,12 @@ Spark shuffle/broadcast for data and an external MPI ring for training
 expressed as XLA collectives over ICI/DCN via ``jax.sharding.Mesh`` +
 ``jit``/``shard_map``. There is no external process and no MPI: gradients
 all-reduce over ICI inside the compiled step function.
+
+Every module here carries a **declared sharding contract** (its
+in/out specs and collective schedule), statically verified by the SPMD
+verifier (:mod:`mmlspark_tpu.analysis.spmd`; ``ENTRY_POINTS`` is the
+registry) and gated at zero findings in tier-1 — see
+docs/spmd_analysis.md.
 """
 
 from mmlspark_tpu.parallel.mesh import (
@@ -19,11 +25,13 @@ from mmlspark_tpu.parallel.moe import (
     moe_param_spec,
 )
 from mmlspark_tpu.parallel.pipeline import (
+    commit_replicated,
     pipeline_apply,
     pipeline_spec,
     stack_layer_params,
 )
 
 __all__ = ["MeshSpec", "make_mesh", "default_mesh_spec",
+           "commit_replicated",
            "pipeline_apply", "pipeline_spec", "stack_layer_params",
            "moe_apply", "moe_param_spec", "init_moe_params"]

@@ -27,6 +27,11 @@ from typing import Any, Mapping, Sequence
 
 import numpy as np
 
+# the canonical axis vocabulary. Collectives must name these axes
+# literally where possible: the JX202 lint (tools/lint_jax.py keeps a
+# jax-free mirror of this tuple) rejects any other literal, and the
+# SPMD verifier (analysis/spmd.py) checks traced axis names against the
+# concrete mesh
 AXES = ("dp", "fsdp", "tp", "sp", "pp", "ep")
 
 

@@ -9,18 +9,33 @@ Mesh-TensorFlow / Switch-Transformer dispatch algebra expressed as one
 * tokens shard over ``ep`` (each shard routes its own slice); expert
   parameters shard over ``ep`` on the expert axis (each shard OWNS
   ``E / ep`` experts),
-* top-1 gating with a fixed per-expert **capacity**: each source shard
-  builds a ``[E, C, d]`` dispatch buffer (position-in-expert via cumsum,
-  overflow tokens dropped — they contribute zero and pass through the
-  residual), applies the combine weights on the way back,
-* ``all_to_all`` regroups ``[ep, E_local, C, d]`` so every shard holds
-  ALL source shards' slots for ITS experts, applies its local expert
-  FFNs, and ``all_to_all``s back — the canonical EP traffic pattern,
-  riding ICI,
+* top-1 gating with a fixed per-expert **capacity** shared by the whole
+  ``ep`` ring: slot positions are assigned *globally* — each shard
+  ``all_gather``s the per-expert routed counts, offsets its local cumsum
+  ranks by the lower shards' counts, and keeps tokens whose global rank
+  fits the capacity (overflow tokens dropped — they contribute zero and
+  pass through the residual). Per-shard capacity splits were the
+  pad-capacity bug class: a token's survival depended on which shard its
+  padding landed on, not on the global expert load,
+* each shard scatters its ``[E, C, d]`` dispatch buffer with
+  ``psum_scatter`` over ``ep`` — global slots are disjoint across source
+  shards, so the reduce-scatter IS the union and every shard receives
+  exactly its own experts' fully-populated slots — applies its local
+  expert FFNs, and ``all_gather``s the expert outputs back to the source
+  shards for the combine,
 * a load-balancing auxiliary loss (mean gate prob × token fraction per
   expert, Switch §2.2 style) is returned alongside the outputs,
 * everything is differentiable; numerics match a dense (every-expert)
   reference exactly when capacity is ample (asserted on the CPU mesh).
+
+**Declared sharding contract** (verified statically by
+:mod:`mmlspark_tpu.analysis.spmd`, pinned against the lowered program
+in tests/test_spmd.py): tokens/mask ``P(('dp','fsdp','ep'))``, expert
+stacks ``P('ep')``, gate replicated; collective schedule
+``all_gather(ep)`` counts → ``psum_scatter(ep)`` dispatch →
+``all_gather(ep)`` outputs → 3 × ``psum(dp,fsdp,ep)`` aux. The
+capacity-dispatch rule (SPMD104/JX204) requires exactly the leading
+count exchange this layout performs.
 """
 
 from __future__ import annotations
@@ -103,8 +118,12 @@ def moe_apply(params: dict, x: Any, mesh, capacity_factor: float = 2.0,
         raise ValueError(
             f"{N} tokens not divisible by dp*fsdp*ep = {ep * dp_ext}")
     n_local = N // (ep * dp_ext)
-    # per-expert slots per SOURCE shard (fixed shape for XLA)
-    C = max(1, int(np.ceil(capacity_factor * n_local / E)))
+    # per-expert slots for the WHOLE ep ring (fixed shape for XLA). The
+    # budget must be global: splitting it per source shard makes a
+    # token's survival depend on how the batch (and its padding) lands
+    # across shards instead of on the expert's global load — the
+    # pad-capacity bug the SPMD verifier's divisibility check flags
+    C = max(1, int(np.ceil(capacity_factor * n_local * ep / E)))
     e_local = E // ep
     if token_mask is None:
         token_mask = jnp.ones((N,), jnp.float32)
@@ -123,25 +142,35 @@ def moe_apply(params: dict, x: Any, mesh, capacity_factor: float = 2.0,
         # capacity and vanish from dispatch, combine, and aux alike
         onehot_i = jax.nn.one_hot(expert, E, dtype=jnp.int32) \
             * m.astype(jnp.int32)[:, None]                      # [n, E]
-        # position of each token within its expert's capacity slots
+        # GLOBAL position of each token within its expert's capacity
+        # slots: local cumsum rank + the routed counts of every lower
+        # ep shard (one all_gather of a tiny [E] int vector). This is
+        # the cross-shard count exchange that makes capacity a property
+        # of the expert, not of where the token (or its padding) landed
+        counts = onehot_i.sum(axis=0)                            # [E]
+        counts_all = jax.lax.all_gather(counts, "ep")            # [ep, E]
+        me = jax.lax.axis_index("ep")
+        before = (jnp.arange(ep) < me)[:, None].astype(jnp.int32)
+        offset = (counts_all * before).sum(axis=0)               # [E]
         pos = (jnp.cumsum(onehot_i, axis=0) - onehot_i) * onehot_i
         pos = jnp.sum(pos, axis=-1)                              # [n] int32
+        pos = pos + (onehot_i * offset[None, :]).sum(axis=-1)
         keep = pos < C
-        # dispatch tensor [n, E, C]: one-hot over (expert, slot)
+        # dispatch tensor [n, E, C]: one-hot over (expert, global slot)
         onehot = onehot_i.astype(jnp.float32)
         slot = jax.nn.one_hot(pos, C, dtype=jnp.float32) \
             * keep[:, None].astype(jnp.float32)
         dispatch = onehot[:, :, None] * slot[:, None, :]        # [n, E, C]
         slots = jnp.einsum("nec,nd->ecd", dispatch,
                            xs.astype(jnp.float32)).astype(xs.dtype)
-        # regroup so THIS shard holds all source shards' slots for its
-        # local experts: [E, C, d] -> [ep, e_local, C, d] -> a2a over ep
-        slots = slots.reshape(ep, e_local, C, d)
-        slots = jax.lax.all_to_all(slots, "ep", split_axis=0,
-                                   concat_axis=0, tiled=False)  # [ep,el,C,d]
-        # apply local experts to their ep*C slots (scan unstacks the
+        # deliver every expert's slots to its owning shard: global slots
+        # are disjoint across source shards, so the reduce-scatter's sum
+        # is the union, and each shard receives [e_local, C, d]
+        slots = jax.lax.psum_scatter(slots.reshape(ep, e_local, C, d),
+                                     "ep", scatter_dimension=0,
+                                     tiled=False)
+        # apply local experts to their C slots (scan unstacks the
         # expert axis of params and slots together; reverse-mode safe)
-        slots = slots.transpose(1, 0, 2, 3).reshape(e_local, ep * C, d)
         stacked_pe = {k: p[k] for k in ("w_in", "b_in", "w_out", "b_out")}
 
         def one_expert(_, args):
@@ -149,10 +178,9 @@ def moe_apply(params: dict, x: Any, mesh, capacity_factor: float = 2.0,
             return None, _expert_ffn(pe, slot)
 
         _, outs = jax.lax.scan(one_expert, None, (stacked_pe, slots))
-        # route back to the source shards
-        outs = outs.reshape(e_local, ep, C, d).transpose(1, 0, 2, 3)
-        outs = jax.lax.all_to_all(outs, "ep", split_axis=0,
-                                  concat_axis=0, tiled=False)
+        # route back: every source shard combines from the full expert
+        # set, so gather the [e_local, C, d] outputs into [E, C, d]
+        outs = jax.lax.all_gather(outs, "ep")                   # [ep,el,C,d]
         outs = outs.reshape(E, C, d)
         y = (jnp.einsum("nec,ecd->nd", dispatch,
                         outs.astype(jnp.float32))

@@ -43,6 +43,36 @@ from __future__ import annotations
 from typing import Any, Callable
 
 
+def commit_replicated(tree: Any, mesh) -> Any:
+    """Pin traced shard_map operands fully replicated before entry.
+
+    GSPMD full-to-shard sharp edge (jax ≤ 0.4.37): an operand computed
+    *inside* an enclosing jit trace (e.g. per-block params re-stacked at
+    trace time) can reach the partitioner sharded over mesh axes its
+    ``in_spec`` leaves unmentioned; with the replication check off
+    (``check_vma=False`` — required by per-shard code) the conversion
+    consumes it as an **unreduced partial sum**: every shard sees
+    axis-extent × the true value. On a dp×pp mesh this silently scaled
+    the pipelined ViT forward by the dp extent (the dp×pp loss-parity
+    seed failure). An explicit replicated sharding constraint on traced
+    leaves forces the correct (local-slice) conversion; concrete arrays
+    committed by ``jax.device_put`` never hit the edge and pass through
+    untouched. The SPMD verifier's partial-sum escape check
+    (:mod:`mmlspark_tpu.analysis.spmd`) flags shard_map call sites that
+    feed trace-computed operands without this pin."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    repl = NamedSharding(mesh, P())
+
+    def one(leaf):
+        if isinstance(leaf, jax.core.Tracer):
+            return jax.lax.with_sharding_constraint(leaf, repl)
+        return leaf
+
+    return jax.tree_util.tree_map(one, tree)
+
+
 def stack_layer_params(layer_params: list) -> Any:
     """Stack per-layer pytrees (one per block, identical structure) into a
     single pytree with a leading layer axis — the shape
@@ -137,6 +167,10 @@ def pipeline_apply(block_fn: Callable, stacked_params: Any, x: Any,
 
     data_axes = ("dp", "fsdp")
     from mmlspark_tpu.parallel.mesh import shard_map
+    # trace-computed layer stacks (the Trainer re-stacks block{i} params
+    # at trace time) must be pinned replicated or the pp-unaware dp axis
+    # corrupts them on entry — see commit_replicated
+    stacked_params = commit_replicated(stacked_params, mesh)
     out = shard_map(
         stage_fn, mesh=mesh,
         in_specs=(P("pp"), P(None, data_axes)),

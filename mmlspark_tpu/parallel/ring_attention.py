@@ -24,6 +24,14 @@ Both shard the batch dim over ``dp`` as well (each dp group computes only
 its batch slice on a dp×sp mesh), and both match single-device attention
 numerics — including all-zero outputs for fully-masked query rows (tests
 assert this on the 8-virtual-device CPU mesh).
+
+**Declared sharding contracts** (verified statically by
+:mod:`mmlspark_tpu.analysis.spmd`, pinned against the lowered program in
+tests/test_spmd.py): q/k/v ``P('dp','sp',None,None)``, mask
+``P('dp','sp')``, outputs sharded like q; ring = ``ppermute(sp)`` per
+hop per rotating operand, Ulysses = ``all_to_all(sp)`` ×3 in,
+``all_gather(sp)`` for the mask, ``all_to_all(sp)`` back. Neither
+strategy may communicate over any other axis.
 """
 
 from __future__ import annotations

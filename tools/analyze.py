@@ -1,10 +1,12 @@
 """analyze — pre-flight pipeline & codebase analysis CLI.
 
-Two subcommands::
+Three subcommands::
 
     python tools/analyze.py pipeline <saved-stage-dir> --schema schema.json
         [--rows N] [--strict]
     python tools/analyze.py code [path ...]
+    python tools/analyze.py spmd [target ...] [--schema schema.json]
+        [--rows N] [--cpu-devices N]
 
 ``pipeline`` loads a persisted stage (a Pipeline/PipelineModel saved with
 ``.save()``, or any single stage), abstractly interprets it over the
@@ -23,6 +25,17 @@ The schema JSON maps column name → spec (see
 
 ``code`` runs the JAX anti-pattern lint (tools/lint_jax.py) and shares
 its exit semantics.
+
+``spmd`` runs the symbolic SPMD verifier (mmlspark_tpu/analysis/spmd.py;
+docs/spmd_analysis.md): each target is a parallel entry point
+(``moe_apply``, ``pipeline_apply``, ``ring_attention``,
+``ulysses_attention``), ``parallel`` (all of them, the default), or a
+saved-model directory (with ``--schema``: the device-plan audit's
+multi-chip mode — fused segments must be manual-collective-free and
+dp-divisible). Prints each function's sharding contract, collective
+schedule, and findings; exit 1 when any finding survives. Runs on a
+virtual CPU mesh (``--cpu-devices``, default 8) — no accelerator is
+touched.
 """
 
 from __future__ import annotations
@@ -59,6 +72,52 @@ def cmd_code(args: argparse.Namespace) -> int:
     return lint_jax.main(args.paths)
 
 
+def cmd_spmd(args: argparse.Namespace) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count="
+            f"{args.cpu_devices}").strip()
+    from mmlspark_tpu.analysis.spmd import (
+        ENTRY_POINTS, audit_plan_spmd, verify_entry_point,
+    )
+
+    targets = args.targets or ["parallel"]
+    by_name = {ep.name: ep for ep in ENTRY_POINTS}
+    n_findings = 0
+    for target in targets:
+        if os.path.isdir(target):
+            if not args.schema:
+                print(f"{target}: saved-model targets need --schema")
+                return 2
+            from mmlspark_tpu.analysis import TableSchema
+            from mmlspark_tpu.core.stage import PipelineStage
+
+            with open(args.schema, "r", encoding="utf-8") as fh:
+                schema = TableSchema.from_spec(json.load(fh))
+            stage = PipelineStage.load(target)
+            stages = getattr(stage, "stages", [stage])
+            audit = audit_plan_spmd(stages, schema.entry_meta,
+                                    n_rows=args.rows)
+            print(f"== plan spmd audit: {target}")
+            print(audit.format())
+            n_findings += len(audit.findings)
+            continue
+        eps = (list(ENTRY_POINTS) if target == "parallel"
+               else [by_name[t] for t in [target] if t in by_name])
+        if not eps:
+            print(f"unknown target {target!r}; choose from "
+                  f"{sorted(by_name)} | parallel | <saved-model-dir>")
+            return 2
+        for ep in eps:
+            report = verify_entry_point(ep)
+            print(f"== {report.format()}")
+            n_findings += len(report.findings)
+    print(f"spmd: {n_findings} finding(s)")
+    return 1 if n_findings else 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="analyze", description=__doc__,
@@ -80,6 +139,18 @@ def main(argv: list[str] | None = None) -> int:
     c.add_argument("paths", nargs="*", help="files/dirs (default: "
                    "mmlspark_tpu/)")
     c.set_defaults(func=cmd_code)
+
+    s = sub.add_parser("spmd", help="run the symbolic SPMD verifier")
+    s.add_argument("targets", nargs="*",
+                   help="parallel entry point(s), 'parallel' (default), "
+                        "or a saved-model directory")
+    s.add_argument("--schema", default=None,
+                   help="schema JSON (saved-model targets)")
+    s.add_argument("--rows", type=int, default=None,
+                   help="row count for minibatch-round prediction")
+    s.add_argument("--cpu-devices", type=int, default=8,
+                   help="virtual CPU mesh size (default 8)")
+    s.set_defaults(func=cmd_spmd)
 
     args = parser.parse_args(argv)
     return args.func(args)

@@ -33,6 +33,34 @@ jax initialization) catching the mistakes that cost the most on TPU:
   fetch after the loop) — the discipline of
   ``mmlspark_tpu/serve/batcher.py``.
 
+The JX2xx family is the AST face of the SPMD verifier
+(``mmlspark_tpu/analysis/spmd.py`` — which checks the same hazards
+semantically on the traced jaxpr; see docs/spmd_analysis.md):
+
+* **JX201 collective under data-dependent control flow** — a
+  ``psum``/``ppermute``/``all_gather``/``all_to_all``/``psum_scatter``
+  inside a ``lax.cond``/``lax.switch``/``lax.while_loop`` branch or
+  body: hosts whose predicate (or trip count) differs disagree on the
+  collective schedule — a cross-host deadlock-in-waiting. Hoist the
+  collective out (compute both sides, select after).
+* **JX202 unknown mesh axis name** — a collective (or ``axis_index``)
+  whose literal axis name is not one of the canonical mesh axes
+  (``parallel/mesh.py`` ``AXES``): a typo'd axis traces fine inside a
+  matching-named shard_map but can never bind to the production meshes.
+* **JX203 unreduced axis escapes a shard_map** — an axis named in
+  ``in_specs`` but absent from every ``out_specs`` entry, with no
+  reducing collective (``psum``/``all_gather``/...) over it in the
+  body: the out_spec claims replication over an axis the inputs vary
+  over, and ``check_vma=False`` (which every body here needs) stops jax
+  from checking the claim — values escape as unreduced partial sums.
+* **JX204 per-shard capacity arithmetic** — a shard_map body that
+  assigns capacity slots from a local ``cumsum`` and dispatches with
+  ``all_to_all``/``psum_scatter`` but never exchanges the routed counts
+  (``all_gather``): the slot budget is split per source shard, so
+  which tokens survive depends on where the batch (and its padding)
+  landed — the MoE pad-capacity bug class. Assign slot positions
+  globally (gather counts, offset the local ranks).
+
 Intentional exceptions are suppressed two ways, both documented in
 docs/static_analysis.md:
 
@@ -74,7 +102,29 @@ RULES = {
     "JX106": "blocking device fetch on a dispatched batch inside a serve "
              "dispatch loop; drain through the bounded in-flight window "
              "(or after the loop)",
+    "JX201": "collective under data-dependent control flow (lax.cond/"
+             "switch/while_loop); hoist it out — hosts that disagree on "
+             "the predicate deadlock",
+    "JX202": "collective names a mesh axis outside the canonical AXES "
+             "(parallel/mesh.py); typo'd axes can never bind to the "
+             "production meshes",
+    "JX203": "axis sharded by in_specs but absent from out_specs with no "
+             "reducing collective over it in the body; the output escapes "
+             "as an unreduced partial sum (check_vma=False hides it)",
+    "JX204": "capacity slots assigned from a local cumsum with no "
+             "cross-shard count exchange (all_gather) before the "
+             "dispatch; assign slot positions globally",
 }
+
+# mirror of parallel/mesh.py AXES — the lint must not import jax code
+_MESH_AXES = ("dp", "fsdp", "tp", "sp", "pp", "ep")
+_COLLECTIVE_CALLS = {"psum", "pmean", "pmax", "pmin", "ppermute",
+                     "pshuffle", "all_gather", "all_to_all",
+                     "psum_scatter"}
+# collectives that make a value invariant over their axis (JX203)
+_REDUCING_CALLS = {"psum", "pmean", "pmax", "pmin", "all_gather",
+                   "all_to_all", "psum_scatter"}
+_COND_CALLS = {"cond", "switch", "while_loop"}
 
 # the callee-name hint marking a train-step call whose outputs JX105 tracks
 _STEP_HINT = "step"
@@ -117,6 +167,32 @@ def _callee_name(node: ast.AST) -> str | None:
     return None
 
 
+def _literal_axis_names(expr: ast.AST | None) -> set:
+    """String literals in an axis argument: ``"pp"`` or ``("dp", "ep")``.
+    Non-literal axis expressions yield nothing (the lint never guesses)."""
+    if expr is None:
+        return set()
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return {expr.value}
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        out = set()
+        for elt in expr.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.add(elt.value)
+        return out
+    return set()
+
+
+def _spec_axis_names(expr: ast.AST | None) -> set:
+    """Canonical axis names appearing literally anywhere in an
+    in_specs/out_specs expression (inside ``P(...)`` calls and tuples)."""
+    if expr is None:
+        return set()
+    return {n.value for n in ast.walk(expr)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)
+            and n.value in _MESH_AXES}
+
+
 def _is_jit_func(node: ast.AST) -> bool:
     """Is this expression a reference to jax.jit / jit / pjit?"""
     if isinstance(node, ast.Name):
@@ -151,8 +227,9 @@ class _Linter(ast.NodeVisitor):
         self.loop_depth = 0
         self.jitted_names: set[str] = set()
         self.jitted_lambdas: list[ast.Lambda] = []
+        self.func_defs: dict[str, ast.AST] = {}
 
-    # -- pass 1 collects jit targets; pass 2 walks their bodies --
+    # -- pass 1 collects jit targets + local defs; pass 2 walks bodies --
 
     def collect(self, tree: ast.AST) -> None:
         for node in ast.walk(tree):
@@ -163,6 +240,10 @@ class _Linter(ast.NodeVisitor):
                         self.jitted_names.add(target.id)
                     elif isinstance(target, ast.Lambda):
                         self.jitted_lambdas.append(target)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # JX201/JX203/JX204 resolve branch/body callables by name;
+                # later definitions shadow earlier ones, as at runtime
+                self.func_defs[node.name] = node
 
     def _emit(self, node: ast.AST, rule: str, message: str) -> None:
         line = getattr(node, "lineno", 0)
@@ -301,7 +382,94 @@ class _Linter(ast.NodeVisitor):
                                "Param(default=<mutable literal>) is shared "
                                "across every stage instance; use None or a "
                                "tuple")
+        callee = _callee_name(func)
+        # JX201: collective inside a lax.cond/switch/while_loop callable
+        if callee in _COND_CALLS:
+            for arg in node.args:
+                body = self._resolve_callable(arg)
+                if body is None:
+                    continue
+                for sub in ast.walk(body):
+                    if (isinstance(sub, ast.Call) and _callee_name(sub.func)
+                            in _COLLECTIVE_CALLS):
+                        self._emit(sub, "JX201", RULES["JX201"])
+        # JX202: collective with a literal axis name outside the canon
+        if callee in _COLLECTIVE_CALLS or callee == "axis_index":
+            pos = 0 if callee == "axis_index" else 1
+            axis_arg = None
+            for kw in node.keywords:
+                if kw.arg in ("axis_name", "axes"):
+                    axis_arg = kw.value
+            if axis_arg is None and len(node.args) > pos:
+                axis_arg = node.args[pos]
+            for name in _literal_axis_names(axis_arg):
+                if name not in _MESH_AXES:
+                    self._emit(node, "JX202",
+                               f"axis {name!r} is not a canonical mesh "
+                               f"axis {_MESH_AXES}; see parallel/mesh.py")
+        # JX203/JX204: shard_map contract checks at the shim call site
+        if callee == "shard_map":
+            self._lint_shard_map_site(node)
         self.generic_visit(node)
+
+    # -- JX201/JX203/JX204 helpers --
+
+    def _resolve_callable(self, expr: ast.AST) -> ast.AST | None:
+        """A Lambda inline, or a Name bound to a module-local def."""
+        if isinstance(expr, ast.Lambda):
+            return expr
+        if isinstance(expr, ast.Name):
+            return self.func_defs.get(expr.id)
+        return None
+
+    def _lint_shard_map_site(self, node: ast.Call) -> None:
+        kw = {k.arg: k.value for k in node.keywords}
+        in_specs = kw.get("in_specs") if "in_specs" in kw else (
+            node.args[2] if len(node.args) > 2 else None)
+        out_specs = kw.get("out_specs") if "out_specs" in kw else (
+            node.args[3] if len(node.args) > 3 else None)
+        body = self._resolve_callable(node.args[0]) if node.args else None
+        in_axes = _spec_axis_names(in_specs)
+        out_axes = _spec_axis_names(out_specs)
+        # JX203: in_spec axes that never reach an out_spec need a
+        # reducing collective in the body (literal-resolvable sites only;
+        # a variable axis arg in the body gets the benefit of the doubt)
+        missing = in_axes - out_axes
+        if missing and body is not None:
+            covered: set[str] = set()
+            for sub in ast.walk(body):
+                if not (isinstance(sub, ast.Call) and _callee_name(sub.func)
+                        in _REDUCING_CALLS):
+                    continue
+                axis_arg = None
+                for k in sub.keywords:
+                    if k.arg in ("axis_name", "axes"):
+                        axis_arg = k.value
+                if axis_arg is None and len(sub.args) > 1:
+                    axis_arg = sub.args[1]
+                lits = _literal_axis_names(axis_arg)
+                if lits:
+                    covered |= lits
+                elif axis_arg is not None:
+                    covered |= missing  # unresolvable axis: assume covers
+            for axis in sorted(missing - covered):
+                self._emit(node, "JX203",
+                           f"axis {axis!r} is sharded by in_specs, absent "
+                           "from out_specs, and never reduced in the body "
+                           "— the output escapes as an unreduced partial "
+                           "sum over it (check_vma=False hides this)")
+        # JX204: local-cumsum capacity slots + dispatch, no count exchange
+        if body is not None:
+            calls = {_callee_name(sub.func) for sub in ast.walk(body)
+                     if isinstance(sub, ast.Call)}
+            if ("cumsum" in calls
+                    and calls & {"all_to_all", "psum_scatter"}
+                    and "all_gather" not in calls):
+                for sub in ast.walk(body):
+                    if (isinstance(sub, ast.Call)
+                            and _callee_name(sub.func) == "cumsum"):
+                        self._emit(sub, "JX204", RULES["JX204"])
+                        break
 
     def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
         if node.module and node.module.startswith("jax.experimental.shard_map"):

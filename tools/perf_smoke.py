@@ -26,6 +26,14 @@ flake on a loaded CI box):
   bound (per-call disabled-seam cost × the number of seams one transform
   actually hits, against the transform's own wall time) rather than an
   A/B wall-clock diff, so a loaded CI box cannot flake it.
+* **spmd clean** — the symbolic SPMD verifier
+  (mmlspark_tpu/analysis/spmd.py, docs/spmd_analysis.md) over every
+  declared parallel entry point (sharding contracts, partial-sum
+  escapes, capacity/divisibility, conditional collectives), the
+  drain-fence discipline of the multi-host sources, the multi-chip plan
+  audit of the canonical fused pipeline (zero manual collectives), and
+  the JAX lint including JX201–JX204 — all at zero unallowlisted
+  findings.
 
 The same checks run in tier-1 as tests/test_perf_smoke.py; this entry
 point is the ``BENCH_FAST=1``-style standalone for CI wiring:
@@ -284,6 +292,62 @@ def check_obs_overhead(max_fraction: float = 0.02) -> dict:
     }
 
 
+def check_spmd_clean() -> dict:
+    """Repo-wide static SPMD gate; raise AssertionError on any finding.
+
+    Needs the 8-device CPU mesh (tier-1's conftest forces it; the
+    standalone entry point sets the flag itself before jax loads)."""
+    import jax
+
+    from mmlspark_tpu.analysis.spmd import audit_plan_spmd, verify_repo
+    from mmlspark_tpu.core import plan
+
+    if len(jax.devices()) < 8:
+        raise AssertionError(
+            "check_spmd_clean needs the 8-device CPU mesh "
+            "(--xla_force_host_platform_device_count=8); got "
+            f"{len(jax.devices())} device(s)")
+    res = verify_repo()
+    findings = [str(f) for f in res["findings"]]
+    assert findings == [], (
+        "SPMD verifier findings over the parallel layer:\n"
+        + "\n".join(findings))
+
+    # multi-chip plan audit of the canonical fused pipeline: a fused
+    # inference segment must carry ZERO manual collectives and its
+    # minibatch walk must divide the mesh's data extent
+    pm, table, n, _mb = canonical_pipeline()
+    audit = audit_plan_spmd(pm.stages,
+                            lambda col: plan._entry_meta(table, col),
+                            n_rows=n)
+    assert audit.ok and len(audit.segments) == 1, (
+        "plan spmd audit regressed:\n" + audit.format())
+
+    # the AST lint (incl. JX201–JX204) over the codebase
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import lint_jax
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    lint = lint_jax.lint_paths([os.path.join(repo, "mmlspark_tpu")])
+    assert lint == [], "\n".join(str(f) for f in lint)
+
+    reports = res["reports"]
+    return {
+        "entry_points": sorted(reports),
+        "collectives": {name: rep.schedule.counts()
+                        for name, rep in reports.items()},
+        "shard_map_sites": sum(len(rep.sites)
+                               for rep in reports.values()),
+        "fence_files": res["fence_files"],
+        "plan_segments": len(audit.segments),
+        "plan_minibatches": audit.segments[0].minibatches,
+        # the real count, not a constant: the asserts above guarantee 0
+        # on the happy path, and a refactor that stops raising would
+        # surface here instead of silently passing the tier-1 gate
+        "findings": (len(res["findings"]) + len(audit.findings)
+                     + len(lint)),
+    }
+
+
 def _timed_once(pm, table, time_mod) -> float:
     t0 = time_mod.perf_counter()
     pm.transform(table)
@@ -291,17 +355,24 @@ def _timed_once(pm, table, time_mod) -> float:
 
 
 def main() -> int:
+    # the spmd gate verifies the parallel layer on the 8-device CPU
+    # mesh; force it BEFORE jax initializes (same flag as tests/conftest)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
     try:
         result = check_fused_crossings()
         train = check_train_prefetch()
         serve = check_serve_batching()
         obs_overhead = check_obs_overhead()
+        spmd = check_spmd_clean()
     except AssertionError as e:
         print(json.dumps({"perf_smoke": "FAIL", "reason": str(e)}))
         return 1
     print(json.dumps({"perf_smoke": "OK", **result,
                       "train_prefetch": train, "serve": serve,
-                      "obs_overhead": obs_overhead}))
+                      "obs_overhead": obs_overhead, "spmd": spmd}))
     return 0
 
 
