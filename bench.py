@@ -275,6 +275,81 @@ def bench_serve(jm, rng, n_total: int = 192) -> dict:
     return out
 
 
+def bench_serve_sharded(jm, rng, n_total: int = 192,
+                        conc: int = 8) -> dict:
+    """Sharded-serving scaling A/B: one chip (``dp=1``) vs DP-replica
+    fan-out over every local chip (``dp=N``), same request stream, same
+    bucket ladder, ``conc`` concurrent requesters.
+
+    On real multi-chip hosts the N-replica run multiplies the Round-8
+    single-chip numbers (each replica owns its chip, params uploaded once
+    per replica); on a single-device (or virtual-CPU) box the A/B
+    degenerates and the honest scaling evidence is the latency-bound
+    dryrun gate (``tools/perf_smoke.py check_serve_sharded``) — the
+    record labels which regime it measured via ``n_devices``.
+    """
+    import threading
+
+    import jax
+
+    from mmlspark_tpu.data.table import DataTable
+    from mmlspark_tpu.serve import Client, ModelServer, ServeConfig
+
+    n_dev = len(jax.local_devices())
+    meshes = [("dp1", "dp=1")]
+    if n_dev > 1:
+        meshes.append((f"dp{n_dev}", f"dp={n_dev}"))
+    imgs = rng.integers(0, 255, size=(n_total, 32 * 32 * 3)
+                        ).astype(np.uint8)
+    tables = [DataTable({"image": [imgs[i]]}) for i in range(n_total)]
+    out: dict = {"n_devices": n_dev}
+    for label, mesh in meshes:
+        server = ModelServer(ServeConfig(
+            buckets=(1, 8, 32, 128), max_queue=n_total + conc,
+            deadline_ms=None, mesh=mesh))
+        server.add_model("m", jm, example=tables[0])
+        client = Client(server)
+        errors: list[str] = []
+
+        def worker(k: int) -> None:
+            try:
+                for i in range(k, n_total, conc):
+                    client.predict("m", tables[i], timeout=600)
+            except BaseException as e:  # noqa: BLE001 — reported
+                errors.append(f"{type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=worker, args=(k,))
+                   for k in range(conc)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        snap = server.stats("m").snapshot()
+        programs = server.compiled_programs("m")
+        server.close()
+        if errors:
+            out[label] = {"error": errors[0]}
+            continue
+        e2e = snap.get("e2e_ms") or {}
+        out[label] = {
+            "rows_per_s": round(n_total / wall, 1),
+            "p99_ms": e2e.get("p99"),
+            "batches": snap.get("batches"),
+            "programs_compiled": programs,
+            "replica_batches": {k: v.get("batches")
+                                for k, v in snap["replicas"].items()},
+        }
+    first, last = out[meshes[0][0]], out[meshes[-1][0]]
+    if (len(meshes) > 1 and isinstance(first.get("rows_per_s"), float)
+            and isinstance(last.get("rows_per_s"), float)
+            and first["rows_per_s"]):
+        out["speedup"] = round(last["rows_per_s"] / first["rows_per_s"],
+                               2)
+    return out
+
+
 def main() -> None:
     import jax
 
@@ -587,6 +662,17 @@ def main() -> None:
     except Exception as e:  # best-effort metric; label failures accurately
         serve_ab = {"error": f"{type(e).__name__}: {e}"}
 
+    # sharded serving (round 9): dp=1 vs dp=N replica fan-out — every
+    # added chip should multiply the round-8 per-chip serve numbers
+    # (replica scheduler + per-replica param upload; docs/serving.md)
+    serve_sharded: dict | None = None
+    try:
+        if jm is None:
+            raise RuntimeError("inference setup failed, serve skipped")
+        serve_sharded = bench_serve_sharded(jm, rng)
+    except Exception as e:  # best-effort metric; label failures accurately
+        serve_sharded = {"error": f"{type(e).__name__}: {e}"}
+
     # BASELINE configs 3-5 (flagship models); skip with BENCH_FAST=1
     import os
     extra: dict = {}
@@ -620,6 +706,8 @@ def main() -> None:
         "serve_p99_ms": (serve_ab or {}).get(
             "dynamic_c8", {}).get("p99_ms"),
         "serve_ab": serve_ab,
+        "serve_sharded": serve_sharded,
+        "serve_sharded_speedup": (serve_sharded or {}).get("speedup"),
         "tunnel_upload_mb_s": tunnel_mb_s,
         "mxu_matmul_tf_s": mxu_tf_s,
         "fetch_rtt_ms": rtt_ms,

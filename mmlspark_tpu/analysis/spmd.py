@@ -538,14 +538,17 @@ def verify_function(fn: Callable, *args: Any, name: str = "<fn>",
 @dataclasses.dataclass(frozen=True)
 class EntryPoint:
     """A parallel module's declared sharding contract: the mesh it
-    expects, the axes it may communicate over, and whether it performs
-    capacity dispatch (enabling the count-exchange rule)."""
+    expects, the axes it may communicate over, whether it performs
+    capacity dispatch (enabling the count-exchange rule), and whether it
+    must be manual-collective-free (the serve dp-replica / GSPMD-tp
+    segment contract — XLA-inserted resharding only)."""
 
     name: str
     mesh_spec: dict
     expect_axes: tuple[str, ...]
     build: Callable                  # (mesh) -> (fn, example_args)
     capacity_dispatch: bool = False
+    expect_no_collectives: bool = False
 
 
 def _build_moe(mesh):
@@ -621,6 +624,61 @@ def _build_ulysses(mesh):
     return fn, (q, q, q)
 
 
+def _build_serve_segment(mesh):
+    """The sharded serve dispatch entry: the composite
+    ``core.plan.dispatch_segment`` jits for a lone-JaxModel segment on
+    ``mesh`` — a DP replica's sub-mesh or a GSPMD-tp model-parallel
+    layout. The contract either way: ZERO manual collectives (replicas
+    are independent; tp resharding is XLA-inserted from the param
+    shardings, never hand-rolled)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.core import plan
+    from mmlspark_tpu.core.stage import ArrayMeta
+    from mmlspark_tpu.models.bundle import ModelBundle
+    from mmlspark_tpu.models.jax_model import JaxModel
+    from mmlspark_tpu.models.zoo import MLP
+
+    d_in, width, n_out = 16, 32, 8
+    module = MLP(features=(width,), num_outputs=n_out)
+    params = jax.eval_shape(module.init, jax.random.PRNGKey(0),
+                            jnp.zeros((1, d_in), jnp.float32))["params"]
+    bundle = ModelBundle(module=module, params=params, input_spec=(d_in,),
+                         output_names=("features", "logits"))
+    jm = JaxModel(model=bundle, input_col="x", output_col="scores")
+    seg = plan.collect_segment([jm], 0,
+                               lambda c: ArrayMeta((d_in,), "float32"),
+                               min_stages=1, mesh=mesh)
+    composite, params_tuple = plan_segment_composite(seg)
+    rows = plan.dp_rounded_minibatch(8, plan.mesh_dp(mesh), 8)
+    entry = jax.ShapeDtypeStruct((rows, d_in), jnp.float32)
+    return composite, (params_tuple, entry)
+
+
+def _build_serve_pp(mesh):
+    """The pp-sharded serve segment: what a pipelined stage's
+    ``device_fn`` wraps — L stacked blocks through
+    :func:`~mmlspark_tpu.parallel.pipeline.pipeline_apply` under the
+    bucket ladder. Manual collectives allowed, over ``pp`` only."""
+    import jax
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.parallel.pipeline import pipeline_apply
+    L, D = 8, 16
+    stacked = {"w": jax.ShapeDtypeStruct((L, D, D), jnp.float32),
+               "b": jax.ShapeDtypeStruct((L, D), jnp.float32)}
+    x = jax.ShapeDtypeStruct((8, D), jnp.float32)
+
+    def block_fn(layer, h):
+        return jnp.tanh(h @ layer["w"] + layer["b"])
+
+    def fn(p, xs):
+        return pipeline_apply(block_fn, p, xs, mesh, num_microbatches=2)
+
+    return fn, (stacked, x)
+
+
 ENTRY_POINTS: tuple[EntryPoint, ...] = (
     EntryPoint("moe_apply", {"dp": 2, "ep": 4},
                ("dp", "fsdp", "ep"), _build_moe, capacity_dispatch=True),
@@ -630,6 +688,16 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
                ("sp",), _build_ring),
     EntryPoint("ulysses_attention", {"dp": 2, "sp": 4},
                ("sp",), _build_ulysses),
+    # the sharded serving entries (docs/serving.md): a DP replica's
+    # single-chip segment, the same segment GSPMD-tp-sharded, and the
+    # pipelined pp serve segment — the contracts ModelServer.add_model
+    # audits a sharded load against
+    EntryPoint("serve_dp_replica", {"dp": 1}, (), _build_serve_segment,
+               expect_no_collectives=True),
+    EntryPoint("serve_tp_segment", {"dp": 2, "tp": 4}, (),
+               _build_serve_segment, expect_no_collectives=True),
+    EntryPoint("serve_pp_segment", {"dp": 2, "pp": 4}, ("pp",),
+               _build_serve_pp),
 )
 
 
@@ -639,7 +707,8 @@ def verify_entry_point(ep: EntryPoint, devices: Any = None) -> SpmdReport:
     fn, args = ep.build(mesh)
     return verify_function(fn, *args, name=ep.name,
                            capacity_dispatch=ep.capacity_dispatch,
-                           expect_axes=ep.expect_axes)
+                           expect_axes=ep.expect_axes,
+                           expect_no_collectives=ep.expect_no_collectives)
 
 
 def verify_parallel_layer(devices: Any = None) -> dict[str, SpmdReport]:
@@ -697,14 +766,35 @@ class PlanSpmdAudit:
         return "\n".join(lines)
 
 
+def plan_segment_composite(seg: Any) -> tuple[Callable, tuple]:
+    """(composite fn, params tuple) for a fused plan segment — built by
+    ``core.plan.segment_composite``, the SAME builder the executor jits.
+    Shared by the multi-chip plan audit and the serve entry-point
+    contracts so the verified program cannot drift from the dispatched
+    one."""
+    from mmlspark_tpu.core import plan
+
+    return plan.segment_composite(seg, plan._segment_mesh(seg))
+
+
 def audit_plan_spmd(stages: list, meta_of: Callable,
-                    n_rows: int | None = None) -> PlanSpmdAudit:
+                    n_rows: int | None = None, mesh: Any = None,
+                    expect_axes: Iterable[str] | None = None
+                    ) -> PlanSpmdAudit:
     """Replay the planner's segmentation (``core/plan.collect_segment``
     with the abstract ``meta_of`` probe — same contract as the PR 2 plan
-    audit) and verify each fused segment's SPMD behavior on its own
-    inference mesh: batch sharded over the data axes, zero manual
-    collectives in the composite, minibatch sizing divisible by the dp
-    extent."""
+    audit) and verify each fused segment's SPMD behavior on its
+    inference mesh: batch sharded over the data axes, minibatch sizing
+    divisible by the dp extent, and the collective contract.
+
+    ``mesh`` pins the segments to an explicit mesh — the sharded-serving
+    audit passes a replica's sub-mesh here (the same override
+    ``serve``'s dispatch lanes pass to ``core.plan.transform_async``).
+    ``expect_axes=None`` (the default, and the dp-replica contract)
+    requires ZERO manual collectives in the composite; a tp/pp
+    model-parallel serve segment instead passes its declared
+    model-parallel axes, and any collective outside them (in particular
+    over ``dp``) is a finding."""
     import jax
 
     from mmlspark_tpu.core import plan
@@ -716,41 +806,31 @@ def audit_plan_spmd(stages: list, meta_of: Callable,
         # through the fused path (core/plan.transform_async), so the
         # audit must cover single-stage plans too — a lone JaxModel
         # with a manual collective must not audit as "no segments"
-        seg = plan.collect_segment(stages, i, meta_of, min_stages=1)
+        seg = plan.collect_segment(stages, i, meta_of, min_stages=1,
+                                   mesh=mesh)
         if seg is None:
             i += 1
             continue
-        mesh = plan._segment_mesh(seg)
-        dp = plan.mesh_dp(mesh)
-        ops = [plan._stage_device_fn(s, m)
-               for s, m in zip(seg.stages, seg.metas_in)]
-        in_cols = [s.device_input_col() for s in seg.stages]
-        out_cols = [s.device_output_col() for s in seg.stages]
-
-        def composite(all_params, x, _ops=ops, _in=in_cols, _out=out_cols,
-                      _seg=seg):
-            vals = {_seg.entry_col: x}
-            for k, op in enumerate(_ops):
-                vals[_out[k]] = op.fn(all_params[k], vals[_in[k]])
-            return tuple(vals[c] for c in _seg.out_cols)
-
-        params_tuple = tuple(op.params for op in ops)
+        seg_mesh = plan._segment_mesh(seg)
+        dp = plan.mesh_dp(seg_mesh)
+        composite, params_tuple = plan_segment_composite(seg)
         size, _ = plan._segment_minibatch(seg)
         mb_rows = plan.dp_rounded_minibatch(size, dp, n_rows or size)
         entry = jax.ShapeDtypeStruct(
             (mb_rows,) + tuple(seg.entry_meta.shape),
             seg.entry_meta.dtype)
         name = "→".join(type(s).__name__ for s in seg.stages)
-        report = verify_function(composite, params_tuple, entry,
-                                 name=f"segment[{name}]",
-                                 expect_no_collectives=True)
+        report = verify_function(
+            composite, params_tuple, entry, name=f"segment[{name}]",
+            expect_axes=expect_axes,
+            expect_no_collectives=expect_axes is None)
         # the executor shards minibatches P(('dp','fsdp')) on dim 0
         entry_state = ShardState((("dp", "fsdp"),) + ((),) * len(
             seg.entry_meta.shape))
         findings = list(report.findings)
         findings.extend(check_divisibility(
             entry_state, (mb_rows,) + tuple(seg.entry_meta.shape),
-            dict(mesh.shape), f"segment[{name}] minibatch"))
+            dict(seg_mesh.shape), f"segment[{name}] minibatch"))
         minibatches = (plan.predict_segment_minibatches(seg, n_rows)
                        if n_rows else None)
         audit.segments.append(SegmentSpmdReport(
@@ -763,7 +843,8 @@ def audit_plan_spmd(stages: list, meta_of: Callable,
 
 # ---- the repo-wide gate ----
 
-_FENCED_SOURCES = ("train/loop.py", "train/input.py", "serve/batcher.py")
+_FENCED_SOURCES = ("train/loop.py", "train/input.py", "serve/batcher.py",
+                   "serve/mesh.py")
 
 
 def verify_repo(repo_root: str | None = None,

@@ -308,13 +308,29 @@ def _coerce_entry(table: DataTable, col: str, meta: ArrayMeta
 _DEVICE_FN_MEMO: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 
-def _stage_device_fn(s: DeviceStage, meta: ArrayMeta) -> DeviceOp | None:
-    token = s.device_cache_token()
+def _stage_device_fn(s: DeviceStage, meta: ArrayMeta,
+                     mesh: Any = None) -> DeviceOp | None:
+    """The stage's device op for ``meta``, memoized.
+
+    A stage whose computation depends on the concrete mesh (e.g. a
+    pipeline-parallel stage wrapping
+    :func:`~mmlspark_tpu.parallel.pipeline.pipeline_apply` — its
+    collectives name mesh axes over specific devices) implements the
+    optional ``device_fn_mesh(meta, mesh)`` hook; the planner calls it
+    with the segment's resolved mesh at compile/verify time and falls
+    back to the plain ``device_fn`` during mesh-less planning probes
+    (shape inference only — the op's metas must match either way)."""
+    fn_mesh = getattr(s, "device_fn_mesh", None)
+    key = (s.device_cache_token(), meta,
+           None if mesh is None or fn_mesh is None else _mesh_key(mesh))
     hit = _DEVICE_FN_MEMO.get(s)
-    if hit is not None and hit[0] == token and hit[1] == meta:
-        return hit[2]
-    op = s.device_fn(meta)
-    _DEVICE_FN_MEMO[s] = (token, meta, op)
+    if hit is not None and hit[0] == key:
+        return hit[1]
+    if fn_mesh is not None and mesh is not None:
+        op = fn_mesh(meta, mesh)
+    else:
+        op = s.device_fn(meta)
+    _DEVICE_FN_MEMO[s] = (key, op)
     return op
 
 class _Segment:
@@ -323,7 +339,8 @@ class _Segment:
     def __init__(self, start: int, stages: list, entry_col: str,
                  entry_meta: ArrayMeta, metas_in: list[ArrayMeta],
                  out_cols: list[str], emitters: dict[str, int],
-                 out_metas: dict[str, ArrayMeta]):
+                 out_metas: dict[str, ArrayMeta], mesh: Any = None,
+                 shard_params: Callable | None = None):
         self.start = start
         self.stages = stages
         self.entry_col = entry_col
@@ -332,6 +349,9 @@ class _Segment:
         self.out_cols = out_cols          # first-write order
         self.emitters = emitters          # out col → index of last writer
         self.out_metas = out_metas        # out col → final meta
+        self.mesh = mesh                  # explicit mesh override (sharded
+        #                                   serving: a replica's sub-mesh)
+        self.shard_params = shard_params  # (mesh, params_tuple) → shardings
 
     @property
     def end(self) -> int:
@@ -341,7 +361,9 @@ class _Segment:
 def collect_segment(stages: list, i: int,
                     meta_of: Callable[[str], ArrayMeta | None],
                     explain: list | None = None,
-                    min_stages: int = 2) -> _Segment | None:
+                    min_stages: int = 2, mesh: Any = None,
+                    shard_params: Callable | None = None
+                    ) -> _Segment | None:
     """Root a maximal device segment at ``stages[i]``, resolving the entry
     column's layout through ``meta_of`` (a concrete-table probe at execution
     time; an abstract :class:`~mmlspark_tpu.analysis.info.TableSchema`
@@ -353,7 +375,15 @@ def collect_segment(stages: list, i: int,
     already-optimized ``transform`` path in batch execution); the serving
     entry (:func:`dispatch_segment` via :func:`transform_async`) passes 1,
     because there the win is the *asynchronous single-batch dispatch*, which
-    a lone model stage benefits from just as much as a fused run."""
+    a lone model stage benefits from just as much as a fused run.
+
+    ``mesh`` overrides the segment's inference mesh — the sharded-serving
+    entry passes a replica's sub-mesh (DP-replica fan-out) or a
+    model-parallel tp/pp mesh here instead of the stage-declared/default
+    layout. ``shard_params`` optionally overrides param placement:
+    ``(mesh, params_tuple) → shardings pytree`` (default: the generic
+    :func:`mmlspark_tpu.parallel.mesh.param_shardings` rules plus any
+    per-stage ``device_param_rules``)."""
 
     def note(msg: str) -> None:
         if explain is not None:
@@ -418,7 +448,8 @@ def collect_segment(stages: list, i: int,
                  "(a segment needs >= 2): it keeps its own transform path")
         return None
     return _Segment(i, seg_stages, entry_col, entry_meta, metas_in,
-                    out_cols, emitters, out_metas)
+                    out_cols, emitters, out_metas, mesh=mesh,
+                    shard_params=shard_params)
 
 
 def _collect_segment(stages: list, i: int, table: DataTable
@@ -451,18 +482,59 @@ def _segment_tokens(seg: _Segment) -> tuple:
 
 
 def _segment_mesh(seg: _Segment):
-    """The fused run's inference mesh: the first explicit ``mesh_spec``
-    among the segment's stages wins, else DP over every local device —
-    the same default JaxModel uses standalone, so routing a pipeline
-    through the planner never narrows its data parallelism."""
+    """The fused run's inference mesh: an explicit per-segment override
+    (sharded serving pins each replica's sub-mesh here) wins, then the
+    first explicit ``mesh_spec`` among the segment's stages, else DP over
+    every local device — the same default JaxModel uses standalone, so
+    routing a pipeline through the planner never narrows its data
+    parallelism."""
     import jax
 
     from mmlspark_tpu.parallel import mesh as mesh_lib
 
+    if seg.mesh is not None:
+        return seg.mesh
     spec = next((s.mesh_spec for s in seg.stages
                  if getattr(s, "mesh_spec", None)), None)
     return mesh_lib.make_mesh(spec or mesh_lib.MeshSpec(dp=-1),
                               jax.local_devices())
+
+
+def _mesh_key(mesh: Any) -> tuple:
+    """Hashable identity of a mesh for the compiled-segment cache: axis
+    layout plus the concrete device assignment (two replicas' sub-meshes
+    must never share one compiled entry — each owns its own device-
+    resident params)."""
+    return (tuple(sorted(mesh.shape.items())),
+            tuple(getattr(d, "id", i)
+                  for i, d in enumerate(mesh.devices.flat)))
+
+
+def _segment_param_shardings(seg: _Segment, mesh, params_tuple):
+    """Param placement for a fused run on ``mesh``: the segment's explicit
+    ``shard_params`` override wins; otherwise the generic
+    :func:`~mmlspark_tpu.parallel.mesh.param_shardings` rules (tp
+    column-sharding, fsdp zero-sharding, replicate elsewhere — a pure-dp
+    mesh replicates everything, the pre-sharded-serving behavior) with any
+    per-stage ``device_param_rules(path, leaf)`` hook consulted first.
+    ``params_tuple`` has one entry per segment stage, so rule paths are
+    ``<stage-idx>/<leaf path>``."""
+    from mmlspark_tpu.parallel import mesh as mesh_lib
+
+    if seg.shard_params is not None:
+        return seg.shard_params(mesh, params_tuple)
+    stage_rules = [getattr(s, "device_param_rules", None)
+                   for s in seg.stages]
+    if not any(stage_rules):
+        return mesh_lib.param_shardings(mesh, params_tuple)
+
+    def rules(path: str, leaf):
+        head, _, rest = path.partition("/")
+        # tuple indices render as "[k]" (SequenceKey), dict keys as "k"
+        fn = stage_rules[int(head.strip("[]"))]
+        return fn(rest, leaf) if fn is not None else None
+
+    return mesh_lib.param_shardings(mesh, params_tuple, rules)
 
 
 def _compile_segment(seg: _Segment) -> tuple:
@@ -483,14 +555,14 @@ def _compile_segment(seg: _Segment) -> tuple:
     return _compile_segment_inner(seg)
 
 
-def _compile_segment_inner(seg: "_Segment") -> tuple:
-    import jax
-
-    from mmlspark_tpu.parallel import mesh as mesh_lib
-
+def segment_composite(seg: "_Segment", mesh: Any) -> tuple:
+    """(composite fn, params tuple) for a fused segment on ``mesh`` —
+    the ONE builder of the function this module jits. The SPMD audit
+    (``analysis.spmd.plan_segment_composite``) traces the same object,
+    so the verified program can never drift from the dispatched one."""
     ops: list[DeviceOp] = []
     for s, meta_in in zip(seg.stages, seg.metas_in):
-        op = _stage_device_fn(s, meta_in)
+        op = _stage_device_fn(s, meta_in, mesh)
         if op is None:  # config changed between planning and compile
             raise RuntimeError(
                 f"{type(s).__name__}.device_fn declined at compile time")
@@ -506,17 +578,28 @@ def _compile_segment_inner(seg: "_Segment") -> tuple:
                                                 vals[in_cols[k]])
         return tuple(vals[c] for c in seg.out_cols)
 
-    params_tuple = tuple(op.params for op in ops)
+    return composite, tuple(op.params for op in ops)
+
+
+def _compile_segment_inner(seg: "_Segment") -> tuple:
+    import jax
+
+    from mmlspark_tpu.parallel import mesh as mesh_lib
+
     mesh = _segment_mesh(seg)
+    composite, params_tuple = segment_composite(seg, mesh)
     if mesh.devices.size == 1:
         target = mesh.devices.reshape(-1)[0]
         dev_params = jax.device_put(params_tuple, target)
         return jax.jit(composite), dev_params, target, 1
 
-    repl = mesh_lib.replicated(mesh)
     data = mesh_lib.batch_sharding(mesh)
-    dev_params = jax.device_put(params_tuple, repl)
-    fn = jax.jit(composite, in_shardings=(repl, data), out_shardings=data)
+    # params place by the sharding rules (replicated on a pure-dp mesh —
+    # the historical behavior; tp/pp/fsdp serve meshes shard them)
+    param_shards = _segment_param_shardings(seg, mesh, params_tuple)
+    dev_params = jax.device_put(params_tuple, param_shards)
+    fn = jax.jit(composite, in_shardings=(param_shards, data),
+                 out_shardings=data)
     return fn, dev_params, data, mesh_dp(mesh)
 
 
@@ -579,7 +662,9 @@ def _cached_segment(seg: _Segment, cache_host: Any) -> tuple:
     composite and one device-resident param upload."""
     if cache_host is None:
         return _compile_segment(seg)
-    key = (tuple(id(s) for s in seg.stages), seg.entry_col, seg.entry_meta)
+    key = (tuple(id(s) for s in seg.stages), seg.entry_col, seg.entry_meta,
+           None if seg.mesh is None else _mesh_key(seg.mesh),
+           None if seg.shard_params is None else id(seg.shard_params))
     lock = cache_host.__dict__.setdefault("_plan_lock", threading.Lock())
     with lock:
         store = cache_host.__dict__.setdefault("_plan_cache", {})
@@ -588,8 +673,10 @@ def _cached_segment(seg: _Segment, cache_host: Any) -> tuple:
         if entry is not None and entry[0] != tokens:
             entry = None  # stage config changed: recompile
         if entry is None:
-            # pin the stage objects so id() keys cannot be reused
-            entry = (tokens, _compile_segment(seg), tuple(seg.stages))
+            # pin the stage objects (and the shard_params override) so
+            # their id()-based key components cannot be reused
+            entry = (tokens, _compile_segment(seg),
+                     (tuple(seg.stages), seg.shard_params))
         else:
             del store[key]  # re-insert: LRU order = insertion order
         store[key] = entry
@@ -702,7 +789,8 @@ def dispatch_segment(seg: _Segment, table: DataTable,
 
 
 def transform_async(stages: list, table: DataTable,
-                    cache_host: Any = None) -> PendingTable:
+                    cache_host: Any = None, mesh: Any = None,
+                    shard_params: Callable | None = None) -> PendingTable:
     """Run a fitted-transformer list over one packed batch, dispatching the
     *trailing* device segment asynchronously (the serving execution engine).
 
@@ -712,7 +800,12 @@ def transform_async(stages: list, table: DataTable,
     including a lone model stage), that segment is dispatched via
     :func:`dispatch_segment` and the returned :class:`PendingTable` is
     still in flight: host packing of the next batch overlaps this batch's
-    device compute, and ``result()`` performs the blocking fetch."""
+    device compute, and ``result()`` performs the blocking fetch.
+
+    ``mesh``/``shard_params`` pin the device segments to an explicit mesh
+    and param placement (see :func:`collect_segment`) — the sharded
+    serving entry: a DP replica's sub-mesh, or a tp/pp model-parallel
+    layout for a model too big for one chip."""
     stages = list(stages)
     i = 0
     while i < len(stages):
@@ -720,7 +813,8 @@ def transform_async(stages: list, table: DataTable,
         if len(table):
             seg = collect_segment(stages, i,
                                   lambda col: _entry_meta(table, col),
-                                  min_stages=1)
+                                  min_stages=1, mesh=mesh,
+                                  shard_params=shard_params)
         if seg is not None:
             if seg.end == len(stages):
                 dispatched = dispatch_segment(seg, table, cache_host)

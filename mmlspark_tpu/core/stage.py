@@ -248,6 +248,16 @@ class DeviceStage:
     ``transform`` with identical semantics. Implementations must keep the
     device math equivalent to the host path — the parity suite
     (tests/test_plan.py) holds fused output to the documented tolerance.
+
+    Two OPTIONAL hooks extend the protocol for sharded serving
+    (docs/serving.md): ``device_fn_mesh(meta, mesh)`` — a mesh-aware
+    variant the planner prefers at compile/verify time when the segment's
+    concrete mesh is resolved (pipeline-parallel stages whose collectives
+    bind mesh axes need it; shape inference still uses the plain
+    ``device_fn``) — and ``device_param_rules(path, leaf)`` — per-leaf
+    ``PartitionSpec`` placement consulted by
+    :func:`mmlspark_tpu.parallel.mesh.param_shardings` when the segment
+    compiles on a model-parallel mesh.
     """
 
     def device_input_col(self) -> str | None:

@@ -42,23 +42,59 @@ def _args(labels: dict | None) -> dict:
             for k, v in labels.items()}
 
 
+# serve-replica spans get their own synthetic timeline lane (one per
+# (model, replica index)) so the DP fan-out's concurrency is visible
+# directly — the base is far above any real thread id's useful range and
+# stable across exports; the model digest keeps two sharded models'
+# replica-0 lanes from colliding onto one tid (Perfetto derives span
+# nesting from interval containment per tid)
+REPLICA_TID_BASE = 1 << 31
+_REPLICA_LANE_STRIDE = 4096
+
+
+def _record_lane(r: Any) -> tuple[int, str]:
+    """(tid, lane name) for one record: spans labeled with a ``replica``
+    index land on a dedicated per-(model, replica) lane instead of their
+    worker thread's, so a dp=N model renders as N parallel lanes."""
+    labels = getattr(r, "labels", None)
+    if labels:
+        rep = labels.get("replica")
+        if rep is not None:
+            try:
+                idx = int(rep)
+            except (TypeError, ValueError):
+                return r.tid, r.thread_name
+            import zlib
+            model = str(labels.get("model", ""))
+            digest = zlib.crc32(model.encode("utf-8")) % _REPLICA_LANE_STRIDE
+            tid = (REPLICA_TID_BASE + digest * _REPLICA_LANE_STRIDE
+                   + idx % _REPLICA_LANE_STRIDE)
+            name = (f"serve-replica-{idx}" if not model
+                    else f"serve-replica-{idx} [{model}]")
+            return tid, name
+    return r.tid, r.thread_name
+
+
 def chrome_trace(records: list | None = None) -> dict:
     """``{"traceEvents": [...]}`` for the given records (default: the
     runtime ring buffer). Spans become complete events (``ph: "X"``)
     whose nesting Perfetto derives from interval containment per
-    ``tid``; instants become ``ph: "i"`` thread-scoped events."""
+    ``tid``; instants become ``ph: "i"`` thread-scoped events.
+    Replica-labeled serve spans render one lane per replica
+    (:func:`_record_lane`)."""
     if records is None:
         records = _rt.spans()
     pid = os.getpid()
     events: list[dict] = []
     thread_names: dict[int, str] = {}
     for r in records:
-        thread_names.setdefault(r.tid, r.thread_name)
+        tid, lane = _record_lane(r)
+        thread_names.setdefault(tid, lane)
         if isinstance(r, SpanRecord):
             events.append({
                 "name": r.name, "cat": r.cat, "ph": "X",
                 "ts": r.start_ns / 1e3, "dur": r.dur_ns / 1e3,
-                "pid": pid, "tid": r.tid,
+                "pid": pid, "tid": tid,
                 "args": {**_args(r.labels), "span_id": r.span_id,
                          **({"parent_id": r.parent_id}
                             if r.parent_id is not None else {})},
@@ -66,7 +102,7 @@ def chrome_trace(records: list | None = None) -> dict:
         elif isinstance(r, EventRecord):
             events.append({
                 "name": r.name, "cat": r.cat, "ph": "i", "s": "t",
-                "ts": r.ts_ns / 1e3, "pid": pid, "tid": r.tid,
+                "ts": r.ts_ns / 1e3, "pid": pid, "tid": tid,
                 "args": _args(r.labels),
             })
     for tid, tname in thread_names.items():

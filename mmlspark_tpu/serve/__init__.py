@@ -13,6 +13,9 @@ compiles exactly one program per (model, bucket) — see docs/serving.md.
 * :class:`DynamicBatcher` — per-model bounded queue + coalescing dispatch
   loop with admission control, deadlines, and graceful drain.
 * :class:`Client` — in-process client (deterministic tests, the bench).
+* :mod:`mmlspark_tpu.serve.mesh` — sharded serving: DP-replica fan-out,
+  tp/pp model-parallel sub-meshes, and multi-host lockstep
+  (``ServeMeshSpec``, ``--mesh dp=N[,tp=M]`` on the CLI).
 * :mod:`mmlspark_tpu.serve.http` — stdlib-only HTTP front end (JSON +
   Arrow bodies); ``tools/serve.py`` is the CLI.
 """
@@ -25,6 +28,10 @@ from mmlspark_tpu.serve.errors import (  # noqa: F401
 from mmlspark_tpu.serve.batcher import (  # noqa: F401
     DynamicBatcher, ServeRequest, THREAD_PREFIX,
 )
+from mmlspark_tpu.serve.mesh import (  # noqa: F401
+    LockstepCoordinator, Replica, ReplicaSet, ServeMeshSpec,
+    build_replicas,
+)
 from mmlspark_tpu.serve.server import Client, ModelServer  # noqa: F401
 from mmlspark_tpu.serve.stats import ServerStats  # noqa: F401
 
@@ -34,8 +41,13 @@ __all__ = [
     "DeadlineExceeded",
     "DynamicBatcher",
     "ModelLoadError",
+    "LockstepCoordinator",
     "ModelNotFound",
     "ModelServer",
+    "Replica",
+    "ReplicaSet",
+    "ServeMeshSpec",
+    "build_replicas",
     "Overloaded",
     "ServeConfig",
     "ServeError",

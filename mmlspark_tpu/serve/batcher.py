@@ -16,14 +16,25 @@ inverted to the serving direction:
 * **packing** takes whole requests in FIFO order up to the largest bucket
   and pads to the smallest bucket that fits (a request is never split, so
   a timeout can never observe a partial result);
-* **dispatch** goes through ``core.plan.transform_async`` — one H2D
-  upload, one fused program call, one async D2H fetch round — and returns
-  while the device still computes, so host packing of batch *i+1* overlaps
-  device compute of batch *i*; the bounded in-flight window
-  (``max_inflight``) is where completed batches are drained and their
-  requests resolved;
+* **dispatch** fans out over one or more :class:`_Lane` workers — one per
+  DP replica when the model serves sharded
+  (:mod:`mmlspark_tpu.serve.mesh`), else a single lane over the model's
+  own mesh. The batcher packs on its own thread and hands the padded
+  batch to the least-loaded lane, so host packing of batch *i+1* overlaps
+  device compute of batch *i*; each lane drives
+  ``core.plan.transform_async`` — one H2D upload, one fused program call,
+  one async D2H fetch round per bucket batch — against its own sub-mesh
+  and compiled-segment cache (params uploaded once per replica), with its
+  own bounded in-flight window (``max_inflight`` per replica);
+* **lockstep** (multi-host serving) — before a collective-bearing
+  dispatch every process must quiesce and agree: the batcher calls
+  :meth:`DynamicBatcher.drain_barrier` (the PR 3 train-input fence
+  discipline — all in-flight dispatches drained) and then the
+  :class:`~mmlspark_tpu.serve.mesh.LockstepCoordinator` signature
+  exchange, so cross-process collective issue order stays identical;
 * **shutdown** (``close(drain=True)``) stops admission, answers every
-  already-admitted request, then joins the worker — no leaked thread.
+  already-admitted request, then joins the scheduler and every lane
+  worker — no leaked thread.
 """
 
 from __future__ import annotations
@@ -31,6 +42,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
+from concurrent import futures
 from typing import Any
 
 import numpy as np
@@ -71,7 +83,12 @@ def _compat_key(table: DataTable) -> tuple:
     so a wrong-shape request (same column names, different per-row
     layout) is dispatched alone and fails alone — it can never take a
     batch of well-formed neighbors down with it. A request whose own rows
-    are ragged gets a key unique to itself, for the same reason.
+    are ragged gets a "nonuniform" key carrying its full cell-by-cell
+    layout: it can only ever coalesce with an identically-ragged request
+    (both doomed to the same per-batch failure), never with a well-formed
+    one. The key is a pure function of the table's layout — the lockstep
+    dispatch signature hashes it, so identical request streams must
+    digest identically across processes and runs.
     O(rows × cols) on cheap signatures; requests are bucket-sized."""
     parts = []
     for name in sorted(table.columns):
@@ -82,8 +99,11 @@ def _compat_key(table: DataTable) -> tuple:
         sig = _cell_sig(col[0]) if len(col) else ("empty",)
         for cell in col[1:]:
             if _cell_sig(cell) != sig:
-                # internally ragged: never packable with anything
-                return ("nonuniform", id(table))
+                # internally ragged: keyed by the whole per-cell layout —
+                # every OTHER column still contributes its part, so two
+                # requests coalesce only when ALL columns line up
+                sig = ("nonuniform", tuple(_cell_sig(c) for c in col))
+                break
         parts.append((name, sig))
     return tuple(parts)
 
@@ -198,20 +218,217 @@ class ServeRequest:
         raise err
 
 
+class _Lane:
+    """One dispatch lane: a DP replica's sub-mesh (or the model's default
+    whole-mesh path) with its own worker thread, compiled-segment cache,
+    and bounded in-flight window.
+
+    The worker pulls packed bucket-batches the scheduler assigned, issues
+    the async dispatch against the lane's mesh, and drains its window —
+    at most ``max_inflight`` dispatched-but-undrained batches per lane.
+    On shutdown the worker finishes everything already assigned to it
+    (the device work is in flight; answering it costs only the drain).
+    """
+
+    __slots__ = ("batcher", "index", "cache_host", "mesh", "shard_params",
+                 "replica", "_cv", "_queue", "_window", "_closing",
+                 "_thread", "load")
+
+    def __init__(self, batcher: "DynamicBatcher", index: int,
+                 cache_host: Any, mesh: Any = None,
+                 shard_params: Any = None, replica: Any = None):
+        self.batcher = batcher
+        self.index = index
+        self.cache_host = cache_host
+        self.mesh = mesh
+        self.shard_params = shard_params
+        self.replica = replica       # serve.mesh.Replica | None
+        self._cv = threading.Condition()
+        self._queue: deque = deque()   # (packed, batch, rows, bucket)
+        self._window: deque = deque()  # (pending, batch, rows, bucket, t0)
+        self._closing = False
+        self.load = 0  # queued + in-flight batches; guarded by the
+        #                batcher's scheduler condition, not this lane's
+        self._thread = threading.Thread(
+            target=self._run,
+            name=f"{THREAD_PREFIX}[{batcher.name}]#{index}", daemon=True)
+        self._thread.start()
+
+    @property
+    def replica_index(self) -> int | None:
+        return None if self.replica is None else self.replica.index
+
+    # -- scheduler side --
+
+    def assign(self, packed: DataTable, batch: list, rows: int,
+               bucket: int) -> None:
+        with self._cv:
+            self._queue.append((packed, batch, rows, bucket))
+            self._cv.notify()
+
+    def close(self) -> None:
+        with self._cv:
+            self._closing = True
+            self._cv.notify_all()
+
+    def join(self, timeout: float) -> bool:
+        self._thread.join(timeout=timeout)
+        return not self._thread.is_alive()
+
+    # -- worker --
+
+    def _release(self) -> None:
+        """One batch fully resolved: free the load slot and wake the
+        scheduler (and any ``drain_barrier`` waiter)."""
+        cv = self.batcher._sched_cv
+        with cv:
+            self.load -= 1
+            cv.notify_all()
+
+    def _labels(self) -> dict | None:
+        if not _obs_rt._enabled:
+            return None
+        labels = {"model": self.batcher.name}
+        if self.replica is not None:
+            labels["replica"] = self.replica.index
+        return labels
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while (not self._queue and not self._window
+                       and not self._closing):
+                    self._cv.wait()
+                item = self._queue.popleft() if self._queue else None
+                closing = self._closing
+            if item is None:
+                if self._window:
+                    # idle: finish outstanding batches promptly
+                    self._drain_one()
+                    continue
+                if closing:
+                    return
+                continue
+            self._dispatch(*item)
+            if len(self._window) >= self.batcher.config.max_inflight:
+                self._drain_one()
+
+    def _dispatch(self, packed: DataTable, batch: list, rows: int,
+                  bucket: int) -> None:
+        from mmlspark_tpu.core import plan
+        now = time.monotonic()
+        if all(r._deadline is not None and now >= r._deadline
+               for r in batch):
+            # the whole batch expired while queued for this lane: cancel
+            # BEFORE dispatch (the same pre-dispatch cancellation the
+            # admission queue applies) instead of burning device time on
+            # answers nobody is waiting for
+            for r in batch:
+                if r._fail(DeadlineExceeded(self.batcher.name,
+                                            r.deadline_ms or 0.0,
+                                            "queued")):
+                    self.batcher.stats.record_expired()
+            self._release()
+            return
+        for r in batch:
+            r._mark_dispatched(now)
+        labels = self._labels()
+        try:
+            with _obs_span("serve/dispatch", "serve",
+                           {**labels, "bucket": bucket}
+                           if labels is not None else None):
+                pending = plan.transform_async(
+                    self.batcher.stages, packed, self.cache_host,
+                    mesh=self.mesh, shard_params=self.shard_params)
+        except BaseException as e:  # noqa: BLE001 — relayed per request
+            for r in batch:
+                if r._fail(e):
+                    self.batcher.stats.record_failed()
+            self._release()
+            return
+        if self.replica is not None:
+            self.replica.dispatched += 1
+        self._window.append((pending, batch, rows, bucket, now))
+
+    def _drain_one(self) -> None:
+        pending, batch, rows, bucket, t0 = self._window.popleft()
+        try:
+            labels = self._labels()
+            with _obs_span("serve/drain", "serve",
+                           {**labels, "bucket": bucket}
+                           if labels is not None else None):
+                out = pending.result()
+        except BaseException as e:  # noqa: BLE001 — relayed per request
+            _log.warning("%s lane %d: batch of %d failed: %s",
+                         self.batcher.name, self.index, rows, e)
+            for r in batch:
+                if r._fail(e):
+                    self.batcher.stats.record_failed()
+            self._release()
+            return
+        done = time.monotonic()
+        # pending.shapes is what the device actually saw (one entry per
+        # uploaded chunk) — if bucket quantization ever regresses, the
+        # distinct-shape count grows past the ladder and the perf gate
+        # trips; a host-path dispatch contributes no shapes
+        self.batcher.stats.record_batch(bucket, rows, (done - t0) * 1e3,
+                                        pending.shapes,
+                                        replica=self.replica_index)
+        if len(out) != bucket:
+            # a row-count-changing stage breaks the per-request split:
+            # offsets would shift and neighbors would silently receive
+            # each other's rows. Fail the WHOLE batch — wrong-but-
+            # plausible results are worse than a typed error
+            err = BadRequest(
+                f"model {self.batcher.name!r}: transform changed the row "
+                f"count ({bucket} in, {len(out)} out) — row-preserving "
+                "models only; per-request results cannot be attributed")
+            for r in batch:
+                if r._fail(err):
+                    self.batcher.stats.record_failed()
+            self._release()
+            return
+        offset = 0
+        for r in batch:
+            piece = out.take(np.arange(offset, offset + r.n_rows))
+            offset += r.n_rows
+            if r._resolve(piece):
+                self.batcher.stats.record_done(
+                    (done - r._submitted) * 1e3,
+                    ((r._dispatched_at or done) - r._submitted) * 1e3)
+        self._release()
+
+
 class DynamicBatcher:
     """Bounded request queue + coalescing dispatch loop for ONE model."""
 
     def __init__(self, name: str, stages: list, cache_host: Any,
-                 config: ServeConfig, stats: ServerStats | None = None):
+                 config: ServeConfig, stats: ServerStats | None = None,
+                 replicas: Any = None, lockstep: Any = None):
         self.name = name
         self.stages = list(stages)
         self.cache_host = cache_host
         self.config = config
         self.stats = stats or ServerStats(config.stats_window, model=name)
+        self.replicas = replicas     # serve.mesh.ReplicaSet | None
+        self._lockstep = lockstep    # serve.mesh.LockstepCoordinator | None
         self._cv = threading.Condition()
         self._queue: deque[ServeRequest] = deque()
         self._closed = False     # admission stopped (drain in progress)
         self._abort = False      # fail queued work instead of draining
+        # lane scheduling state: lane.load counters live under this
+        # condition; lanes notify it as batches resolve
+        self._sched_cv = threading.Condition()
+        if replicas is not None:
+            self._lanes = [
+                _Lane(self, i, rep.cache_host, mesh=rep.mesh,
+                      shard_params=rep.shard_params, replica=rep)
+                for i, rep in enumerate(replicas.replicas)]
+        else:
+            # default: ONE lane over the model's own mesh and cache, so
+            # online serving and offline transform share one compiled
+            # segment + param upload
+            self._lanes = [_Lane(self, 0, cache_host)]
         self._thread = threading.Thread(
             target=self._run, name=f"{THREAD_PREFIX}[{name}]", daemon=True)
         self._thread.start()
@@ -311,71 +528,66 @@ class DynamicBatcher:
                 cols[name] = np.concatenate(parts)
         return DataTable(cols, dict(first.meta)), bucket
 
-    def _dispatch(self, batch: list, rows: int, window: deque) -> None:
-        from mmlspark_tpu.core import plan
-        now = time.monotonic()
-        # coalesce/pack + async dispatch spans: the packing work is what
-        # overlaps device compute of the previous batch, so the timeline
-        # shows the overlap (or its absence) directly
+    def _acquire_lane(self) -> _Lane | None:
+        """Least-loaded replica pick (ties → lowest index), bounded at
+        ``max_inflight`` outstanding batches per lane — the scheduler's
+        backpressure. Blocks until a slot frees; None when aborted."""
+        with self._sched_cv:
+            while not self._abort:
+                lane = min(self._lanes, key=lambda L: (L.load, L.index))
+                if lane.load < self.config.max_inflight:
+                    lane.load += 1
+                    return lane
+                self._sched_cv.wait(timeout=0.1)
+        return None
+
+    def drain_barrier(self, poll_s: float = 0.05) -> None:
+        """Block until every assigned batch has been dispatched AND
+        drained across all lanes — the serve analog of
+        ``DeviceLoader.drain_barrier`` (PR 3): multi-host lockstep calls
+        this before the cross-process signature exchange so no process
+        interleaves the exchange with in-flight device work."""
+        with self._sched_cv:
+            while (not self._abort
+                   and any(lane.load for lane in self._lanes)):
+                self._sched_cv.wait(timeout=poll_s)
+
+    def _dispatch(self, batch: list, rows: int) -> None:
+        # pack on the scheduler thread: the packing work is what overlaps
+        # device compute of the previous batch on the lane workers, so
+        # the timeline shows the overlap (or its absence) directly
         on = _obs_rt._enabled
         with _obs_span("serve/pack", "serve",
                        {"model": self.name, "requests": len(batch),
                         "rows": rows} if on else None):
             packed, bucket = self._pack(batch, rows)
-        for r in batch:
-            r._mark_dispatched(now)
-        with _obs_span("serve/dispatch", "serve",
-                       {"model": self.name, "bucket": bucket}
-                       if on else None):
-            pending = plan.transform_async(self.stages, packed,
-                                           self.cache_host)
-        window.append((pending, batch, rows, bucket, now))
-
-    def _drain_one(self, window: deque) -> None:
-        pending, batch, rows, bucket, t0 = window.popleft()
-        try:
-            with _obs_span("serve/drain", "serve",
-                           {"model": self.name, "bucket": bucket}
-                           if _obs_rt._enabled else None):
-                out = pending.result()
-        except BaseException as e:  # noqa: BLE001 — relayed per request
-            _log.warning("ServeBatcher[%s]: batch of %d failed: %s",
-                         self.name, rows, e)
-            for r in batch:
-                if r._fail(e):
-                    self.stats.record_failed()
-            return
-        done = time.monotonic()
-        # pending.shapes is what the device actually saw (one entry per
-        # uploaded chunk) — if bucket quantization ever regresses, the
-        # distinct-shape count grows past the ladder and the perf gate
-        # trips; a host-path dispatch contributes no shapes
-        self.stats.record_batch(bucket, rows, (done - t0) * 1e3,
-                                pending.shapes)
-        if len(out) != bucket:
-            # a row-count-changing stage breaks the per-request split:
-            # offsets would shift and neighbors would silently receive
-            # each other's rows. Fail the WHOLE batch — wrong-but-
-            # plausible results are worse than a typed error
-            err = BadRequest(
-                f"model {self.name!r}: transform changed the row count "
-                f"({bucket} in, {len(out)} out) — row-preserving models "
-                "only; per-request results cannot be attributed")
-            for r in batch:
-                if r._fail(err):
-                    self.stats.record_failed()
-            return
-        offset = 0
-        for r in batch:
-            piece = out.take(np.arange(offset, offset + r.n_rows))
-            offset += r.n_rows
-            if r._resolve(piece):
-                self.stats.record_done(
-                    (done - r._submitted) * 1e3,
-                    ((r._dispatched_at or done) - r._submitted) * 1e3)
+        if self._lockstep is not None:
+            # collective lockstep: quiesce every lane (the fence), claim
+            # the dispatch slot, and only THEN agree cross-process — once
+            # agree() returns, this process dispatches unconditionally
+            # (lanes complete assigned work even on abort), so no process
+            # can advance the agreed schedule and then fail to issue the
+            # collective-bearing program it agreed to
+            self.drain_barrier()
+            lane = self._acquire_lane()
+            if lane is None:  # aborted at the fence: nothing was agreed
+                raise ServerClosed(f"model {self.name!r} closed")
+            try:
+                self._lockstep.agree((bucket, batch[0]._compat))
+            except BaseException:
+                # nothing dispatched: free the claimed slot or the next
+                # drain_barrier spins on this lane's load forever
+                with self._sched_cv:
+                    lane.load -= 1
+                    self._sched_cv.notify_all()
+                raise
+        else:
+            lane = self._acquire_lane()
+            if lane is None:  # aborted while waiting for a slot
+                raise ServerClosed(f"model {self.name!r} closed")
+        lane.assign(packed, batch, rows, bucket)
 
     def _run(self) -> None:
-        window: deque = deque()
         while not self._abort:
             batch, expired, rows = self._collect(time.monotonic())
             for r in expired:
@@ -385,17 +597,11 @@ class DynamicBatcher:
                     self.stats.record_expired()
             if batch:
                 try:
-                    self._dispatch(batch, rows, window)
+                    self._dispatch(batch, rows)
                 except BaseException as e:  # noqa: BLE001 — per-request
                     for r in batch:
                         if r._fail(e):
                             self.stats.record_failed()
-                if len(window) >= self.config.max_inflight:
-                    self._drain_one(window)
-                continue
-            if window:
-                # idle: finish outstanding batches promptly
-                self._drain_one(window)
                 continue
             with self._cv:
                 if self._queue:
@@ -408,11 +614,12 @@ class DynamicBatcher:
                 # deadline expiry never needs a timer here because a
                 # non-empty queue never reaches the wait
                 self._cv.wait()
-        # already-dispatched batches complete even on abort (the device
-        # work is in flight; answering it costs only the drain)
-        while window:
-            self._drain_one(window)
-        # abort path: fail whatever the drain never dispatched
+        # batches already assigned to lanes complete even on abort (the
+        # device work is in flight; answering it costs only the drain) —
+        # the lane workers finish their queues and windows before joining
+        for lane in self._lanes:
+            lane.close()
+        # abort path: fail whatever the scheduler never assigned
         leftovers: list[ServeRequest] = []
         with self._cv:
             leftovers.extend(self._queue)
@@ -423,34 +630,66 @@ class DynamicBatcher:
     # -- warmup --
 
     def warm(self, padded: DataTable) -> None:
-        """Compile (and cache) the program for this padded batch size by
-        executing it through the SAME dispatch path requests take.
+        """Compile (and cache) the program for this padded batch size on
+        EVERY lane by executing it through the SAME dispatch path requests
+        take — each replica owns its compiled ladder and param upload.
         Blocking; runs on the loader's thread, not the dispatch loop, and
-        records nothing in the request stats."""
+        records nothing in the request stats. Replica compiles are
+        independent (own cache host, own sub-mesh) and XLA compilation
+        releases the GIL, so lanes warm concurrently — model-load
+        latency stays ~one compile per bucket, not replicas × buckets."""
         from mmlspark_tpu.core import plan
-        plan.transform_async(self.stages, padded, self.cache_host).result()
+
+        def _one(lane: _Lane) -> None:
+            plan.transform_async(self.stages, padded, lane.cache_host,
+                                 mesh=lane.mesh,
+                                 shard_params=lane.shard_params).result()
+
+        if len(self._lanes) == 1:
+            _one(self._lanes[0])
+            return
+        with futures.ThreadPoolExecutor(
+                max_workers=len(self._lanes),
+                thread_name_prefix=f"{THREAD_PREFIX}-{self.name}-warm",
+        ) as pool:
+            for f in [pool.submit(_one, lane) for lane in self._lanes]:
+                f.result()
 
     # -- lifecycle --
 
     def close(self, drain: bool = True) -> None:
         """Stop admission; ``drain=True`` answers every admitted request
-        before the worker exits, ``drain=False`` fails queued requests
-        with :class:`ServerClosed`. Idempotent; joins the worker."""
+        before the workers exit, ``drain=False`` fails queued requests
+        with :class:`ServerClosed`. Idempotent; joins the scheduler and
+        every lane worker."""
         with self._cv:
             self._closed = True
             if not drain:
                 self._abort = True
             self._cv.notify_all()
+        with self._sched_cv:
+            self._sched_cv.notify_all()  # unblock an _acquire_lane wait
+        deadline = time.monotonic() + self.config.drain_timeout_s
         self._thread.join(timeout=self.config.drain_timeout_s)
-        if self._thread.is_alive():  # pragma: no cover - defensive
+        stuck = self._thread.is_alive()
+        for lane in self._lanes:
+            lane.close()  # idempotent; _run also closes lanes on exit
+            if not lane.join(max(deadline - time.monotonic(), 0.1)):
+                stuck = True
+        if stuck:  # pragma: no cover - defensive
             _log.warning("ServeBatcher[%s] did not stop within %.1fs",
                          self.name, self.config.drain_timeout_s)
 
     def compiled_programs(self) -> int | None:
         """XLA executables compiled for this model's serving entry — the
-        jit compile-cache hook, now owned by the obs subsystem
-        (:func:`mmlspark_tpu.obs.runtime.compiled_programs`) since every
-        layer wants the same recompile observable. ``None`` when the jit
-        object doesn't expose its cache size (older jax) — callers fall
-        back to ``stats.dispatch_shapes``."""
+        jit compile-cache hook owned by the obs subsystem
+        (:func:`mmlspark_tpu.obs.runtime.compiled_programs`). For a
+        replicated model this is the per-model LOGICAL count: the max
+        over replicas' caches (each replica compiles the same bucket
+        ladder, device-specialized), so the ladder bound stays
+        ``<= len(buckets)`` per model, not replicas × buckets. ``None``
+        when the jit object doesn't expose its cache size (older jax) —
+        callers fall back to ``stats.dispatch_shapes``."""
+        if self.replicas is not None:
+            return self.replicas.compiled_programs()
         return _obs_rt.compiled_programs(self.cache_host)

@@ -29,11 +29,17 @@ class ServeConfig:
     buckets: tuple = DEFAULT_BUCKETS
     max_queue: int = 128        # queued requests per model; admission bound
     deadline_ms: float | None = None  # default per-request deadline
-    max_inflight: int = 2       # dispatched-but-undrained batches (HBM and
-    #                             latency bound on the async window)
+    max_inflight: int = 2       # dispatched-but-undrained batches PER
+    #                             REPLICA LANE (HBM and latency bound on
+    #                             each lane's async window)
     warmup: bool = True         # compile every bucket at load time
     stats_window: int = 4096    # per-model latency reservoir bound
     drain_timeout_s: float = 30.0  # close(drain=True) join bound
+    mesh: object = None         # server-wide default serving mesh — a
+    #                             ServeMeshSpec / "dp=N[,tp=M][,pp=K]"
+    #                             string / dict (serve.mesh); None keeps
+    #                             the single whole-mesh dispatch lane.
+    #                             add_model(mesh=...) overrides per model
 
     def __post_init__(self):
         buckets = tuple(sorted({int(b) for b in self.buckets}))

@@ -65,15 +65,20 @@ class ServerClosed(ServeError):
 
 
 class ModelLoadError(ServeError):
-    """The pre-flight analyzer rejected the model at load time.
+    """The model was rejected at load time, before any device work.
 
-    Raised before any device work (no compile, no transfer); ``report``
-    is the full :class:`~mmlspark_tpu.analysis.AnalysisReport`.
+    Raised with no compile and no transfer performed, for either cause:
+    the pre-flight analyzer found errors (``report`` is the full
+    :class:`~mmlspark_tpu.analysis.AnalysisReport`), or the requested
+    serving mesh cannot be realized on this host's devices / the sharded
+    segment fails its SPMD contract (``message`` carries the reason and
+    ``report`` is None).
     """
 
-    def __init__(self, name: str, report):
-        errors = "\n  ".join(str(d) for d in report.errors)
-        super().__init__(
-            f"model {name!r} failed pre-flight analysis:\n  {errors}")
+    def __init__(self, name: str, report=None, message: str | None = None):
+        if message is None:
+            errors = "\n  ".join(str(d) for d in report.errors)
+            message = f"model {name!r} failed pre-flight analysis:\n  {errors}"
+        super().__init__(message)
         self.name = name
         self.report = report

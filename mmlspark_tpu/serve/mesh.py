@@ -1,0 +1,262 @@
+"""Sharded serving meshes — DP-replica fan-out and model-parallel tiers.
+
+One served model, many chips (docs/serving.md §sharded serving). Three
+tiers, selected per model at load time via a :class:`ServeMeshSpec`:
+
+* **DP-replica serving** (``dp=N``) — N independent replicas, each a
+  sub-mesh of ``tp × pp`` chips (one chip in the common small-model
+  case). Params upload once *per replica*, the batcher's scheduler
+  load-balances packed bucket-batches onto the least-loaded replica, and
+  each replica keeps its own bounded in-flight window — every added
+  replica multiplies the per-chip Round-8 serve throughput instead of
+  sharding a single batch thinner.
+* **model-parallel segments** (``tp=M`` / ``pp=K``) — a model too big
+  for one chip runs as ONE sharded jitted segment per replica:
+  ``core.plan`` places params by the generic sharding rules (tp
+  column-sharding via GSPMD — zero manual collectives in the composite,
+  the same invariant ``audit_plan_spmd`` enforces for dp segments) or a
+  stage's own ``device_param_rules`` hook (e.g. a pipelined stage whose
+  ``device_fn`` wraps :func:`~mmlspark_tpu.parallel.pipeline
+  .pipeline_apply` — a manual-collective segment, verified against its
+  declared ``ENTRY_POINTS`` contract in :mod:`mmlspark_tpu.analysis
+  .spmd`).
+* **multi-host lockstep** — when the serving mesh spans processes, every
+  process must issue the same sharded programs in the same order or the
+  collectives deadlock. :class:`LockstepCoordinator` reuses the
+  train-loop fence discipline (PR 3's ``drain_barrier``): the batcher
+  drains every in-flight dispatch *before* the cross-process signature
+  exchange, then all processes dispatch the agreed batch.
+
+Per-model program accounting: each replica compiles the same logical
+bucket ladder (≤ ``len(buckets)`` programs); the copies are
+device-specialized, so the per-model recompile observable reported by
+:meth:`ReplicaSet.compiled_programs` is the MAX over replicas, not the
+sum — a regression past the ladder on any replica still trips the gate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import zlib
+from typing import Any, Mapping, Sequence
+
+from mmlspark_tpu.core.logging_utils import get_logger
+from mmlspark_tpu.obs import runtime as _obs_rt
+from mmlspark_tpu.serve.errors import ModelLoadError
+
+_log = get_logger(__name__)
+
+# mesh axes a served segment may communicate over: the model-parallel
+# axes only — dp is the replica axis and must stay collective-free
+MODEL_PARALLEL_AXES = ("tp", "pp", "sp", "ep")
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeMeshSpec:
+    """Per-model serving layout: ``dp`` replicas of ``tp × pp`` chips.
+
+    ``lockstep=True`` opts a model into collective-lockstep dispatch —
+    for deployments that feed every process the identical request
+    stream. Replicas are carved from this host's local devices, so a
+    served program never contains a cross-process collective today;
+    lockstep therefore stays OFF unless requested (the dryrun harness
+    and tests exercise the discipline single-process).
+    """
+
+    dp: int = 1
+    tp: int = 1
+    pp: int = 1
+    lockstep: bool | None = None
+
+    def __post_init__(self):
+        for axis in ("dp", "tp", "pp"):
+            if int(getattr(self, axis)) < 1:
+                raise ValueError(
+                    f"serve mesh axis {axis} must be >= 1: {self}")
+
+    @property
+    def chips(self) -> int:
+        return self.dp * self.tp * self.pp
+
+    @property
+    def model_parallel(self) -> bool:
+        return self.tp > 1 or self.pp > 1
+
+    def describe(self) -> str:
+        parts = [f"dp={self.dp}"]
+        if self.tp > 1:
+            parts.append(f"tp={self.tp}")
+        if self.pp > 1:
+            parts.append(f"pp={self.pp}")
+        if self.lockstep:
+            parts.append("lockstep")
+        return ",".join(parts)
+
+    @classmethod
+    def parse(cls, value: Any) -> "ServeMeshSpec":
+        """``"dp=4,tp=2[,lockstep]"`` / mapping / spec → spec.
+
+        The CLI flag format (``tools/serve.py --mesh``): comma-separated
+        ``axis=N`` terms over ``dp``/``tp``/``pp`` plus the bare
+        ``lockstep`` toggle.
+        """
+        if isinstance(value, cls):
+            return value
+        if isinstance(value, Mapping):
+            return cls(**dict(value))
+        fields: dict[str, Any] = {}
+        for term in str(value).split(","):
+            term = term.strip()
+            if not term:
+                continue
+            if term == "lockstep":
+                fields["lockstep"] = True
+                continue
+            axis, sep, n = term.partition("=")
+            if not sep or axis not in ("dp", "tp", "pp"):
+                raise ValueError(
+                    f"bad serve mesh term {term!r} (want dp=N[,tp=M]"
+                    f"[,pp=K][,lockstep]): {value!r}")
+            try:
+                fields[axis] = int(n)
+            except ValueError as e:
+                raise ValueError(
+                    f"bad serve mesh extent {term!r}: {value!r}") from e
+        return cls(**fields)
+
+
+class _ReplicaHost:
+    """Cache host of one replica: carries the replica's compiled-segment
+    cache (``core.plan._cached_segment``) and device-resident params —
+    per replica, so params upload once per replica and the jit cache
+    stays one logical bucket ladder per replica."""
+
+
+class Replica:
+    """One dispatch target: a sub-mesh plus its own compiled-segment
+    cache (the batcher's lane carries the live load/in-flight
+    accounting). ``shard_params`` optionally overrides the segment's
+    param placement on this replica's mesh — ``(mesh, params_tuple) →
+    shardings pytree`` — instead of the generic
+    ``parallel.mesh.param_shardings`` rules."""
+
+    def __init__(self, index: int, mesh: Any, shard_params: Any = None):
+        self.index = index
+        self.mesh = mesh
+        self.shard_params = shard_params
+        self.cache_host = _ReplicaHost()
+        self.dispatched = 0    # total batches this replica served
+
+    def describe(self) -> str:
+        devs = [getattr(d, "id", "?") for d in self.mesh.devices.flat]
+        return f"replica{self.index}[devices={devs}]"
+
+
+class ReplicaSet:
+    """The per-model replica fan-out the batcher schedules over."""
+
+    def __init__(self, model: str, spec: ServeMeshSpec,
+                 replicas: list[Replica]):
+        self.model = model
+        self.spec = spec
+        self.replicas = replicas
+
+    def __len__(self) -> int:
+        return len(self.replicas)
+
+    def compiled_programs(self) -> int | None:
+        """Per-model logical XLA program count: the MAX over replicas
+        (each replica holds a device-specialized copy of the same bucket
+        ladder — the ladder bound is per model, not replicas × buckets).
+        ``None`` when any replica's jit doesn't expose its cache size."""
+        sizes = [_obs_rt.compiled_programs(r.cache_host)
+                 for r in self.replicas]
+        if any(s is None for s in sizes):
+            return None
+        return max(sizes) if sizes else 0
+
+
+def build_replicas(model: str, spec: ServeMeshSpec,
+                   devices: Sequence[Any] | None = None,
+                   shard_params: Any = None) -> ReplicaSet:
+    """Carve ``dp`` replica sub-meshes of ``tp × pp`` chips out of the
+    local devices. A mesh that does not divide the device count is a
+    typed load error (:class:`~mmlspark_tpu.serve.errors.ModelLoadError`)
+    — before any compile or transfer. ``shard_params`` (an explicit
+    param-placement override, see :class:`Replica`) applies to every
+    replica."""
+    import jax
+
+    from mmlspark_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    devices = list(devices if devices is not None
+                   else jax.local_devices())
+    chips = spec.chips
+    if chips > len(devices) or len(devices) % chips:
+        raise ModelLoadError(model, message=(
+            f"model {model!r}: serving mesh {spec.describe()} needs "
+            f"{chips} chip(s) ({spec.dp} replica(s) x {spec.tp * spec.pp} "
+            f"chip(s) each) which does not divide this host's "
+            f"{len(devices)} device(s)"))
+    per = spec.tp * spec.pp
+    sub = MeshSpec(dp=1, tp=spec.tp, pp=spec.pp)
+    replicas = [Replica(r, make_mesh(sub, devices[r * per:(r + 1) * per]),
+                        shard_params=shard_params)
+                for r in range(spec.dp)]
+    _log.info("serve[%s]: mesh %s -> %s", model, spec.describe(),
+              "; ".join(r.describe() for r in replicas))
+    return ReplicaSet(model, spec, replicas)
+
+
+def _signature_digest(signature: tuple) -> int:
+    """Stable 32-bit digest of a dispatch signature (bucket, entry
+    layout) — what lockstep processes compare before issuing the
+    collective-bearing program."""
+    return zlib.crc32(repr(signature).encode("utf-8"))
+
+
+class LockstepCoordinator:
+    """Multi-host serve lockstep: agree on every dispatch, in order.
+
+    The discipline mirrors ``train/input.py``'s multi-host rule: the
+    batcher calls its ``drain_barrier()`` (all in-flight dispatches
+    drained) *before* :meth:`agree`, so no process interleaves the
+    signature exchange with outstanding device work; then every process
+    verifies it is about to dispatch the identical (bucket, layout)
+    program. Single-process (the dryrun harness) the exchange is local
+    but the fence-then-agree ordering still runs — the discipline the
+    SPMD203 static check pins.
+    """
+
+    def __init__(self, model: str):
+        self.model = model
+        self._lock = threading.Lock()
+        self.steps = 0
+        self.fingerprint = 0   # running digest over the dispatch order
+
+    def agree(self, signature: tuple) -> None:
+        """Exchange + verify one dispatch signature across processes.
+
+        Raises ``RuntimeError`` on divergence — dispatching anyway would
+        deadlock the collectives, and a typed host-side failure beats a
+        hung mesh."""
+        import jax
+
+        digest = _signature_digest(signature)
+        if jax.process_count() > 1:  # pragma: no cover - needs multi-host
+            import numpy as np
+            from jax.experimental import multihost_utils
+            gathered = multihost_utils.process_allgather(
+                np.asarray([digest], np.uint32))
+            if len(set(int(g) for g in gathered.reshape(-1))) != 1:
+                raise RuntimeError(
+                    f"serve lockstep divergence on model "
+                    f"{self.model!r}: processes disagree on dispatch "
+                    f"{self.steps} signature ({signature!r}) — feed "
+                    "every process the identical request sequence")
+        with self._lock:
+            self.steps += 1
+            self.fingerprint = zlib.crc32(
+                digest.to_bytes(4, "little"),
+                self.fingerprint)

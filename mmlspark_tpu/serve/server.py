@@ -112,11 +112,12 @@ def _example_rows(schema: Any, n: int) -> DataTable | None:
 
 class _ModelEntry:
     def __init__(self, name: str, model: Any, batcher: DynamicBatcher,
-                 schema: Any | None):
+                 schema: Any | None, mesh_spec: Any | None = None):
         self.name = name
         self.model = model
         self.batcher = batcher
         self.schema = schema
+        self.mesh_spec = mesh_spec
 
 
 class ModelServer:
@@ -136,18 +137,29 @@ class ModelServer:
 
     def add_model(self, name: str, model: Any,
                   schema: Any | None = None,
-                  example: DataTable | None = None) -> None:
+                  example: DataTable | None = None,
+                  mesh: Any = None, shard_params: Any = None) -> None:
         """Register ``model`` under ``name``.
 
         1. **Validate** with the pre-flight analyzer over ``schema`` (or a
            schema derived from the model's own input contract, or an
            inexact empty schema) — error diagnostics raise
            :class:`ModelLoadError` before any device work.
-        2. **Warm** the bucket ladder when concrete example rows are
+        2. **Shard** (optional): ``mesh`` (or the server-wide
+           ``ServeConfig.mesh``) selects the model's serving tier —
+           ``dp=N`` replica fan-out and/or ``tp``/``pp`` model-parallel
+           sub-meshes (:mod:`mmlspark_tpu.serve.mesh`); ``shard_params``
+           optionally overrides every replica's param placement
+           (``(mesh, params_tuple) → shardings``). A mesh that does
+           not divide the host's device count, or a sharded segment that
+           violates its SPMD contract (manual collectives on a dp
+           replica; off-contract axes under tp/pp), is a typed
+           :class:`ModelLoadError` — still before any device work.
+        3. **Warm** the bucket ladder when concrete example rows are
            available (``example``, or rows synthesized from the schema):
            one compiled program per bucket exists before the first
-           request.
-        3. **Start** the model's dispatch loop.
+           request, on EVERY replica.
+        4. **Start** the model's dispatch loop (one lane per replica).
         """
         from mmlspark_tpu.analysis import TableSchema, analyze
 
@@ -160,9 +172,40 @@ class ModelServer:
         if not report.ok:
             raise ModelLoadError(name, report)
 
+        mesh = mesh if mesh is not None else self.config.mesh
+        replicas = lockstep = mesh_spec = None
+        if mesh is not None:
+            from mmlspark_tpu.serve.mesh import (
+                LockstepCoordinator, ServeMeshSpec, build_replicas,
+            )
+            mesh_spec = ServeMeshSpec.parse(mesh)
+            if mesh_spec.lockstep and mesh_spec.dp > 1:
+                # lockstep drains every lane before each agreed dispatch,
+                # so extra DP replicas could never serve a batch — they'd
+                # only cost dp× warm compiles and param HBM. Typed error
+                # beats silently serializing a fan-out the caller paid for.
+                raise ModelLoadError(name, message=(
+                    f"model {name!r}: lockstep serving dispatches one "
+                    f"agreed batch at a time, which is incompatible with "
+                    f"dp={mesh_spec.dp} replica fan-out — use dp=1 for "
+                    f"lockstep models, or drop lockstep for DP scaling"))
+            replicas = build_replicas(name, mesh_spec,
+                                      shard_params=shard_params)
+            self._audit_sharded(name, stages, schema, mesh_spec, replicas)
+            # lockstep only on request: build_replicas carves sub-meshes
+            # of THIS host's devices, so no serve program today contains
+            # a cross-process collective — auto-enabling on process
+            # count would fence (and allgather-stall) multi-host
+            # processes that serve independent local traffic. The flag
+            # exists for callers that feed every process the identical
+            # stream (the dryrun harness; a future cross-process mesh).
+            if mesh_spec.lockstep:
+                lockstep = LockstepCoordinator(name)
+
         stats = ServerStats(self.config.stats_window, model=name)
         batcher = DynamicBatcher(name, stages, cache_host, self.config,
-                                 stats)
+                                 stats, replicas=replicas,
+                                 lockstep=lockstep)
         try:
             if self.config.warmup:
                 warm = example
@@ -182,11 +225,46 @@ class ModelServer:
                 batcher.close(drain=False)
                 raise ServerClosed("server is closed")
             old = self._models.get(name)
-            self._models[name] = _ModelEntry(name, model, batcher, schema)
+            self._models[name] = _ModelEntry(name, model, batcher, schema,
+                                             mesh_spec)
         if old is not None:
             old.batcher.close(drain=True)
-        _log.info("serve[%s]: loaded (%d stage(s), buckets=%s)", name,
-                  len(stages), self.config.buckets)
+        _log.info("serve[%s]: loaded (%d stage(s), buckets=%s, mesh=%s)",
+                  name, len(stages), self.config.buckets,
+                  mesh_spec.describe() if mesh_spec else "default")
+
+    def _audit_sharded(self, name: str, stages: list, schema: Any,
+                       mesh_spec: Any, replicas: Any) -> None:
+        """Static SPMD gate for a sharded serve entry, at load time.
+
+        The served segment runs on every replica's sub-mesh, so it must
+        honor the sharded-serving contract *before* any compile: a
+        DP-replica segment stays manual-collective-free (replicas are
+        independent — a collective would deadlock the fan-out), and a
+        tp/pp model-parallel segment may communicate only over its
+        model-parallel axes, never ``dp``. Needs a concrete entry layout;
+        a model with no derivable schema skips the audit (the analyzer
+        already passed) and relies on the repo-wide
+        ``check_spmd_clean`` gate."""
+        if schema is None or not replicas.replicas:
+            return
+        from mmlspark_tpu.analysis.spmd import audit_plan_spmd
+        from mmlspark_tpu.serve.mesh import MODEL_PARALLEL_AXES
+
+        expect_axes = (tuple(a for a in MODEL_PARALLEL_AXES)
+                       if mesh_spec.model_parallel else None)
+        try:
+            audit = audit_plan_spmd(stages, schema.entry_meta,
+                                    mesh=replicas.replicas[0].mesh,
+                                    expect_axes=expect_axes)
+        except Exception as e:  # abstract trace failed: not a verdict
+            _log.info("serve[%s]: sharded SPMD audit skipped (%s)",
+                      name, e)
+            return
+        if not audit.ok:
+            raise ModelLoadError(name, message=(
+                f"model {name!r} failed the sharded-serving SPMD audit "
+                f"on mesh {mesh_spec.describe()}:\n" + audit.format()))
 
     def _warm(self, batcher: DynamicBatcher, example: DataTable) -> None:
         """Compile every bucket by running one padded batch per rung
@@ -243,6 +321,8 @@ class ModelServer:
             programs = e.batcher.compiled_programs()
             if programs is not None:
                 snap["programs_compiled"] = programs
+            if e.mesh_spec is not None:
+                snap["mesh"] = e.mesh_spec.describe()
             out[e.name] = snap
         return out
 

@@ -127,7 +127,8 @@ class ServerStats:
     # -- batch side --
 
     def record_batch(self, bucket: int, occupancy: int, device_ms: float,
-                     shapes: tuple = ()) -> None:
+                     shapes: tuple = (),
+                     replica: int | None = None) -> None:
         self._batches.add()
         self._rows_dispatched.add(occupancy)
         self._rows_padded.add(max(bucket - occupancy, 0))
@@ -135,6 +136,22 @@ class ServerStats:
         self._occupancy.observe(occupancy)
         self.registry.counter("serve.bucket_batches",
                               bucket=int(bucket), **self._lbl).add()
+        if replica is not None:
+            # replica-labeled series (sharded serving): per-replica
+            # dispatch counts, occupancy, and device-time percentiles
+            # stay distinguishable in /metrics and the snapshot — the
+            # load-balance observable of the DP fan-out
+            r = int(replica)
+            self.registry.counter("serve.replica_batches",
+                                  replica=r, **self._lbl).add()
+            self.registry.counter("serve.replica_rows",
+                                  replica=r, **self._lbl).add(occupancy)
+            self.registry.histogram("serve.replica_device_ms",
+                                    window=self._window, replica=r,
+                                    **self._lbl).observe(device_ms)
+            self.registry.histogram("serve.replica_occupancy",
+                                    window=self._window, replica=r,
+                                    **self._lbl).observe(occupancy)
         if shapes:
             with self._shape_lock:
                 for s in shapes:
@@ -150,6 +167,19 @@ class ServerStats:
             int(dict(c.labels)["bucket"]): int(c.value)
             for c in self.registry.series("serve.bucket_batches")
         }
+        replicas: dict[int, dict] = {}
+        for c in self.registry.series("serve.replica_batches"):
+            replicas.setdefault(int(dict(c.labels)["replica"]),
+                                {})["batches"] = int(c.value)
+        for c in self.registry.series("serve.replica_rows"):
+            replicas.setdefault(int(dict(c.labels)["replica"]),
+                                {})["rows_dispatched"] = int(c.value)
+        for h in self.registry.series("serve.replica_device_ms"):
+            replicas.setdefault(int(dict(h.labels)["replica"]),
+                                {})["device_ms"] = h.percentiles()
+        for h in self.registry.series("serve.replica_occupancy"):
+            replicas.setdefault(int(dict(h.labels)["replica"]),
+                                {})["occupancy_mean"] = h.mean()
         with self._shape_lock:
             n_shapes = len(self.dispatch_shapes)
         return {
@@ -168,4 +198,8 @@ class ServerStats:
             "queue_wait_ms": self._queue_ms.percentiles(),
             "device_ms": self._device_ms.percentiles(),
             "distinct_batch_shapes": n_shapes,
+            # per-replica breakdown (empty unless the model serves
+            # sharded): dispatch counts / rows / device-time percentiles
+            # keyed by replica index — the DP fan-out's load balance
+            "replicas": {k: replicas[k] for k in sorted(replicas)},
         }

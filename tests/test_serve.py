@@ -545,3 +545,420 @@ class TestStatsPreTraffic:
         # the per-instance registry exposes the same series for /metrics
         reg_snap = stats.registry.snapshot()
         assert reg_snap["counters"]["serve.admitted{model=m}"] == 3
+
+
+# ---- sharded serving (serve.mesh): DP replicas, tp/pp segments, lockstep ----
+
+
+from mmlspark_tpu.core.stage import (  # noqa: E402
+    ArrayMeta, DeviceOp, DeviceStage, HasInputCol, HasOutputCol,
+    Transformer,
+)
+from mmlspark_tpu.serve import ServeMeshSpec  # noqa: E402
+
+
+class PipelinedTanh(Transformer, DeviceStage, HasInputCol, HasOutputCol):
+    """Test-only pp-served model: L tanh blocks. The host ``transform``
+    is the sequential reference; the mesh-aware device op runs the SAME
+    blocks through ``parallel.pipeline.pipeline_apply`` on the segment's
+    replica mesh (the pp serving tier), with the stacked layer axis
+    placed over ``pp`` via the ``device_param_rules`` hook."""
+
+    from mmlspark_tpu.core.params import Param
+    layers = Param(default=None, is_complex=True,
+                   doc="list of {'w','b'} numpy layer dicts")
+    microbatches = Param(default=2, type_=int, doc="pipeline microbatches")
+
+    def transform(self, table):
+        x = table.column_matrix(self.input_col, dtype=np.float32)
+        for layer in self.layers:
+            x = np.tanh(x @ layer["w"] + layer["b"])
+        return table.with_column(self.output_col, list(x))
+
+    # -- DeviceStage --
+
+    def device_cache_token(self):
+        return (id(self.layers), self.microbatches, self.input_col,
+                self.output_col)
+
+    def _stacked(self):
+        return {k: np.stack([np.asarray(layer[k], np.float32)
+                             for layer in self.layers])
+                for k in ("w", "b")}
+
+    def _dim(self):
+        return int(np.asarray(self.layers[0]["w"]).shape[0])
+
+    def device_fn(self, meta):
+        # mesh-less planning/shape probe: the sequential layer scan
+        import jax
+        import jax.numpy as jnp
+        d = self._dim()
+        if tuple(meta.shape) != (d,):
+            return None
+
+        def fwd(params, x):
+            def body(h, layer):
+                return jnp.tanh(h @ layer["w"] + layer["b"]), None
+            h, _ = jax.lax.scan(body, x.astype(jnp.float32), params)
+            return h
+
+        return DeviceOp(fwd, ArrayMeta((d,), "float32"),
+                        params=self._stacked())
+
+    def device_fn_mesh(self, meta, mesh):
+        if mesh.shape.get("pp", 1) == 1:
+            return self.device_fn(meta)
+        d = self._dim()
+        if tuple(meta.shape) != (d,):
+            return None
+        m = int(self.microbatches)
+
+        def fwd(params, x):
+            import jax.numpy as jnp
+
+            from mmlspark_tpu.parallel.pipeline import pipeline_apply
+
+            def block(layer, h):
+                return jnp.tanh(h @ layer["w"] + layer["b"])
+
+            return pipeline_apply(block, params, x.astype(jnp.float32),
+                                  mesh, num_microbatches=m)
+
+        return DeviceOp(fwd, ArrayMeta((d,), "float32"),
+                        params=self._stacked())
+
+    def device_param_rules(self, path, leaf):
+        from jax.sharding import PartitionSpec as P
+        return P("pp")  # stacked layer axis over the pipeline stages
+
+
+class CollectiveLeak(Transformer, DeviceStage, HasInputCol, HasOutputCol):
+    """A served segment smuggling a MANUAL collective — what the
+    load-time sharded SPMD audit must reject on a dp-replica mesh."""
+
+    def transform(self, table):
+        return table.with_column(
+            self.output_col,
+            list(table.column_matrix(self.input_col, dtype=np.float32)))
+
+    def device_cache_token(self):
+        return (self.input_col, self.output_col)
+
+    def device_fn(self, meta):
+        import jax.numpy as jnp
+
+        def fwd(params, x):
+            return x.astype(jnp.float32)
+
+        return DeviceOp(fwd, ArrayMeta(tuple(meta.shape), "float32"),
+                        params=())
+
+    def device_fn_mesh(self, meta, mesh):
+        from jax.sharding import PartitionSpec as P
+
+        from mmlspark_tpu.parallel.mesh import shard_map
+
+        def fwd(params, x):
+            import jax
+
+            def body(v):
+                return jax.lax.psum(v, "pp")
+
+            return shard_map(body, mesh=mesh, in_specs=(P(),),
+                             out_specs=P(), check_vma=False)(
+                                 x.astype(np.float32))
+
+        return DeviceOp(fwd, ArrayMeta(tuple(meta.shape), "float32"),
+                        params=())
+
+
+def _score_rows(outs, spans):
+    """request outputs -> {source row index: [score arrays seen]}."""
+    seen: dict[int, list] = {}
+    for out, (off, n) in zip(outs, spans):
+        for k in range(n):
+            seen.setdefault(off + k, []).append(
+                np.asarray(out["scores"][k]))
+    return seen
+
+
+class TestShardedServing:
+    def _serve_packed(self, mesh, sizes, rows, buckets=(1, 4, 16)):
+        jm = JaxModel(model=mlp_bundle(), input_col="x",
+                      output_col="scores")
+        with ModelServer(ServeConfig(buckets=buckets, max_queue=128,
+                                     mesh=mesh)) as server:
+            server.add_model("mlp", jm, example=vector_table(rows[:1]))
+            handles, spans, off = [], [], 0
+            for n in sizes:
+                if off + n > len(rows):
+                    off = 0
+                handles.append(server.submit(
+                    "mlp", vector_table(rows[off:off + n])))
+                spans.append((off, n))
+                off += n
+            outs = [h.result(timeout=120) for h in handles]
+            snap = server.stats("mlp").snapshot()
+            programs = server.compiled_programs("mlp")
+        return outs, spans, snap, programs
+
+    def test_dp_outputs_bit_identical_across_replica_counts_and_packings(
+            self):
+        """The acceptance pin: dp=N serving is bit-identical to
+        single-chip (dp=1) serving for every packing and request
+        interleaving, with compiled programs on the ladder per model."""
+        rng = np.random.default_rng(11)
+        rows = rng.normal(size=(40, 6)).astype(np.float32)
+        sizes = [1, 2, 3, 5, 1, 4, 7, 1, 16, 2, 3, 5]
+        reference: dict[int, np.ndarray] = {}
+        for mesh, order in (("dp=1", sizes),
+                            ("dp=2", list(reversed(sizes))),
+                            ("dp=4", sizes)):
+            outs, spans, snap, programs = self._serve_packed(
+                mesh, order, rows)
+            assert programs is None or programs <= 3, (mesh, programs)
+            assert snap["distinct_batch_shapes"] <= 3
+            dp = int(mesh.split("=")[1])
+            assert set(snap["replicas"]) <= set(range(dp))
+            assert sum(v["batches"] for v in snap["replicas"].values()) \
+                == snap["batches"]
+            for idx, arrays in _score_rows(outs, spans).items():
+                for arr in arrays:
+                    ref = reference.setdefault(idx, arr)
+                    assert np.array_equal(ref, arr), (
+                        f"{mesh}: row {idx} diverged from dp=1 serving")
+
+    def test_dp_fanout_spreads_load_and_labels_replica_stats(self):
+        rng = np.random.default_rng(12)
+        rows = rng.normal(size=(64, 6)).astype(np.float32)
+        jm = JaxModel(model=mlp_bundle(), input_col="x",
+                      output_col="scores")
+        with ModelServer(ServeConfig(buckets=(4,), max_queue=128,
+                                     mesh="dp=4")) as server:
+            server.add_model("mlp", jm, example=vector_table(rows[:1]))
+            handles = [server.submit("mlp", vector_table(rows[i:i + 4]))
+                       for i in range(0, 64, 4)]
+            for h in handles:
+                h.result(timeout=120)
+            snap = server.stats("mlp").snapshot()
+            reg = server.stats("mlp").registry.snapshot()["counters"]
+        assert snap["batches"] == 16
+        assert len(snap["replicas"]) >= 2, (
+            f"least-loaded scheduling never fanned out: "
+            f"{snap['replicas']}")
+        for idx, rep in snap["replicas"].items():
+            assert rep["batches"] >= 1
+            assert rep["device_ms"] is not None
+            # the replica label is a first-class series in the registry
+            assert reg[f"serve.replica_batches{{model=mlp,replica={idx}}}"] \
+                == rep["batches"]
+
+    def test_tp_segment_matches_offline_transform(self):
+        """Model-parallel tier: a tp=2-sharded serve segment (params
+        column-sharded, GSPMD resharding only) equals the offline
+        transform within the plan parity tolerance."""
+        jm = JaxModel(model=mlp_bundle(), input_col="x",
+                      output_col="scores")
+        rng = np.random.default_rng(13)
+        rows = rng.normal(size=(24, 6)).astype(np.float32)
+        offline = jm.transform(vector_table(rows))
+        with ModelServer(ServeConfig(buckets=(1, 8), max_queue=64,
+                                     mesh="dp=1,tp=2")) as server:
+            server.add_model("mlp", jm, example=vector_table(rows[:1]))
+            handles = [server.submit("mlp", vector_table(rows[i:i + 8]))
+                       for i in range(0, 24, 8)]
+            outs = [h.result(timeout=120) for h in handles]
+            snap = server.snapshot()["mlp"]
+        assert snap["mesh"] == "dp=1,tp=2"
+        row = 0
+        for out in outs:
+            for k in range(len(out)):
+                assert np.allclose(np.asarray(out["scores"][k]),
+                                   np.asarray(offline["scores"][row]),
+                                   atol=1e-5)
+                row += 1
+        assert row == 24
+
+    def test_shard_params_override_reaches_the_replica_lanes(self):
+        """add_model(shard_params=...) overrides every replica's param
+        placement — the explicit-placement escape hatch for models the
+        generic rules misplace."""
+        from mmlspark_tpu.parallel import mesh as mesh_lib
+        calls = []
+
+        def override(mesh, params):
+            calls.append(dict(mesh.shape))
+            return mesh_lib.param_shardings(mesh, params)
+
+        jm = JaxModel(model=mlp_bundle(), input_col="x",
+                      output_col="scores")
+        rng = np.random.default_rng(17)
+        rows = rng.normal(size=(8, 6)).astype(np.float32)
+        offline = jm.transform(vector_table(rows))
+        with ModelServer(ServeConfig(buckets=(8,), max_queue=16,
+                                     mesh="dp=1,tp=2")) as server:
+            server.add_model("mlp", jm, example=vector_table(rows[:1]),
+                             shard_params=override)
+            out = server.predict("mlp", vector_table(rows), timeout=60)
+        assert calls and all(c["tp"] == 2 for c in calls)
+        for k in range(8):
+            assert np.allclose(np.asarray(out["scores"][k]),
+                               np.asarray(offline["scores"][k]),
+                               atol=1e-5)
+
+    def test_pp_segment_matches_offline_transform(self):
+        """Pipeline-parallel tier: a pp=4 serve segment (stacked layers
+        over the pp ring via pipeline_apply, under the same bucket
+        ladder) equals the sequential host transform."""
+        rng = np.random.default_rng(14)
+        d, n_layers = 16, 8
+        layers = [{"w": (rng.normal(size=(d, d)) / np.sqrt(d)
+                         ).astype(np.float32),
+                   "b": rng.normal(size=d).astype(np.float32) * 0.1}
+                  for _ in range(n_layers)]
+        stage = PipelinedTanh(layers=layers, microbatches=2,
+                              input_col="x", output_col="y")
+        rows = rng.normal(size=(16, d)).astype(np.float32)
+        offline = stage.transform(vector_table(rows))
+        with ModelServer(ServeConfig(buckets=(8,), max_queue=64,
+                                     mesh="pp=4")) as server:
+            server.add_model("pp", stage, example=vector_table(rows[:1]))
+            handles = [server.submit("pp", vector_table(rows[i:i + 8]))
+                       for i in range(0, 16, 8)]
+            outs = [h.result(timeout=120) for h in handles]
+            programs = server.compiled_programs("pp")
+        assert programs is None or programs <= 1
+        row = 0
+        for out in outs:
+            for k in range(len(out)):
+                assert np.allclose(np.asarray(out["y"][k]),
+                                   np.asarray(offline["y"][row]),
+                                   atol=1e-5), f"row {row}"
+                row += 1
+        assert row == 16
+
+    def test_mesh_that_does_not_divide_devices_is_typed_load_error(self):
+        jm = JaxModel(model=mlp_bundle(), input_col="x",
+                      output_col="scores")
+        for bad in ("dp=3", "dp=16", "dp=2,tp=3"):
+            with ModelServer(ServeConfig(warmup=False)) as server:
+                with pytest.raises(ModelLoadError, match="does not divide"):
+                    server.add_model("mlp", jm, mesh=bad)
+                assert server.models() == []
+
+    def test_mesh_spec_parse_round_trip_and_errors(self):
+        spec = ServeMeshSpec.parse("dp=4,tp=2")
+        assert (spec.dp, spec.tp, spec.pp) == (4, 2, 1)
+        assert spec.chips == 8 and spec.describe() == "dp=4,tp=2"
+        assert ServeMeshSpec.parse({"dp": 2}).describe() == "dp=2"
+        assert ServeMeshSpec.parse("dp=1,lockstep").lockstep is True
+        for bad in ("dp", "dp=x", "sp=2"):
+            with pytest.raises(ValueError):
+                ServeMeshSpec.parse(bad)
+
+    def test_lockstep_rejects_dp_fanout(self):
+        """Lockstep serializes dispatch behind the drain fence, so a
+        dp>1 fan-out could never be used — typed load error, no device
+        work."""
+        jm = JaxModel(model=mlp_bundle(), input_col="x",
+                      output_col="scores")
+        with ModelServer(ServeConfig(warmup=False)) as server:
+            with pytest.raises(ModelLoadError, match="lockstep"):
+                server.add_model("mlp", jm, mesh="dp=2,lockstep")
+            assert server.models() == []
+
+    def test_compat_key_is_deterministic_and_keys_every_column(self):
+        """The batch-compatibility key is a pure function of layout (the
+        lockstep signature hashes it): ragged columns key by their full
+        cell-by-cell layout WITHOUT dropping the other columns, so
+        requests whose ragged columns agree but whose entry columns
+        differ never coalesce."""
+        from mmlspark_tpu.serve.batcher import _compat_key
+        ragged = [np.zeros(3, np.float32), np.zeros(5, np.float32)]
+
+        def key(width):
+            return _compat_key(DataTable(
+                {"x": [np.zeros(width, np.float32)] * 2,
+                 "tags": list(ragged)}))
+
+        assert key(4) == key(4)          # deterministic across tables
+        assert key(4) != key(8)          # ragged col can't mask 'x'
+        uniform = _compat_key(DataTable(
+            {"x": [np.zeros(4, np.float32)] * 2,
+             "tags": [np.zeros(3, np.float32)] * 2}))
+        assert key(4) != uniform         # never packs with well-formed
+
+    def test_sharded_audit_rejects_manual_collective_segment(self):
+        from mmlspark_tpu.analysis import ColumnInfo, TableSchema
+        stage = CollectiveLeak(input_col="x", output_col="y")
+        schema = TableSchema({"x": ColumnInfo.vector(8, "float32")})
+        with ModelServer(ServeConfig(warmup=False)) as server:
+            with pytest.raises(ModelLoadError, match="SPMD"):
+                server.add_model("leak", stage, schema=schema, mesh="dp=2")
+            assert server.models() == []
+
+    def test_lockstep_fences_and_agrees_every_dispatch(self):
+        """Collective-lockstep serving: every dispatched batch passes the
+        drain fence + signature agreement, in order (the multi-host
+        discipline, exercised single-process on the dryrun mesh)."""
+        jm = JaxModel(model=mlp_bundle(), input_col="x",
+                      output_col="scores")
+        rng = np.random.default_rng(15)
+        rows = rng.normal(size=(24, 6)).astype(np.float32)
+        offline = jm.transform(vector_table(rows))
+        with ModelServer(ServeConfig(buckets=(8,), max_queue=64,
+                                     mesh="tp=2,lockstep")) as server:
+            server.add_model("mlp", jm, example=vector_table(rows[:1]))
+            handles = [server.submit("mlp", vector_table(rows[i:i + 8]))
+                       for i in range(0, 24, 8)]
+            outs = [h.result(timeout=120) for h in handles]
+            coord = server._entry("mlp").batcher._lockstep
+            snap = server.stats("mlp").snapshot()
+        assert coord is not None and coord.steps == snap["batches"]
+        assert coord.fingerprint != 0
+        row = 0
+        for out in outs:
+            for k in range(len(out)):
+                assert np.allclose(np.asarray(out["scores"][k]),
+                                   np.asarray(offline["scores"][row]),
+                                   atol=1e-5)
+                row += 1
+
+    def test_replica_spans_render_one_timeline_lane_per_replica(self):
+        from mmlspark_tpu import obs
+        from mmlspark_tpu.obs.export import REPLICA_TID_BASE, chrome_trace
+        jm = JaxModel(model=mlp_bundle(), input_col="x",
+                      output_col="scores")
+        rng = np.random.default_rng(16)
+        rows = rng.normal(size=(32, 6)).astype(np.float32)
+        obs.enable()
+        try:
+            obs.clear()
+            with ModelServer(ServeConfig(buckets=(4,), max_queue=64,
+                                         mesh="dp=2")) as server:
+                server.add_model("mlp", jm,
+                                 example=vector_table(rows[:1]))
+                handles = [server.submit("mlp",
+                                         vector_table(rows[i:i + 4]))
+                           for i in range(0, 32, 4)]
+                for h in handles:
+                    h.result(timeout=120)
+                used = sorted(server.stats("mlp").snapshot()["replicas"])
+            trace = chrome_trace()
+        finally:
+            obs.disable()
+            obs.clear()
+        lanes = {e["args"]["name"]: e["tid"] for e in trace["traceEvents"]
+                 if e.get("ph") == "M"}
+        # one synthetic lane per (model, replica), above the tid base so
+        # real worker-thread lanes can never collide with it
+        for idx in used:
+            name = f"serve-replica-{idx} [mlp]"
+            assert name in lanes and lanes[name] >= REPLICA_TID_BASE, lanes
+        # replica spans actually moved onto the synthetic lanes
+        replica_tids = {e["tid"] for e in trace["traceEvents"]
+                        if e.get("ph") == "X"
+                        and e["args"].get("replica") is not None}
+        assert replica_tids == {lanes[f"serve-replica-{i} [mlp]"]
+                                for i in used}

@@ -4,7 +4,7 @@ Usage::
 
     python tools/serve.py <model-path> [--name NAME] [--host H] [--port P]
         [--buckets 1,8,32,128] [--max-queue N] [--deadline-ms D]
-        [--schema schema.json] [--no-warmup]
+        [--mesh dp=N[,tp=M][,pp=K]] [--schema schema.json] [--no-warmup]
 
 ``<model-path>`` is any of
 
@@ -78,6 +78,12 @@ def main(argv: list[str] | None = None) -> int:
                     help="queued requests per model before Overloaded")
     ap.add_argument("--deadline-ms", type=float, default=1000.0,
                     help="default per-request deadline (0 = none)")
+    ap.add_argument("--mesh", default=None,
+                    help="serving mesh: dp=N[,tp=M][,pp=K][,lockstep] — "
+                         "N DP replicas of M×K chips each (sharded "
+                         "serving, docs/serving.md). The load fails with "
+                         "a typed ModelLoadError when the mesh does not "
+                         "divide this host's device count")
     ap.add_argument("--schema", default=None,
                     help="JSON column-spec file (tools/analyze.py format) "
                          "used for validation + bucket warmup")
@@ -102,11 +108,21 @@ def main(argv: list[str] | None = None) -> int:
         with open(args.schema, "r", encoding="utf-8") as fh:
             schema = TableSchema.from_spec(json.load(fh))
 
+    mesh = None
+    if args.mesh:
+        from mmlspark_tpu.serve.mesh import ServeMeshSpec
+        try:
+            mesh = ServeMeshSpec.parse(args.mesh)
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+
     config = ServeConfig(
         buckets=tuple(int(b) for b in args.buckets.split(",")),
         max_queue=args.max_queue,
         deadline_ms=args.deadline_ms or None,
-        warmup=not args.no_warmup)
+        warmup=not args.no_warmup,
+        mesh=mesh)
     server = ModelServer(config)
     try:
         for model_name, model in _load_models(args.model, args.name):
@@ -124,6 +140,7 @@ def main(argv: list[str] | None = None) -> int:
         "buckets": list(config.buckets),
         "max_queue": config.max_queue,
         "deadline_ms": config.deadline_ms,
+        "mesh": mesh.describe() if mesh is not None else None,
     }), flush=True)
     try:
         httpd.serve_forever()
