@@ -23,6 +23,16 @@ timeline:
   them with the device timeline.
 * :mod:`~mmlspark_tpu.obs.runtime` — enable/disable plus the jit
   compile-cache hook (promoted here from the serve layer).
+* :mod:`~mmlspark_tpu.obs.context` — **request-scoped tracing**: trace
+  ids minted at admission, bound across thread hops, fan-in/fan-out
+  span links, and the ``request_traces``/``check_journey`` read side.
+* :mod:`~mmlspark_tpu.obs.slo` — the **SLO engine**: declarative
+  objectives (``SLOSpec``), windowed error-budget burn rates computed
+  from registry reads only (``SLOTracker``), and the train-loop
+  slow-step detector.
+* :mod:`~mmlspark_tpu.obs.health` — the **ok/degraded/unhealthy state
+  machine** (fast/slow burn + reject-ratio classification, hysteretic
+  recovery) behind the serving health surfaces.
 
 Everything is CPU-safe and jax-free at import time. See
 docs/observability.md for the architecture and the instrumented seams.
@@ -37,18 +47,36 @@ from mmlspark_tpu.obs.runtime import (  # noqa: F401
 )
 from mmlspark_tpu.obs.runtime import spans as captured  # noqa: F401
 from mmlspark_tpu.obs.spans import event, span  # noqa: F401
+from mmlspark_tpu.obs.context import (  # noqa: F401
+    REQUEST_JOURNEY, bind, check_journey, mint, request_traces,
+)
 from mmlspark_tpu.obs.export import (  # noqa: F401
-    chrome_trace, metrics_snapshot, write_chrome_trace, write_snapshot,
+    chrome_trace, metrics_snapshot, prometheus_text, write_chrome_trace,
+    write_snapshot,
+)
+from mmlspark_tpu.obs.slo import (  # noqa: F401
+    SLOSpec, SLOTracker, SlowStepDetector,
+)
+from mmlspark_tpu.obs.health import (  # noqa: F401
+    HealthMonitor, HealthPolicy,
 )
 
 __all__ = [
     "Counter",
     "EventRecord",
     "Gauge",
+    "HealthMonitor",
+    "HealthPolicy",
     "Histogram",
     "MetricsRegistry",
+    "REQUEST_JOURNEY",
+    "SLOSpec",
+    "SLOTracker",
+    "SlowStepDetector",
     "SpanRecord",
+    "bind",
     "captured",
+    "check_journey",
     "chrome_trace",
     "clear",
     "compiled_programs",
@@ -57,7 +85,10 @@ __all__ = [
     "enabled",
     "event",
     "metrics_snapshot",
+    "mint",
+    "prometheus_text",
     "registry",
+    "request_traces",
     "span",
     "spans",
     "write_chrome_trace",

@@ -1,0 +1,150 @@
+"""Request-scoped tracing — trace ids across the batcher's thread hops.
+
+The span tracer (``obs/spans.py``) nests per thread, which is exactly
+wrong for a served request: its journey is admission on an HTTP handler
+thread, packing on the batcher's scheduler thread, dispatch + D2H drain
+on a replica lane's worker thread, and resolution back on the caller.
+Per-thread timelines shatter that causal chain (the Dapper gap —
+Sigelman et al., 2010). This module is the stitch:
+
+* :func:`mint` — a process-unique **trace id**, minted at admission
+  (``DynamicBatcher.submit``). One id per request, for its whole life.
+* :func:`bind` — a context manager installing a trace id as the
+  thread's **active request context**; every span recorded while bound
+  carries it in ``SpanRecord.trace``. This is how a span "belongs to" a
+  request without threading an argument through every call.
+* **links** — fan-in/fan-out edges: a bucket-batch span (pack,
+  dispatch, drain) runs on behalf of N coalesced requests at once, so it
+  records ``links=(t1, …, tN)`` instead of a single trace
+  (``span(..., links=...)``). N request flows converge into the batch
+  span on pack and diverge back out at the per-request
+  ``serve/complete`` span — rendered as Perfetto flow arrows by
+  :func:`mmlspark_tpu.obs.export.chrome_trace`.
+* :func:`request_traces` / :func:`check_journey` — the structured read
+  side: group captured spans by trace id and validate that one
+  request's chain (``REQUEST_JOURNEY``) is intact — what the tier-1
+  ``check_obs_request_tracing`` gate asserts for every completed
+  request of a dp-fan-out burst.
+
+Everything here is gated the same way as the tracer: ``mint()`` is one
+module-flag check returning None when obs is disabled, and a None trace
+binds/records as nothing.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Iterable
+
+from mmlspark_tpu.obs import runtime as _rt
+from mmlspark_tpu.obs.events import SpanRecord
+
+_tls = threading.local()
+
+# one served request's causal chain, in dispatch order. admit/complete
+# are per-request spans carrying the trace id itself; pack/dispatch/
+# drain are per-bucket-batch spans carrying it in their links
+REQUEST_JOURNEY = ("serve/admit", "serve/pack", "serve/dispatch",
+                   "serve/drain", "serve/complete")
+# the per-request endpoints of the chain (exactly one of each per trace)
+_ENDPOINTS = ("serve/admit", "serve/complete")
+
+
+def mint() -> int | None:
+    """A fresh trace id, or None when the tracer is disabled (one
+    module-flag check — the admission hot path's whole disabled cost)."""
+    if not _rt._enabled:
+        return None
+    return _rt.next_trace_id()
+
+
+def current() -> int | None:
+    """The calling thread's active request trace id (None outside any
+    bound request)."""
+    return getattr(_tls, "trace", None)
+
+
+class _Bind:
+    """Context manager installing (and restoring) the thread's active
+    trace id. Re-entrant: the previous binding is saved per instance."""
+
+    __slots__ = ("_trace", "_prev")
+
+    def __init__(self, trace: int | None):
+        self._trace = trace
+
+    def __enter__(self) -> int | None:
+        self._prev = getattr(_tls, "trace", None)
+        _tls.trace = self._trace
+        return self._trace
+
+    def __exit__(self, *exc: Any) -> bool:
+        _tls.trace = self._prev
+        return False
+
+
+def bind(trace: int | None) -> _Bind:
+    """Install ``trace`` as the thread's active request context for the
+    ``with`` body; spans recorded inside carry it. ``bind(None)``
+    deliberately clears the context (a worker reused across requests
+    must not leak the previous request's id into unrelated spans)."""
+    return _Bind(trace)
+
+
+# ---- structured read side ----
+
+def span_trace_ids(record: SpanRecord) -> tuple:
+    """Every trace id one span touches: its own trace plus its links."""
+    ids = () if record.trace is None else (record.trace,)
+    if record.links:
+        ids = ids + tuple(record.links)
+    return ids
+
+
+def request_traces(records: Iterable | None = None
+                   ) -> dict[int, list[SpanRecord]]:
+    """Captured spans grouped by trace id (default: the runtime ring
+    buffer), each group sorted by start time — one entry per request
+    observed, containing its whole journey including the shared
+    bucket-batch spans it was coalesced into."""
+    if records is None:
+        records = _rt.spans()
+    out: dict[int, list[SpanRecord]] = {}
+    for r in records:
+        if not isinstance(r, SpanRecord):
+            continue
+        for tid in span_trace_ids(r):
+            out.setdefault(tid, []).append(r)
+    for spans in out.values():
+        spans.sort(key=lambda s: (s.start_ns, s.span_id))
+    return out
+
+
+def check_journey(spans: list[SpanRecord],
+                  journey: tuple = REQUEST_JOURNEY) -> str | None:
+    """None when one request's span chain is intact, else a reason.
+
+    Intact means: exactly one ``serve/admit`` and one ``serve/complete``
+    (the per-request endpoints), at least one of every other journey
+    span (the batch spans the request was fanned into), and start times
+    that respect the causal order admission → pack → dispatch → drain →
+    complete. Used for COMPLETED requests — an expired/failed request
+    legitimately stops mid-journey."""
+    by_name: dict[str, list[SpanRecord]] = {}
+    for s in spans:
+        by_name.setdefault(s.name, []).append(s)
+    for name in journey:
+        got = by_name.get(name, [])
+        if not got:
+            return f"missing {name!r} span"
+        if name in _ENDPOINTS and len(got) != 1:
+            return (f"{len(got)} {name!r} spans for one request "
+                    "(want exactly 1)")
+    prev_name, prev_start = None, None
+    for name in journey:
+        start = min(s.start_ns for s in by_name[name])
+        if prev_start is not None and start < prev_start:
+            return (f"{name!r} starts before {prev_name!r} — the "
+                    "causal chain is out of order")
+        prev_name, prev_start = name, start
+    return None

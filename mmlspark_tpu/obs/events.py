@@ -12,15 +12,26 @@ from typing import Any
 
 
 class SpanRecord:
-    """One completed span: a named, labeled interval on one thread."""
+    """One completed span: a named, labeled interval on one thread.
+
+    ``trace`` and ``links`` are the request-scoped tracing fields
+    (``obs/context.py``): ``trace`` is the request trace id the span was
+    recorded under (inherited from the thread's active request context),
+    and ``links`` is the tuple of OTHER trace ids a fan-in/fan-out span
+    touches (a bucket-batch span links every coalesced request's trace).
+    Both default to None so nesting/threading stay unchanged for spans
+    recorded outside any request.
+    """
 
     __slots__ = ("name", "cat", "start_ns", "dur_ns", "tid", "thread_name",
-                 "span_id", "parent_id", "depth", "labels")
+                 "span_id", "parent_id", "depth", "labels", "trace",
+                 "links")
 
     def __init__(self, name: str, cat: str, start_ns: int, dur_ns: int,
                  tid: int, thread_name: str, span_id: int,
                  parent_id: int | None, depth: int,
-                 labels: dict | None):
+                 labels: dict | None, trace: int | None = None,
+                 links: tuple | None = None):
         self.name = name
         self.cat = cat
         self.start_ns = start_ns
@@ -31,6 +42,8 @@ class SpanRecord:
         self.parent_id = parent_id
         self.depth = depth
         self.labels = labels
+        self.trace = trace
+        self.links = links
 
     @property
     def end_ns(self) -> int:
@@ -43,6 +56,8 @@ class SpanRecord:
             "tid": self.tid, "thread_name": self.thread_name,
             "span_id": self.span_id, "parent_id": self.parent_id,
             "depth": self.depth, "labels": self.labels or {},
+            "trace": self.trace,
+            "links": list(self.links) if self.links else [],
         }
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid

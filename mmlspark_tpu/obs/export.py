@@ -8,21 +8,34 @@
   Perfetto's legacy importer): complete ``"ph": "X"`` events with
   microsecond ``ts``/``dur``, one ``tid`` lane per thread, span labels
   in ``args``. Thread-name metadata events give lanes readable names.
+  Request-scoped trace ids (``obs/context.py``) additionally render as
+  **flow events** (``ph: "s"/"t"/"f"``): one flow per request, stepping
+  through every span that carries its trace id — so the fan-in of N
+  admitted requests into one bucket-batch span and the fan-out back to
+  their per-request completions draw as arrows across lanes.
   Host spans recorded while ``enable(device_annotations=True)`` also
   entered ``jax.profiler`` annotations, so a simultaneous XProf capture
   carries the same names on its device timeline — load both traces in
   Perfetto to correlate.
+* :func:`prometheus_text` — one or more metrics registries in the
+  Prometheus text exposition format (the ``/metrics`` endpoint body
+  under ``Accept: text/plain`` content negotiation), so standard
+  scrapers consume the same registry the JSON snapshot serves.
 """
 
 from __future__ import annotations
 
 import json
+import math
 import os
+import re
 from typing import Any
 
 from mmlspark_tpu.obs import runtime as _rt
 from mmlspark_tpu.obs.events import EventRecord, SpanRecord
-from mmlspark_tpu.obs.metrics import registry
+from mmlspark_tpu.obs.metrics import (
+    Counter, Gauge, Histogram, MetricsRegistry, registry,
+)
 
 
 def metrics_snapshot() -> dict:
@@ -81,12 +94,17 @@ def chrome_trace(records: list | None = None) -> dict:
     whose nesting Perfetto derives from interval containment per
     ``tid``; instants become ``ph: "i"`` thread-scoped events.
     Replica-labeled serve spans render one lane per replica
-    (:func:`_record_lane`)."""
+    (:func:`_record_lane`), and request trace ids render as flow
+    events (:func:`_flow_events`) so one request's journey draws as
+    arrows across lanes."""
     if records is None:
         records = _rt.spans()
     pid = os.getpid()
     events: list[dict] = []
     thread_names: dict[int, str] = {}
+    # trace id -> the spans carrying it (own trace or links), with the
+    # lane each renders on — the flow-event pass below walks these
+    flows: dict[int, list[tuple[SpanRecord, int]]] = {}
     for r in records:
         tid, lane = _record_lane(r)
         thread_names.setdefault(tid, lane)
@@ -97,20 +115,57 @@ def chrome_trace(records: list | None = None) -> dict:
                 "pid": pid, "tid": tid,
                 "args": {**_args(r.labels), "span_id": r.span_id,
                          **({"parent_id": r.parent_id}
-                            if r.parent_id is not None else {})},
+                            if r.parent_id is not None else {}),
+                         **({"trace": r.trace}
+                            if r.trace is not None else {}),
+                         **({"links": list(r.links)} if r.links else {})},
             })
+            if r.trace is not None:
+                flows.setdefault(r.trace, []).append((r, tid))
+            for link in r.links or ():
+                flows.setdefault(link, []).append((r, tid))
         elif isinstance(r, EventRecord):
             events.append({
                 "name": r.name, "cat": r.cat, "ph": "i", "s": "t",
                 "ts": r.ts_ns / 1e3, "pid": pid, "tid": tid,
                 "args": _args(r.labels),
             })
+    events.extend(_flow_events(flows, pid))
     for tid, tname in thread_names.items():
         events.append({
             "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
             "args": {"name": tname},
         })
     return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def _flow_events(flows: dict[int, list[tuple[SpanRecord, int]]],
+                 pid: int) -> list[dict]:
+    """Perfetto flow events for the request traces: per trace id, a
+    flow start (``ph: "s"``) anchored in its first span, a step
+    (``"t"``) in every intermediate span, and a finish (``"f"``) in the
+    last — each bound to its enclosing slice (``bp: "e"``, timestamp at
+    the span's midpoint so the binding is unambiguous). In the Perfetto
+    UI this draws the admission → pack → dispatch → drain → complete
+    arrows of one request across the scheduler/lane/replica lanes —
+    including the N-into-1 fan-in at pack and the 1-into-N fan-out at
+    completion, because batch spans participate in every linked flow."""
+    out: list[dict] = []
+    for flow_id, touched in flows.items():
+        if len(touched) < 2:
+            continue  # an arrow needs two ends
+        touched = sorted(touched, key=lambda t: (t[0].start_ns,
+                                                 t[0].span_id))
+        last = len(touched) - 1
+        for i, (r, tid) in enumerate(touched):
+            out.append({
+                "name": "request", "cat": "serve.request",
+                "ph": "s" if i == 0 else ("f" if i == last else "t"),
+                "id": flow_id, "bp": "e",
+                "ts": (r.start_ns + r.dur_ns / 2) / 1e3,
+                "pid": pid, "tid": tid,
+            })
+    return out
 
 
 def write_chrome_trace(path: str, records: list | None = None) -> str:
@@ -147,3 +202,104 @@ def summarize_spans(records: list | None = None,
         row["total_ms"] = round(row["total_ms"], 3)
         row["mean_ms"] = round(row["total_ms"] / row["calls"], 3)
     return rows
+
+
+# ---- Prometheus text exposition (the /metrics content-negotiated body) ----
+
+_PROM_NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    """Registry series name → a legal Prometheus metric name (dots and
+    other separators become underscores; a leading digit is prefixed)."""
+    name = _PROM_NAME_BAD.sub("_", name)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def _prom_labels(labels: tuple, extra: tuple = ()) -> str:
+    """``(k, v)`` label pairs → ``{k="v",...}`` with value escaping per
+    the exposition format (backslash, quote, newline)."""
+    pairs = tuple(labels) + tuple(extra)
+    if not pairs:
+        return ""
+    parts = []
+    for k, v in pairs:
+        val = str(v).replace("\\", r"\\").replace('"', r"\"")
+        val = val.replace("\n", r"\n")
+        parts.append(f'{_prom_name(str(k))}="{val}"')
+    return "{" + ",".join(parts) + "}"
+
+
+def _prom_value(v: float) -> str:
+    v = float(v)
+    if math.isnan(v):
+        # the registry is the shared substrate — one client recording a
+        # NaN/Inf (zero-denominator ratio, say) must not 500 the whole
+        # scrape; these are the official Prometheus text literals
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if v == int(v):
+        return str(int(v))
+    return repr(v)
+
+
+def prometheus_text(registries: list[MetricsRegistry] | None = None) -> str:
+    """Every metric of the given registries (default: the process-wide
+    one) in the Prometheus text exposition format (version 0.0.4).
+
+    Counters/gauges map directly; histograms expose as summaries —
+    ``name{quantile="0.5|0.95|0.99"}`` over the bounded window plus the
+    exact lifetime ``name_count``/``name_sum``. A ``# TYPE`` header is
+    emitted once per metric name across all registries (per-model serve
+    registries contribute the same names under different labels), and
+    unset gauges are skipped (Prometheus has no null). Series within a
+    name are emitted in sorted order so consecutive scrapes of the same
+    state are byte-identical."""
+    if registries is None:
+        registries = [registry()]
+    # name -> (type string, [(sorted label text, sample lines)])
+    by_name: dict[str, tuple[str, list]] = {}
+
+    def _add(name: str, kind: str, lines: list[tuple[str, str]]) -> None:
+        slot = by_name.setdefault(name, (kind, []))
+        slot[1].extend(lines)
+
+    for reg in registries:
+        for m in reg.iter_metrics():
+            name = _prom_name(m.name)
+            if isinstance(m, Counter):
+                _add(name, "counter",
+                     [(f"{name}{_prom_labels(m.labels)}",
+                       _prom_value(m.value))])
+            elif isinstance(m, Gauge):
+                v = m.value
+                if v is None:
+                    continue
+                _add(name, "gauge",
+                     [(f"{name}{_prom_labels(m.labels)}",
+                       _prom_value(v))])
+            elif isinstance(m, Histogram):
+                pct = m.percentiles(ndigits=None)
+                lines = []
+                if pct is not None:
+                    for q, key in (("0.5", "p50"), ("0.95", "p95"),
+                                   ("0.99", "p99")):
+                        lines.append((
+                            f"{name}"
+                            f"{_prom_labels(m.labels, (('quantile', q),))}",
+                            _prom_value(pct[key])))
+                lines.append((f"{name}_count{_prom_labels(m.labels)}",
+                              _prom_value(m.count)))
+                lines.append((f"{name}_sum{_prom_labels(m.labels)}",
+                              _prom_value(m.sum)))
+                _add(name, "summary", lines)
+    chunks: list[str] = []
+    for name in sorted(by_name):
+        kind, lines = by_name[name]
+        chunks.append(f"# TYPE {name} {kind}")
+        chunks.extend(f"{series} {value}" for series, value
+                      in sorted(lines))
+    return "\n".join(chunks) + ("\n" if chunks else "")

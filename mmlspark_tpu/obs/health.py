@@ -1,0 +1,168 @@
+"""Health surfaces — the ok/degraded/unhealthy state machine.
+
+Burn rates and latency verdicts (:mod:`mmlspark_tpu.obs.slo`) are
+instantaneous signals; a health endpoint needs a *state* that neither
+flaps on one bad sample nor lingers green through a sustained burn.
+This module is that state machine, deliberately tiny and deterministic:
+
+* **classification** (:func:`classify`) maps one SLO status dict to a
+  level — ``unhealthy`` when the short-window burn crosses the
+  fast-burn threshold or admission is bouncing a majority of arrivals
+  (the reject-ratio rule: ``Overloaded`` is backpressure, and sustained
+  backpressure is an unhealthy service even while completed requests
+  still succeed); ``degraded`` on sustained long-window burn or a
+  violated latency objective backed by fresh short-window traffic (the
+  e2e reservoir freezes when traffic stops — a stale spike must not
+  hold the verdict); ``ok`` otherwise.
+* **hysteresis** (:class:`HealthMonitor`): worsening applies
+  immediately (a page must not wait), improving requires
+  ``recover_after`` consecutive better samples (a flapping service is
+  not healthy).
+
+Readiness is the health state plus **drain-awareness**: a draining
+server (or model) reports itself not-ready so load balancers stop
+sending traffic. Liveness is deliberately NOT derived from any of
+this — ``/livez`` answers 200 whenever the process serves HTTP, so an
+alive-but-burning or draining server fails readiness without getting
+restarted. The ``/healthz``/``/livez``/``/slo`` wiring lives in
+``serve/server.py`` + ``serve/http.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any
+
+OK = "ok"
+DEGRADED = "degraded"
+UNHEALTHY = "unhealthy"
+
+SEVERITY = {OK: 0, DEGRADED: 1, UNHEALTHY: 2}
+
+
+def worst(states: list[str]) -> str:
+    """The most severe of a set of states (``ok`` for an empty set —
+    a server with no models is trivially healthy)."""
+    return max(states, key=SEVERITY.__getitem__, default=OK)
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthPolicy:
+    """Thresholds of the state machine. ``fast_burn``/``slow_burn``
+    default from the SLO spec that drives the monitor;
+    ``reject_ratio`` is the fraction of window arrivals bounced by
+    admission control at which the model is unhealthy regardless of
+    burn (needs ``min_events`` arrivals for a verdict);
+    ``recover_after`` is the hysteresis depth — consecutive
+    better-level samples required before the state improves."""
+
+    fast_burn: float = 14.0
+    slow_burn: float = 2.0
+    reject_ratio: float = 0.5
+    min_events: int = 10
+    recover_after: int = 3
+
+
+def classify(status: dict, policy: HealthPolicy) -> tuple[str, str]:
+    """(level, reason) for one :meth:`SLOTracker.sample` status dict.
+    Pure function of the status — the monitor owns the memory."""
+    burn_short = status.get("burn_rate_short")
+    if burn_short is not None and burn_short >= policy.fast_burn:
+        return UNHEALTHY, (
+            f"short-window burn {burn_short:.1f}x >= "
+            f"{policy.fast_burn:g}x budget")
+    short = status.get("window_short") or {}
+    arrivals = (short.get("admitted") or 0) + (short.get("rejected") or 0)
+    if arrivals >= policy.min_events:
+        ratio = (short.get("rejected") or 0) / arrivals
+        if ratio >= policy.reject_ratio:
+            return UNHEALTHY, (
+                f"admission rejecting {ratio:.0%} of arrivals "
+                f"(>= {policy.reject_ratio:.0%})")
+    burn_long = status.get("burn_rate_long")
+    if burn_long is not None and burn_long >= policy.slow_burn:
+        return DEGRADED, (
+            f"long-window burn {burn_long:.1f}x >= "
+            f"{policy.slow_burn:g}x budget")
+    if status.get("latency_ok") is False \
+            and (short.get("terminal") or 0) >= policy.min_events:
+        # the e2e reservoir freezes when traffic stops, so a latency
+        # violation only counts while the short window carries fresh
+        # terminal traffic (the burn verdicts' no-traffic rule) —
+        # otherwise one cold-compile spike would hold DEGRADED forever,
+        # with the hysteresis recovery never able to fire
+        spec = status.get("slo") or {}
+        return DEGRADED, (
+            f"latency {status.get('latency_ms'):.1f} ms exceeds the "
+            f"{spec.get('latency_quantile', 'p99')} objective "
+            f"{spec.get('latency_ms')} ms")
+    return OK, ""
+
+
+class HealthMonitor:
+    """Hysteretic health state of one served model.
+
+    ``update(status)`` classifies the sample and advances the state:
+    a worse level applies immediately; a better level must be observed
+    ``recover_after`` times in a row — at that SAME level — before the
+    state steps down to it (a worse sample, or a different better
+    level, resets the streak; UNHEALTHY cannot jump straight to OK on
+    one quiet sample after a run of DEGRADED ones).
+    ``state``/``reason`` are the last-transition verdict the health
+    surfaces expose.
+    """
+
+    __slots__ = ("policy", "state", "reason", "_streak", "_candidate",
+                 "_lock")
+
+    def __init__(self, policy: HealthPolicy | None = None):
+        self.policy = policy or HealthPolicy()
+        self.state = OK
+        self.reason = ""
+        self._streak = 0
+        self._candidate: str | None = None
+        # /healthz and /slo handler threads advance the same monitor;
+        # an unsynchronized read-modify-write of the streak would let
+        # two concurrent good samples count as recover_after progress
+        # twice (or lose a worsening transition)
+        self._lock = threading.Lock()
+
+    @classmethod
+    def for_spec(cls, spec: Any) -> "HealthMonitor":
+        """A monitor whose burn thresholds come from an
+        :class:`~mmlspark_tpu.obs.slo.SLOSpec`."""
+        return cls(HealthPolicy(fast_burn=spec.fast_burn,
+                                slow_burn=spec.slow_burn,
+                                min_events=spec.min_requests))
+
+    def update(self, status: dict) -> str:
+        return self.update_describe(status)["state"]
+
+    def update_describe(self, status: dict) -> dict:
+        """Advance the machine and return ``{state, reason}`` from the
+        SAME locked transition — pairing :meth:`update` with a later
+        read of ``.reason`` can interleave with a concurrent poller's
+        transition and report one verdict's state with another's
+        reason."""
+        level, reason = classify(status, self.policy)
+        with self._lock:
+            if SEVERITY[level] > SEVERITY[self.state]:
+                self.state, self.reason = level, reason
+                self._streak, self._candidate = 0, None
+            elif level == self.state:
+                self._streak, self._candidate = 0, None
+                if reason:
+                    self.reason = reason
+            else:
+                if level != self._candidate:
+                    self._candidate, self._streak = level, 1
+                else:
+                    self._streak += 1
+                if self._streak >= self.policy.recover_after:
+                    self.state, self.reason = level, reason
+                    self._streak, self._candidate = 0, None
+            return {"state": self.state, "reason": self.reason}
+
+    def describe(self) -> dict:
+        return {"state": self.state, "reason": self.reason}

@@ -20,6 +20,7 @@ observable every layer wants, so :func:`jit_cache_size` /
 
 from __future__ import annotations
 
+import itertools
 import threading
 from collections import deque
 from typing import Any
@@ -91,6 +92,21 @@ def captured_count() -> int:
 
 def span_records() -> list[SpanRecord]:
     return [r for r in spans() if isinstance(r, SpanRecord)]
+
+
+# ---- request trace ids (obs/context.py) ----
+
+# process-wide monotonic trace-id source: itertools.count.__next__ is a
+# single CPython bytecode step, so ids are unique without a lock even
+# when every HTTP handler thread mints at once
+_trace_ids = itertools.count(1)
+
+
+def next_trace_id() -> int:
+    """A fresh, process-unique request trace id (never reused; surviving
+    ``clear()`` on purpose — a cleared buffer must not let a new request
+    collide with ids already serialized into an exported trace)."""
+    return next(_trace_ids)
 
 
 # ---- the jit compile-cache hook (promoted from serve/batcher.py) ----

@@ -1,0 +1,400 @@
+"""SLO engine — declarative objectives, windowed burn rates, derived
+signals. Registry reads only.
+
+The SRE framing (Beyer et al., *Site Reliability Engineering*, 2016):
+an SLO is a **latency objective** plus an **error budget** (1 −
+objective), and the operational signal is the **burn rate** — how many
+times faster than budget the service is consuming its error allowance,
+measured over a short window (paging speed) and a long window
+(sustained degradation). This module computes all of it *purely from
+the existing obs registry*: the ``serve.*`` counters and histograms
+:class:`~mmlspark_tpu.serve.stats.ServerStats` already records. No new
+side-channel counters — the one-substrate rule of docs/observability.md
+holds, and the crossing counters stay bit-for-bit equal to
+``plan.count_crossings``.
+
+* :class:`SLOSpec` — the declarative objective (success ratio, latency
+  target at a quantile, burn windows + thresholds).
+* :class:`SLOTracker` — samples a :class:`ServerStats` registry on
+  demand (each ``/slo`` or ``/healthz`` poll is one sample), keeps a
+  time-bounded ring of counter snapshots, and computes short/long
+  window burn rates from the deltas. It also publishes the **derived
+  gauges** downstream consumers need — ``serve.queue_depth`` (the
+  replica-autoscaling signal), ``serve.occupancy_mean_window`` and
+  ``serve.replica_skew`` (the adaptive-bucket-ladder signals) and the
+  burn gauges themselves — back into the same per-model registry, so
+  ``/metrics`` exports them like any other series.
+* :class:`SlowStepDetector` — the train-loop analog: a rolling-median
+  outlier detector over per-step dispatch time (``train.step_ms``
+  histogram), flagging steps slower than ``factor ×`` the window median
+  as ``train/slow_step`` events + a ``train.slow_steps`` counter.
+
+The health state machine these signals drive lives in
+:mod:`mmlspark_tpu.obs.health`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Any, Callable
+
+import numpy as np
+
+from mmlspark_tpu.obs import runtime as _rt
+from mmlspark_tpu.obs.metrics import registry as _registry
+from mmlspark_tpu.obs.spans import event as _event
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOSpec:
+    """One service-level objective, declaratively.
+
+    ``objective`` is the success-ratio target over terminal requests
+    (completed vs. rejected/expired/timed-out/failed); its complement is
+    the error budget. ``latency_ms`` (optional) is the latency objective
+    at ``latency_quantile`` over the e2e reservoir. Burn rates are
+    evaluated over ``window_s`` (short — the fast-burn page signal) and
+    ``long_window_s`` (sustained); ``fast_burn``/``slow_burn`` are the
+    multiples of budget-rate at which the health layer calls the model
+    unhealthy/degraded. Windows with fewer than ``min_requests``
+    terminal requests return no burn verdict (no traffic ≠ no errors).
+    """
+
+    name: str = "serve-default"
+    objective: float = 0.999
+    latency_ms: float | None = None
+    latency_quantile: str = "p99"
+    window_s: float = 60.0
+    long_window_s: float = 300.0
+    fast_burn: float = 14.0
+    slow_burn: float = 2.0
+    min_requests: int = 10
+
+    def __post_init__(self):
+        if not 0.0 < self.objective < 1.0:
+            raise ValueError(
+                f"SLO objective must be in (0, 1): {self.objective}")
+        if self.latency_quantile not in ("p50", "p95", "p99"):
+            raise ValueError(
+                f"latency_quantile must be p50/p95/p99: "
+                f"{self.latency_quantile!r}")
+        if self.window_s <= 0 or self.long_window_s < self.window_s:
+            raise ValueError(
+                f"need 0 < window_s <= long_window_s, got "
+                f"{self.window_s}/{self.long_window_s}")
+        if self.min_requests < 1:
+            # min_requests is the zero-traffic guard: a window below it
+            # returns no verdict instead of dividing by its (possibly
+            # zero) terminal count
+            raise ValueError(
+                f"min_requests must be >= 1: {self.min_requests}")
+        if not (self.fast_burn > 0 and self.slow_burn > 0):
+            raise ValueError(
+                f"burn thresholds must be > 0: fast_burn="
+                f"{self.fast_burn}, slow_burn={self.slow_burn}")
+
+    @property
+    def budget(self) -> float:
+        return 1.0 - self.objective
+
+    @classmethod
+    def parse(cls, obj: Any) -> "SLOSpec":
+        """None → the default spec; a dict → field overrides; an
+        SLOSpec passes through (the ``ServeConfig.slo`` coercion)."""
+        if obj is None:
+            return cls()
+        if isinstance(obj, cls):
+            return obj
+        if isinstance(obj, dict):
+            return cls(**obj)
+        raise TypeError(
+            f"slo must be an SLOSpec, a dict of its fields, or None: "
+            f"{type(obj).__name__}")
+
+    def describe(self) -> dict:
+        return {
+            "name": self.name,
+            "objective": self.objective,
+            "budget": round(self.budget, 9),
+            "latency_ms": self.latency_ms,
+            "latency_quantile": self.latency_quantile,
+            "window_s": self.window_s,
+            "long_window_s": self.long_window_s,
+            "fast_burn": self.fast_burn,
+            "slow_burn": self.slow_burn,
+            "min_requests": self.min_requests,
+        }
+
+
+# error-side terminal states, as recorded by ServerStats — the registry
+# counter names the tracker reads (never writes)
+ERROR_COUNTERS = ("rejected_overload", "expired_deadline", "timed_out",
+                  "failed")
+
+
+class SLOTracker:
+    """Windowed burn-rate evaluation over one model's stats registry.
+
+    Sampling is on-demand: every registry read is an atomic
+    counter/histogram read of the shared primitives, and the whole
+    sample (ring append + window scans) runs under one lock because the
+    HTTP front end is a ThreadingHTTPServer — concurrent ``/healthz``
+    and ``/slo`` probes hit the same tracker. There is no background
+    thread — an unpolled tracker costs nothing.
+    """
+
+    __slots__ = ("spec", "stats", "queued_fn", "_clock", "_samples",
+                 "_lock")
+
+    def __init__(self, spec: SLOSpec, stats: Any,
+                 queued_fn: Callable[[], int] | None = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.spec = spec
+        self.stats = stats              # serve.stats.ServerStats
+        self.queued_fn = queued_fn      # live queue depth (admission)
+        self._clock = clock
+        # (t, reads) snapshots. Bounded by TIME, not a fixed maxlen (a
+        # fixed cap silently shrank the long window under frequent
+        # polling): samples older than 2x the long window are pruned on
+        # append, and appends closer together than long_window_s/4096
+        # coalesce into the newest slot, so the ring holds at most
+        # ~8192 samples at any poll rate
+        self._samples: deque = deque()
+        self._lock = threading.Lock()
+
+    # -- the one read seam --
+
+    def _read(self) -> dict:
+        """Every registry value one sample consumes, read once. This is
+        the auditable surface of the 'registry reads only' contract —
+        the burn/health math below touches nothing else."""
+        s = self.stats
+        errors = {name: getattr(s, name) for name in ERROR_COUNTERS}
+        return {
+            "admitted": s.admitted,
+            "completed": s.completed,
+            "errors": errors,
+            "error_total": sum(errors.values()),
+        }
+
+    # -- sampling --
+
+    def _window_delta(self, now: float, cur: dict,
+                      window_s: float) -> dict | None:
+        """Deltas of the terminal counters against the newest sample at
+        least ``window_s`` old (or the oldest held, once the ring spans
+        less than the window); None with fewer than two samples."""
+        base = None
+        for t, reads in self._samples:
+            if now - t >= window_s:
+                base = reads  # keep scanning: the NEWEST old-enough one
+            else:
+                break
+        if base is None:
+            if not self._samples or self._samples[0][1] is cur:
+                return None
+            base = self._samples[0][1]
+        completed = cur["completed"] - base["completed"]
+        err = cur["error_total"] - base["error_total"]
+        rejected = (cur["errors"]["rejected_overload"]
+                    - base["errors"]["rejected_overload"])
+        admitted = cur["admitted"] - base["admitted"]
+        return {"completed": completed, "errors": err,
+                "rejected": rejected, "admitted": admitted,
+                "terminal": completed + err}
+
+    def _burn(self, delta: dict | None) -> tuple[float | None, dict]:
+        """(burn multiple, window detail) — None burn when the window
+        carries too little traffic for a verdict."""
+        detail = {"terminal": 0, "errors": 0, "rejected": 0,
+                  "admitted": 0, "error_rate": None}
+        if delta is None:
+            return None, detail
+        detail.update({k: delta[k] for k in
+                       ("terminal", "errors", "rejected", "admitted")})
+        if delta["terminal"] < self.spec.min_requests:
+            return None, detail
+        rate = delta["errors"] / delta["terminal"]
+        detail["error_rate"] = round(rate, 6)
+        return rate / self.spec.budget, detail
+
+    def _latency(self) -> tuple[float | None, bool | None]:
+        pct = self.stats.e2e_percentiles()
+        if pct is None:
+            return None, None
+        observed = float(pct[self.spec.latency_quantile])
+        if self.spec.latency_ms is None:
+            return observed, None
+        return observed, observed <= self.spec.latency_ms
+
+    def _replica_skew(self) -> float | None:
+        """Load imbalance of the DP fan-out from the per-replica batch
+        counters: (max − min) / max over replicas, 0 for perfectly even,
+        None when the model doesn't serve replicated."""
+        counts = self.stats.replica_batch_counts()
+        if len(counts) < 2:
+            return None
+        hi, lo = max(counts.values()), min(counts.values())
+        return 0.0 if hi == 0 else round((hi - lo) / hi, 6)
+
+    def sample(self, now: float | None = None) -> dict:
+        """Take one sample: read the registry, update the ring, compute
+        burn rates + derived signals, publish the derived gauges into
+        the model's registry, and return the JSON-safe status dict."""
+        with self._lock:
+            return self._sample_locked(now)
+
+    def _sample_locked(self, now: float | None) -> dict:
+        spec = self.spec
+        now = self._clock() if now is None else float(now)
+        cur = self._read()
+        # append BEFORE evaluating so a first sample evaluates against
+        # itself (no-traffic verdict) instead of crashing; samples
+        # arriving within one ring-resolution step of the newest
+        # coalesce into it — replacing the READS but keeping the slot's
+        # original timestamp (counters are cumulative, so the newer
+        # snapshot loses nothing a window spanning >= one step can see;
+        # rewriting the timestamp would make the tail a sliding target
+        # under sustained sub-resolution polling — it never ages past
+        # the step, no base sample ever accumulates, and the burn math
+        # returns no verdict forever)
+        if self._samples and (now - self._samples[-1][0]
+                              < spec.long_window_s / 4096.0):
+            self._samples[-1] = (self._samples[-1][0], cur)
+        else:
+            self._samples.append((now, cur))
+        while self._samples and (now - self._samples[0][0]
+                                 > spec.long_window_s * 2):
+            self._samples.popleft()
+        burn_short, short = self._burn(
+            self._window_delta(now, cur, spec.window_s))
+        burn_long, long_ = self._burn(
+            self._window_delta(now, cur, spec.long_window_s))
+        latency_ms, latency_ok = self._latency()
+        terminal = cur["completed"] + cur["error_total"]
+        if terminal:
+            consumed = (cur["error_total"] / terminal) / spec.budget
+            budget_remaining = round(max(0.0, 1.0 - consumed), 6)
+        else:
+            budget_remaining = 1.0
+        queue_depth = None if self.queued_fn is None \
+            else int(self.queued_fn())
+        occupancy = self.stats.occupancy_mean()
+        skew = self._replica_skew()
+        self._publish_gauges(burn_short, burn_long, queue_depth,
+                             occupancy, skew, budget_remaining)
+        return {
+            "slo": spec.describe(),
+            "burn_rate_short": None if burn_short is None
+            else round(burn_short, 4),
+            "burn_rate_long": None if burn_long is None
+            else round(burn_long, 4),
+            "window_short": short,
+            "window_long": long_,
+            "latency_ms": latency_ms,
+            "latency_ok": latency_ok,
+            "budget_remaining": budget_remaining,
+            "queue_depth": queue_depth,
+            "occupancy_mean": occupancy,
+            "replica_skew": skew,
+            "counters": {"admitted": cur["admitted"],
+                         "completed": cur["completed"],
+                         **cur["errors"]},
+            "min_requests": spec.min_requests,
+        }
+
+    def _publish_gauges(self, burn_short, burn_long, queue_depth,
+                        occupancy, skew, budget_remaining) -> None:
+        """Derived values become first-class gauges in the model's own
+        registry — the queue-depth/skew/burn series autoscalers and the
+        adaptive ladder consume from /metrics without re-deriving."""
+        reg = self.stats.registry
+        lbl = self.stats.labels
+        # a no-verdict window resets the burn gauges to 0 — freezing
+        # them at the last incident-era value would keep alerts (and
+        # the autoscaler) firing long after traffic stopped, while /slo
+        # simultaneously reports no verdict
+        reg.gauge("serve.slo_burn_short",
+                  **lbl).set(burn_short if burn_short is not None else 0.0)
+        reg.gauge("serve.slo_burn_long",
+                  **lbl).set(burn_long if burn_long is not None else 0.0)
+        reg.gauge("serve.slo_budget_remaining",
+                  **lbl).set(budget_remaining)
+        if queue_depth is not None:
+            reg.gauge("serve.queue_depth", **lbl).set(queue_depth)
+        if occupancy is not None:
+            reg.gauge("serve.occupancy_mean_window",
+                      **lbl).set(occupancy)
+        if skew is not None:
+            reg.gauge("serve.replica_skew", **lbl).set(skew)
+
+
+class SlowStepDetector:
+    """Rolling-median outlier detection for the train step loop.
+
+    ``observe(dur_ms)`` records every step's dispatch time into a
+    windowed ``train.step_ms`` histogram (the process-wide registry) and
+    flags a step slower than ``factor ×`` the median of the PRIOR
+    window — after ``min_samples`` steps have established a baseline —
+    as one ``train/slow_step`` event plus a ``train.slow_steps``
+    counter increment. The baseline is the window median, recomputed
+    every ``window // 4`` observations (a per-step copy + sort of the
+    full window would cost host time comparable to the sub-ms dispatch
+    it measures), so a genuine regime change (bigger batches after a
+    rescale) re-baselines itself within one window instead of flagging
+    forever. Call sites gate on ``obs.runtime._enabled``; the detector
+    assumes it only runs enabled.
+    """
+
+    __slots__ = ("factor", "min_samples", "_hist", "_counter", "_labels",
+                 "_window", "_count", "_every", "_baseline",
+                 "_baseline_at")
+
+    def __init__(self, loop: str = "train", factor: float = 4.0,
+                 min_samples: int = 16, window: int = 512):
+        self.factor = float(factor)
+        self.min_samples = int(min_samples)
+        reg = _registry()
+        self._labels = {"loop": loop}
+        self._hist = reg.histogram("train.step_ms", window=window,
+                                   **self._labels)
+        self._counter = reg.counter("train.slow_steps", **self._labels)
+        # the baseline window is PER DETECTOR, not the interned registry
+        # histogram: a second fit in the same process gets the same
+        # train.step_ms{loop=...} series (interned by (name, labels)),
+        # and baselining a fresh fit against the previous fit's step
+        # times would flag every step of a legitimately slower run
+        self._window: deque = deque(maxlen=int(window))
+        self._count = 0
+        self._every = max(1, int(window) // 4)
+        self._baseline: float | None = None
+        self._baseline_at = 0
+
+    def observe(self, dur_ms: float) -> bool:
+        """Record one step; True when it was flagged slow."""
+        prior_count = self._count
+        if prior_count >= self.min_samples and (
+                self._baseline is None
+                or prior_count - self._baseline_at >= self._every):
+            # median of the window BEFORE this observation lands
+            self._baseline = float(np.median(self._window))
+            self._baseline_at = prior_count
+        self._hist.observe(dur_ms)
+        self._window.append(dur_ms)
+        self._count = prior_count + 1
+        if prior_count < self.min_samples:
+            return False
+        baseline = self._baseline
+        if baseline is None or baseline <= 0 \
+                or dur_ms <= self.factor * baseline:
+            return False
+        self._counter.add()
+        if _rt._enabled:
+            _event("train/slow_step", "train",
+                   {**self._labels, "step_ms": round(dur_ms, 3),
+                    "median_ms": round(baseline, 3),
+                    "factor": round(dur_ms / baseline, 2)})
+        return True

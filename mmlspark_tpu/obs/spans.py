@@ -30,6 +30,7 @@ import threading
 import time
 from typing import Any
 
+from mmlspark_tpu.obs import context as _ctx
 from mmlspark_tpu.obs import runtime as _rt
 from mmlspark_tpu.obs.events import EventRecord, SpanRecord
 
@@ -63,13 +64,15 @@ def _annotation(name: str):
 
 
 class _Span:
-    __slots__ = ("name", "cat", "labels", "_t0", "_span_id", "_parent",
-                 "_depth", "_annot")
+    __slots__ = ("name", "cat", "labels", "links", "_t0", "_span_id",
+                 "_parent", "_depth", "_trace", "_annot")
 
-    def __init__(self, name: str, cat: str, labels: dict | None):
+    def __init__(self, name: str, cat: str, labels: dict | None,
+                 links: tuple | None = None):
         self.name = name
         self.cat = cat
         self.labels = labels
+        self.links = links
 
     def __enter__(self) -> "_Span":
         stack = getattr(_tls, "stack", None)
@@ -79,6 +82,9 @@ class _Span:
         self._parent = stack[-1] if stack else None
         self._depth = len(stack)
         stack.append(self._span_id)
+        # the thread's active request context (obs/context.bind): spans
+        # recorded while a trace is bound belong to that request
+        self._trace = _ctx.current()
         self._annot = None
         if _rt._device_annotations:
             annot = _annotation(self.name)
@@ -98,18 +104,21 @@ class _Span:
         th = threading.current_thread()
         _rt.record(SpanRecord(self.name, self.cat, self._t0, dur,
                               th.ident or 0, th.name, self._span_id,
-                              self._parent, self._depth, self.labels))
+                              self._parent, self._depth, self.labels,
+                              self._trace, self.links))
         return False
 
 
-def span(name: str, cat: str = "host",
-         labels: dict | None = None) -> Any:
+def span(name: str, cat: str = "host", labels: dict | None = None,
+         links: tuple | None = None) -> Any:
     """Context manager tracing one interval; a shared no-op when the
-    tracer is disabled (``labels`` is a plain dict parameter, not
-    ``**kwargs``, so the disabled call allocates nothing)."""
+    tracer is disabled (``labels``/``links`` are plain parameters, not
+    ``**kwargs``, so the disabled call allocates nothing). ``links`` is
+    the fan-in edge set: the trace ids of every request this span works
+    for at once (obs/context.py)."""
     if not _rt._enabled:
         return _NULL
-    return _Span(name, cat, labels)
+    return _Span(name, cat, labels, links)
 
 
 def event(name: str, cat: str = "host",

@@ -35,6 +35,12 @@ inverted to the serving direction:
 * **shutdown** (``close(drain=True)``) stops admission, answers every
   already-admitted request, then joins the scheduler and every lane
   worker — no leaked thread.
+
+With the obs tracer enabled every request also carries a **trace id**
+minted at admission (``obs/context.py``): the admit/complete spans
+record under it, and the pack/dispatch/drain bucket-batch spans link
+every coalesced member, so one request's journey across the scheduler
+and lane threads reads as a single flow in the exported timeline.
 """
 
 from __future__ import annotations
@@ -49,6 +55,7 @@ import numpy as np
 
 from mmlspark_tpu.core.logging_utils import get_logger
 from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.obs import context as _obs_ctx
 from mmlspark_tpu.obs import runtime as _obs_rt
 from mmlspark_tpu.obs.spans import event as _obs_event
 from mmlspark_tpu.obs.spans import span as _obs_span
@@ -108,6 +115,15 @@ def _compat_key(table: DataTable) -> tuple:
     return tuple(parts)
 
 
+def _batch_links(batch: list) -> tuple | None:
+    """The fan-in edge set of one packed batch: every member request's
+    trace id (obs/context.py). The pack/dispatch/drain spans carry it so
+    a request's flow steps through the shared bucket-batch work. Only
+    called on the enabled path."""
+    links = tuple(r._trace for r in batch if r._trace is not None)
+    return links or None
+
+
 class ServeRequest:
     """Handle for one admitted request; wait with :meth:`result`.
 
@@ -120,7 +136,7 @@ class ServeRequest:
     __slots__ = ("model", "table", "n_rows", "deadline_ms", "_deadline",
                  "_submitted", "_dispatched_at", "_resolved_at", "_state",
                  "_lock", "_event", "_result", "_error", "_stats",
-                 "_compat")
+                 "_compat", "_trace")
 
     def __init__(self, model: str, table: DataTable,
                  deadline_ms: float | None, stats: ServerStats):
@@ -128,6 +144,11 @@ class ServeRequest:
         self.table = table
         self.n_rows = len(table)
         self._compat = _compat_key(table)
+        # request-scoped trace id (obs/context.py): minted here at
+        # admission, carried for the request's whole life so the
+        # pack/dispatch/drain batch spans can link back to it. None
+        # (one flag check) when the tracer is off
+        self._trace = _obs_ctx.mint()
         self.deadline_ms = deadline_ms
         now = time.monotonic()
         self._submitted = now
@@ -173,6 +194,12 @@ class ServeRequest:
         return True
 
     # -- caller side --
+
+    @property
+    def trace_id(self) -> int | None:
+        """The request's obs trace id (None when tracing is disabled):
+        the key into :func:`mmlspark_tpu.obs.context.request_traces`."""
+        return self._trace
 
     @property
     def done(self) -> bool:
@@ -336,6 +363,8 @@ class _Lane:
         try:
             with _obs_span("serve/dispatch", "serve",
                            {**labels, "bucket": bucket}
+                           if labels is not None else None,
+                           _batch_links(batch)
                            if labels is not None else None):
                 pending = plan.transform_async(
                     self.batcher.stages, packed, self.cache_host,
@@ -352,10 +381,12 @@ class _Lane:
 
     def _drain_one(self) -> None:
         pending, batch, rows, bucket, t0 = self._window.popleft()
+        labels = self._labels()
         try:
-            labels = self._labels()
             with _obs_span("serve/drain", "serve",
                            {**labels, "bucket": bucket}
+                           if labels is not None else None,
+                           _batch_links(batch)
                            if labels is not None else None):
                 out = pending.result()
         except BaseException as e:  # noqa: BLE001 — relayed per request
@@ -390,9 +421,19 @@ class _Lane:
             return
         offset = 0
         for r in batch:
-            piece = out.take(np.arange(offset, offset + r.n_rows))
+            idx = np.arange(offset, offset + r.n_rows)
             offset += r.n_rows
-            if r._resolve(piece):
+            if labels is None:  # tracer off: resolve with zero obs work
+                delivered = r._resolve(out.take(idx))
+            else:
+                # fan-out: each request's slice resolves under its OWN
+                # trace context, so the per-request serve/complete span
+                # closes the admission → pack → dispatch → drain flow
+                with _obs_ctx.bind(r._trace), \
+                        _obs_span("serve/complete", "serve",
+                                  {**labels, "rows": r.n_rows}):
+                    delivered = r._resolve(out.take(idx))
+            if delivered:
                 self.batcher.stats.record_done(
                     (done - r._submitted) * 1e3,
                     ((r._dispatched_at or done) - r._submitted) * 1e3)
@@ -444,28 +485,44 @@ class DynamicBatcher:
         if n > self.config.max_bucket:
             self.config.bucket_for(n, self.name)  # raises BadRequest
         req = ServeRequest(self.name, table, deadline_ms, self.stats)
-        labels = ({"model": self.name, "rows": n}
-                  if _obs_rt._enabled else None)
-        with _obs_span("serve/admit", "serve", labels):
-            with self._cv:
-                if self._closed:
-                    raise ServerClosed(
-                        f"model {self.name!r} is shutting down")
-                if len(self._queue) >= self.config.max_queue:
-                    self.stats.record_rejected()
-                    _obs_event("serve/overloaded", "serve",
-                               {"model": self.name})
-                    raise Overloaded(self.name, len(self._queue),
-                                     self.config.max_queue)
-                self._queue.append(req)
-                self.stats.record_admitted()
-                self._cv.notify()
+        if _obs_rt._enabled:
+            # the request's trace begins here: the admit span records
+            # under the freshly minted trace id (obs/context.py), and
+            # every later span of this request's journey links back
+            with _obs_ctx.bind(req._trace), \
+                    _obs_span("serve/admit", "serve",
+                              {"model": self.name, "rows": n}):
+                self._admit(req)
+        else:
+            self._admit(req)
         return req
+
+    def _admit(self, req: ServeRequest) -> None:
+        with self._cv:
+            if self._closed:
+                raise ServerClosed(
+                    f"model {self.name!r} is shutting down")
+            if len(self._queue) >= self.config.max_queue:
+                self.stats.record_rejected()
+                _obs_event("serve/overloaded", "serve",
+                           {"model": self.name})
+                raise Overloaded(self.name, len(self._queue),
+                                 self.config.max_queue)
+            self._queue.append(req)
+            self.stats.record_admitted()
+            self._cv.notify()
 
     @property
     def queued(self) -> int:
         with self._cv:
             return len(self._queue)
+
+    @property
+    def closed(self) -> bool:
+        """True once admission stopped (drain in progress or done) —
+        the health surfaces' per-model drain-awareness read."""
+        with self._cv:
+            return self._closed
 
     # -- the dispatch loop --
 
@@ -559,7 +616,8 @@ class DynamicBatcher:
         on = _obs_rt._enabled
         with _obs_span("serve/pack", "serve",
                        {"model": self.name, "requests": len(batch),
-                        "rows": rows} if on else None):
+                        "rows": rows} if on else None,
+                       _batch_links(batch) if on else None):
             packed, bucket = self._pack(batch, rows)
         if self._lockstep is not None:
             # collective lockstep: quiesce every lane (the fence), claim

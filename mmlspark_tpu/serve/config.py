@@ -40,6 +40,11 @@ class ServeConfig:
     #                             string / dict (serve.mesh); None keeps
     #                             the single whole-mesh dispatch lane.
     #                             add_model(mesh=...) overrides per model
+    slo: object = None          # per-model SLO — an obs.slo.SLOSpec /
+    #                             dict of its fields / None (the default
+    #                             spec). Drives the /slo burn-rate
+    #                             surface and the /healthz state machine
+    #                             (docs/observability.md)
 
     def __post_init__(self):
         buckets = tuple(sorted({int(b) for b in self.buckets}))

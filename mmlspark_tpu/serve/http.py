@@ -12,13 +12,29 @@ Protocol (details + examples in docs/serving.md):
     bridge; the response is an Arrow stream when the ``Accept`` header
     asks for one, JSON otherwise. Deadline via ``X-Deadline-Ms``.
 
-* ``GET /healthz`` — liveness; ``GET /v1/models`` — the model list;
+* ``GET /healthz`` — drain-aware **readiness**: the
+  ok/degraded/unhealthy state machine over the SLO burn rates
+  (``obs/health.py``), 200 while ready, 503 when draining or unhealthy
+  (the body always carries the full verdict);
+  ``GET /livez`` — **liveness**: always 200 while the process answers
+  HTTP. Point restart-the-container probes here, never at ``/healthz``
+  — an alive-but-burning (or gracefully draining) server must fail
+  readiness without being killed;
+  ``GET /slo`` — every model's SLO status:
+  burn rates, error-budget remaining, latency verdict, derived
+  queue-depth/occupancy/replica-skew signals (``obs/slo.py``);
+  ``GET /v1/models`` — the model list;
   ``GET /v1/stats`` — every model's :class:`ServerStats` snapshot;
-  ``GET /metrics`` — the obs metrics view (process-wide registry merged
-  with every model's stats snapshot — docs/observability.md);
+  ``GET /metrics`` — the obs metrics view. Content-negotiated: the
+  default is the JSON snapshot (process-wide registry merged with every
+  model's stats snapshot — docs/observability.md); an ``Accept`` header
+  asking for ``text/plain`` (or OpenMetrics) gets the Prometheus text
+  exposition of the same registries, so standard scrapers work
+  unchanged;
   ``GET /trace`` — the captured span buffer as Chrome-trace
-  ``trace_event`` JSON (empty unless ``obs.enable()`` was called, e.g.
-  ``tools/serve.py --obs`` or ``MMLSPARK_TPU_OBS=1``).
+  ``trace_event`` JSON, request flows included (empty unless
+  ``obs.enable()`` was called, e.g. ``tools/serve.py --obs`` or
+  ``MMLSPARK_TPU_OBS=1``).
 
 Typed serving errors map to status codes: ``Overloaded`` → 429,
 ``DeadlineExceeded`` → 504, ``ModelNotFound`` → 404, ``BadRequest`` (and
@@ -146,18 +162,26 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:  # noqa: N802 - http.server contract
         try:
             if self.path == "/healthz":
-                self._send_json(200, {"status": "ok",
-                                      "models": self._ms.models()})
+                # drain-aware readiness: 503 tells the load balancer to
+                # stop routing here (draining or unhealthy), while the
+                # body keeps answering with the full verdict
+                payload = self._ms.health()
+                self._send_json(200 if payload["ready"] else 503,
+                                payload)
+            elif self.path == "/livez":
+                # liveness is only "the process answers HTTP": always
+                # 200 — a 503 here would make the orchestrator restart
+                # an alive server mid-drain or mid-incident, discarding
+                # warm compile caches and in-flight requests
+                self._send_json(200, {"alive": True})
+            elif self.path == "/slo":
+                self._send_json(200, self._ms.slo_snapshot())
             elif self.path == "/v1/models":
                 self._send_json(200, {"models": self._ms.models()})
             elif self.path == "/v1/stats":
                 self._send_json(200, self._ms.snapshot())
             elif self.path == "/metrics":
-                from mmlspark_tpu.obs import export as obs_export
-                self._send_json(200, {
-                    **obs_export.metrics_snapshot(),
-                    "models": self._ms.snapshot(),
-                })
+                self._send_metrics()
             elif self.path == "/trace":
                 from mmlspark_tpu.obs import export as obs_export
                 self._send_json(200, obs_export.chrome_trace())
@@ -166,6 +190,25 @@ class _Handler(BaseHTTPRequestHandler):
                                       "message": self.path})
         except BaseException as e:  # noqa: BLE001 — typed mapping
             self._send_error_typed(e)
+
+    def _send_metrics(self) -> None:
+        """The /metrics body under content negotiation: JSON snapshot by
+        default (unchanged), Prometheus text exposition when the Accept
+        header asks for text/plain or OpenMetrics — the standard scraper
+        handshake (Prometheus sends ``text/plain;version=0.0.4``)."""
+        from mmlspark_tpu.obs import export as obs_export
+        from mmlspark_tpu.obs.metrics import registry
+        accept = (self.headers.get("Accept") or "").lower()
+        if "text/plain" in accept or "openmetrics" in accept:
+            body = obs_export.prometheus_text(
+                [registry()] + self._ms.metric_registries())
+            self._send(200, body.encode("utf-8"),
+                       "text/plain; version=0.0.4; charset=utf-8")
+            return
+        self._send_json(200, {
+            **obs_export.metrics_snapshot(),
+            "models": self._ms.snapshot(),
+        })
 
     def do_POST(self) -> None:  # noqa: N802 - http.server contract
         try:

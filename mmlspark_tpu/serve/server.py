@@ -112,12 +112,15 @@ def _example_rows(schema: Any, n: int) -> DataTable | None:
 
 class _ModelEntry:
     def __init__(self, name: str, model: Any, batcher: DynamicBatcher,
-                 schema: Any | None, mesh_spec: Any | None = None):
+                 schema: Any | None, mesh_spec: Any | None = None,
+                 slo: Any = None, health: Any = None):
         self.name = name
         self.model = model
         self.batcher = batcher
         self.schema = schema
         self.mesh_spec = mesh_spec
+        self.slo = slo          # obs.slo.SLOTracker
+        self.health = health    # obs.health.HealthMonitor
 
 
 class ModelServer:
@@ -202,10 +205,27 @@ class ModelServer:
             if mesh_spec.lockstep:
                 lockstep = LockstepCoordinator(name)
 
+        # SLO tracker + health monitor: burn rates over the stats
+        # registry (reads only — obs/slo.py), the hysteretic
+        # ok/degraded/unhealthy machine over them (obs/health.py).
+        # Sampling is on-demand (each /slo, /healthz, or slo_snapshot
+        # poll), so an unpolled server pays nothing. The spec parses
+        # BEFORE the batcher exists: a malformed ServeConfig.slo must
+        # fail the load without leaking dispatch threads
+        from mmlspark_tpu.obs.health import HealthMonitor
+        from mmlspark_tpu.obs.slo import SLOSpec, SLOTracker
+        try:
+            spec = SLOSpec.parse(self.config.slo)
+        except (TypeError, ValueError) as e:
+            raise ModelLoadError(name, message=(
+                f"model {name!r}: invalid SLO spec: {e}")) from e
         stats = ServerStats(self.config.stats_window, model=name)
         batcher = DynamicBatcher(name, stages, cache_host, self.config,
                                  stats, replicas=replicas,
                                  lockstep=lockstep)
+        tracker = SLOTracker(spec, stats,
+                             queued_fn=lambda: batcher.queued)
+        monitor = HealthMonitor.for_spec(spec)
         try:
             if self.config.warmup:
                 warm = example
@@ -226,7 +246,8 @@ class ModelServer:
                 raise ServerClosed("server is closed")
             old = self._models.get(name)
             self._models[name] = _ModelEntry(name, model, batcher, schema,
-                                             mesh_spec)
+                                             mesh_spec, slo=tracker,
+                                             health=monitor)
         if old is not None:
             old.batcher.close(drain=True)
         _log.info("serve[%s]: loaded (%d stage(s), buckets=%s, mesh=%s)",
@@ -325,6 +346,65 @@ class ModelServer:
                 snap["mesh"] = e.mesh_spec.describe()
             out[e.name] = snap
         return out
+
+    def metric_registries(self) -> list:
+        """Every per-model stats registry (plus nothing else) — what the
+        HTTP front end hands to the Prometheus exposition alongside the
+        process-wide obs registry."""
+        with self._lock:
+            return [e.batcher.stats.registry
+                    for e in self._models.values()]
+
+    # -- SLO + health surfaces (obs/slo.py + obs/health.py) --
+
+    def _sample_model_health(self, e) -> tuple[dict, dict]:
+        """One SLO sample + health-machine advance for one model:
+        (status dict, health dict). The single place the per-model
+        health shape is built — ``/slo`` and ``/healthz`` must never
+        diverge on it."""
+        status = e.slo.sample()
+        verdict = e.health.update_describe(status)
+        return status, {**verdict, "draining": e.batcher.closed}
+
+    def slo_snapshot(self) -> dict:
+        """Sample every model's SLO tracker and advance its health
+        machine; the JSON-safe ``/slo`` body. Each call is one burn-rate
+        sample per model (registry reads only — no device work, no
+        batcher locks beyond the queue-depth read), so polling this IS
+        the sampling cadence."""
+        with self._lock:
+            entries = list(self._models.values())
+        out = {}
+        for e in entries:
+            status, health = self._sample_model_health(e)
+            out[e.name] = {**status, "health": health}
+        return out
+
+    def health(self) -> dict:
+        """Drain-aware readiness: the ``/healthz`` body.
+
+        ``status`` is the worst model health state (``ok`` with no
+        models — an empty server is a healthy server), ``draining``
+        reflects server-wide close, and ``ready`` is the load-balancer
+        verdict: accepting traffic AND not unhealthy. The HTTP layer
+        maps ``ready`` to 200/503."""
+        from mmlspark_tpu.obs.health import UNHEALTHY, worst
+        with self._lock:
+            closed = self._closed
+            entries = list(self._models.values())
+        model_health = {}
+        for e in entries:
+            _status, model_health[e.name] = self._sample_model_health(e)
+        overall = worst([h["state"] for h in model_health.values()])
+        draining = closed or any(h["draining"]
+                                 for h in model_health.values())
+        return {
+            "status": "draining" if closed else overall,
+            "ready": not draining and overall != UNHEALTHY,
+            "draining": draining,
+            "models": sorted(model_health),
+            "model_health": model_health,
+        }
 
     # -- lifecycle --
 

@@ -102,6 +102,31 @@ class ServerStats:
     def rows_padded(self) -> int:
         return int(self._rows_padded.value)
 
+    # registry-read accessors for the SLO engine (obs/slo.py): burn
+    # rates and derived gauges are computed ONLY from these reads —
+    # never from new side-channel counters
+
+    @property
+    def labels(self) -> dict:
+        """The model label set every series of this registry carries."""
+        return dict(self._lbl)
+
+    def e2e_percentiles(self) -> dict | None:
+        """Current e2e latency percentiles (None pre-traffic) — the
+        latency-objective read of the SLO tracker."""
+        return self._e2e_ms.percentiles(ndigits=None)
+
+    def occupancy_mean(self) -> float | None:
+        """Mean batch occupancy over the window — the adaptive-ladder
+        signal."""
+        return self._occupancy.mean()
+
+    def replica_batch_counts(self) -> dict[int, int]:
+        """Per-replica dispatched-batch counts (empty unless sharded) —
+        the DP load-balance/skew read."""
+        return {int(dict(c.labels)["replica"]): int(c.value)
+                for c in self.registry.series("serve.replica_batches")}
+
     # -- request side --
 
     def record_admitted(self) -> None:

@@ -32,6 +32,27 @@ from mmlspark_tpu.parallel import mesh as mesh_lib
 _log = get_logger(__name__)
 
 
+def _slow_step_detector(loop: str):
+    """Lazy accessor for the per-fit slow-step detector
+    (:class:`mmlspark_tpu.obs.slo.SlowStepDetector`): flags steps whose
+    dispatch time exceeds 4× the rolling window median as
+    ``train/slow_step`` events + a ``train.slow_steps`` counter — the
+    per-step health signal of a training run (a preempted host, a
+    straggling collective, a donation stall all surface here). Created
+    on first use so a fit with the tracer off never touches the
+    registry; call sites gate on ``obs.runtime._enabled``."""
+    box: dict = {}
+
+    def get():
+        det = box.get("det")
+        if det is None:
+            from mmlspark_tpu.obs.slo import SlowStepDetector
+            det = box["det"] = SlowStepDetector(loop=loop)
+        return det
+
+    return get
+
+
 @dataclasses.dataclass
 class TrainConfig:
     batch_size: int = 128
@@ -683,6 +704,7 @@ class Trainer:
         pending = None
         loader = DeviceLoader(host_batches(), commit_batch,
                               depth=cfg.prefetch_depth, name="fit_arrays")
+        slow_steps = _slow_step_detector("fit_arrays")
         t_loop = time.perf_counter()
         try:
             with timed(f"Trainer[{type(self.module).__name__}]", _log,
@@ -691,11 +713,16 @@ class Trainer:
                     # the span times step DISPATCH (async issue), not
                     # device compute — the honest host-side number; the
                     # wait surfaces in the loader's wait span instead
+                    t_step = time.perf_counter() if _obs_rt._enabled \
+                        else None
                     with _obs_span("train/step", "train"):
                         self.state, metrics = self.step_masked(
                             self.state, dx, dy, dw)
                     if _obs_rt._enabled:
                         _obs_registry().counter("train.steps").add()
+                        if t_step is not None:
+                            slow_steps().observe(
+                                (time.perf_counter() - t_step) * 1e3)
                     if i % cfg.log_every == 0:
                         if pending is not None:
                             self.history.append(float(pending))  # lint-jax: allow(JX105) — one-step-lagged fetch
@@ -881,16 +908,22 @@ class Trainer:
         loader = DeviceLoader(host_batches(), commit_batch,
                               depth=cfg.prefetch_depth, name="fit_stream")
         box["loader"] = loader
+        slow_steps = _slow_step_detector("fit_stream")
         t_loop = time.perf_counter()
         try:
             with timed(f"Trainer[{type(self.module).__name__}:stream]",
                        _log):
                 for gs, (dx, dy, dw) in loader:
+                    t_step = time.perf_counter() if _obs_rt._enabled \
+                        else None
                     with _obs_span("train/step", "train"):
                         self.state, metrics = self.step_masked(
                             self.state, dx, dy, dw)
                     if _obs_rt._enabled:
                         _obs_registry().counter("train.steps").add()
+                        if t_step is not None:
+                            slow_steps().observe(
+                                (time.perf_counter() - t_step) * 1e3)
                     if (gs - 1) % cfg.log_every == 0:
                         if pending is not None:
                             self.history.append(float(pending))  # lint-jax: allow(JX105) — one-step-lagged fetch

@@ -423,3 +423,508 @@ def test_decode_chunk_span_and_counters(tmp_path):
     names = {r.name for r in obs.captured() if isinstance(r, SpanRecord)}
     assert "data/decode_chunk" in names
     assert obs.registry().value("data.images_decoded") == 3
+
+
+# ---- request-scoped tracing (obs/context.py) ----
+
+
+def test_mint_is_none_when_disabled_and_unique_when_enabled():
+    assert obs.mint() is None  # the disabled path: one flag check
+    obs.enable()
+    ids = [obs.mint() for _ in range(100)]
+    assert len(set(ids)) == 100 and all(isinstance(t, int) for t in ids)
+
+
+def test_spans_inherit_bound_trace_across_threads():
+    from mmlspark_tpu.obs import context
+    obs.enable()
+    t1 = obs.mint()
+
+    def worker():
+        # a DIFFERENT thread binding the same trace: its spans belong
+        # to the same request — the batcher's thread-hop case
+        with context.bind(t1):
+            with obs.span("lane/work", "serve"):
+                pass
+
+    with context.bind(t1):
+        with obs.span("caller/work", "serve"):
+            pass
+    assert context.current() is None  # binding restored on exit
+    th = threading.Thread(target=worker)
+    th.start()
+    th.join()
+    recs = [r for r in obs.captured() if isinstance(r, SpanRecord)]
+    assert {r.name for r in recs} == {"caller/work", "lane/work"}
+    assert all(r.trace == t1 for r in recs)
+    assert len({r.tid for r in recs}) == 2  # genuinely two threads
+
+
+def test_bind_nests_and_restores_previous_trace():
+    from mmlspark_tpu.obs import context
+    obs.enable()
+    t1, t2 = obs.mint(), obs.mint()
+    with context.bind(t1):
+        assert context.current() == t1
+        with context.bind(t2):
+            assert context.current() == t2
+        assert context.current() == t1
+        with context.bind(None):  # explicit clear (worker reuse)
+            assert context.current() is None
+        assert context.current() == t1
+    assert context.current() is None
+
+
+def _journey(t, *, admit=1, complete=1):
+    """Record one synthetic request journey for trace id ``t``."""
+    from mmlspark_tpu.obs import context
+    for _ in range(admit):
+        with context.bind(t):
+            with obs.span("serve/admit", "serve"):
+                pass
+    for name in ("serve/pack", "serve/dispatch", "serve/drain"):
+        with obs.span(name, "serve", links=(t,)):
+            pass
+    for _ in range(complete):
+        with context.bind(t):
+            with obs.span("serve/complete", "serve"):
+                pass
+
+
+def test_request_traces_groups_by_trace_and_links():
+    obs.enable()
+    t1, t2 = obs.mint(), obs.mint()
+    # two requests coalesced into ONE batch: shared pack/dispatch/drain
+    from mmlspark_tpu.obs import context
+    for t in (t1, t2):
+        with context.bind(t):
+            with obs.span("serve/admit", "serve"):
+                pass
+    for name in ("serve/pack", "serve/dispatch", "serve/drain"):
+        with obs.span(name, "serve", links=(t1, t2)):
+            pass
+    for t in (t1, t2):
+        with context.bind(t):
+            with obs.span("serve/complete", "serve"):
+                pass
+    traces = obs.request_traces()
+    assert set(traces) == {t1, t2}
+    for t in (t1, t2):
+        assert obs.check_journey(traces[t]) is None
+        names = [s.name for s in traces[t]]
+        assert names[0] == "serve/admit" and names[-1] == "serve/complete"
+        # the SHARED batch spans appear in both traces
+        assert "serve/pack" in names and "serve/drain" in names
+
+
+def test_check_journey_flags_missing_and_duplicated_spans():
+    obs.enable()
+    t = obs.mint()
+    from mmlspark_tpu.obs import context
+    with context.bind(t):
+        with obs.span("serve/admit", "serve"):
+            pass
+    # half a journey: no batch spans, no completion
+    traces = obs.request_traces()
+    why = obs.check_journey(traces[t])
+    assert why is not None and "serve/pack" in why
+    # a duplicated endpoint is flagged too
+    t2 = obs.mint()
+    _journey(t2, admit=2)
+    why2 = obs.check_journey(obs.request_traces()[t2])
+    assert why2 is not None and "serve/admit" in why2
+
+
+def test_chrome_trace_emits_flow_events_binding_the_journey():
+    obs.enable()
+    t = obs.mint()
+    _journey(t)
+    payload = json.loads(json.dumps(obs.chrome_trace()))
+    flows = [e for e in payload["traceEvents"]
+             if e.get("ph") in ("s", "t", "f")]
+    mine = sorted((e for e in flows if e["id"] == t),
+                  key=lambda e: e["ts"])
+    # one flow: a start, three steps (pack/dispatch/drain), a finish
+    assert [e["ph"] for e in mine] == ["s", "t", "t", "t", "f"]
+    assert all(e.get("bp") == "e" for e in mine)
+    # the complete events carry the trace/links in args for debugging
+    admits = [e for e in payload["traceEvents"]
+              if e.get("ph") == "X" and e["name"] == "serve/admit"]
+    assert admits and admits[0]["args"]["trace"] == t
+    packs = [e for e in payload["traceEvents"]
+             if e.get("ph") == "X" and e["name"] == "serve/pack"]
+    assert packs and packs[0]["args"]["links"] == [t]
+
+
+def test_single_touch_trace_emits_no_flow():
+    obs.enable()
+    t = obs.mint()
+    from mmlspark_tpu.obs import context
+    with context.bind(t):
+        with obs.span("serve/admit", "serve"):
+            pass
+    flows = [e for e in obs.chrome_trace()["traceEvents"]
+             if e.get("ph") in ("s", "t", "f")]
+    assert flows == []  # an arrow needs two ends
+
+
+# ---- SLO engine (obs/slo.py) ----
+
+
+def _slo_stats(model="m"):
+    from mmlspark_tpu.serve.stats import ServerStats
+    return ServerStats(window=64, model=model)
+
+
+def test_slo_tracker_burn_rates_from_counter_deltas():
+    from mmlspark_tpu.obs.slo import SLOSpec, SLOTracker
+    spec = SLOSpec(objective=0.9, window_s=10.0, long_window_s=40.0,
+                   min_requests=5)
+    stats = _slo_stats()
+    tracker = SLOTracker(spec, stats, queued_fn=lambda: 3)
+    s0 = tracker.sample(now=0.0)
+    assert s0["burn_rate_short"] is None  # one sample: no delta yet
+    assert s0["queue_depth"] == 3
+    # 10s later: 20 terminal requests, 4 failed → 20% errors on a 10%
+    # budget → burn 2.0
+    for _ in range(16):
+        stats.record_admitted()
+        stats.record_done(e2e_ms=5.0, queue_ms=1.0)
+    for _ in range(4):
+        stats.record_admitted()
+        stats.record_failed()
+    s1 = tracker.sample(now=10.0)
+    assert s1["burn_rate_short"] == pytest.approx(2.0)
+    assert s1["window_short"]["terminal"] == 20
+    assert s1["window_short"]["errors"] == 4
+    # lifetime error rate (20%) is 2x the whole budget: remaining
+    # clamps at zero rather than going negative
+    assert s1["budget_remaining"] == 0.0
+    # quiet window: deltas vs the 10s-old sample go to zero traffic
+    s2 = tracker.sample(now=20.0)
+    assert s2["burn_rate_short"] is None  # < min_requests in window
+    assert s2["window_short"]["terminal"] == 0
+
+
+def test_slo_tracker_ignores_thin_windows():
+    from mmlspark_tpu.obs.slo import SLOSpec, SLOTracker
+    spec = SLOSpec(objective=0.99, window_s=10.0, long_window_s=20.0,
+                   min_requests=10)
+    stats = _slo_stats()
+    tracker = SLOTracker(spec, stats)
+    tracker.sample(now=0.0)
+    stats.record_admitted()
+    stats.record_failed()  # 100% errors, but only ONE request
+    s = tracker.sample(now=10.0)
+    assert s["burn_rate_short"] is None  # no verdict below min_requests
+    assert s["window_short"]["errors"] == 1
+
+
+def test_slo_tracker_long_window_survives_frequent_polling():
+    """A dashboard polling /slo + /healthz at high frequency must not
+    evict the long window's base sample — the ring is bounded by time
+    (with sub-resolution appends coalesced), not a fixed maxlen that
+    would silently collapse burn_rate_long onto a recent window."""
+    from mmlspark_tpu.obs.slo import SLOSpec, SLOTracker
+    spec = SLOSpec(objective=0.9, window_s=10.0, long_window_s=40.0,
+                   min_requests=5)
+    stats = _slo_stats()
+    tracker = SLOTracker(spec, stats)
+    tracker.sample(now=0.0)
+    # the incident happens early: 20 terminal requests, 4 failed
+    for _ in range(16):
+        stats.record_admitted()
+        stats.record_done(e2e_ms=5.0, queue_ms=1.0)
+    for _ in range(4):
+        stats.record_admitted()
+        stats.record_failed()
+    # then 2500 polls over 5 s — far more than any fixed sample cap
+    for i in range(2500):
+        tracker.sample(now=5.0 + i * 0.002)
+    s = tracker.sample(now=41.0)
+    # the 40 s base is still the t=0 sample: the incident stays visible
+    assert s["window_long"]["terminal"] == 20
+    assert s["window_long"]["errors"] == 4
+    assert s["burn_rate_long"] == pytest.approx(2.0)
+    # and coalescing kept the ring bounded despite the poll rate
+    assert len(tracker._samples) < 8200
+
+
+def test_slo_tracker_sub_resolution_polling_from_cold_start():
+    """An LB probing every 2 ms from process start — faster than the
+    ring resolution (long_window_s/4096 ≈ 9.8 ms here) with no slower
+    poll ever banking a base sample — must still converge to a burn
+    verdict. Coalescing replaces the tail slot's reads but keeps its
+    original timestamp, so the slot ages past the resolution step and
+    base samples accumulate; rewriting the timestamp made the tail a
+    sliding target that kept the engine verdict-less forever."""
+    from mmlspark_tpu.obs.slo import SLOSpec, SLOTracker
+    spec = SLOSpec(objective=0.9, window_s=10.0, long_window_s=40.0,
+                   min_requests=5)
+    stats = _slo_stats()
+    tracker = SLOTracker(spec, stats)
+    for i in range(1000):          # t = 0 .. 2 s, quiet
+        tracker.sample(now=i * 0.002)
+    for _ in range(16):
+        stats.record_admitted()
+        stats.record_done(e2e_ms=5.0, queue_ms=1.0)
+    for _ in range(4):
+        stats.record_admitted()
+        stats.record_failed()
+    s = None
+    for i in range(1000, 5501):    # keep probing through t = 11 s
+        s = tracker.sample(now=i * 0.002)
+    # the 10 s short-window base (a slot near t = 1 s) predates the
+    # incident: the burn is visible instead of None-forever
+    assert s["window_short"]["terminal"] == 20
+    assert s["window_short"]["errors"] == 4
+    assert s["burn_rate_short"] == pytest.approx(2.0)
+
+
+def test_slo_latency_objective_and_derived_gauges():
+    from mmlspark_tpu.obs.slo import SLOSpec, SLOTracker
+    spec = SLOSpec(objective=0.999, latency_ms=50.0,
+                   latency_quantile="p99")
+    stats = _slo_stats()
+    stats.record_batch(bucket=8, occupancy=6, device_ms=4.0,
+                       replica=0)
+    stats.record_batch(bucket=8, occupancy=2, device_ms=4.0,
+                       replica=0)
+    stats.record_batch(bucket=8, occupancy=8, device_ms=4.0,
+                       replica=1)
+    for ms in (10.0, 20.0, 200.0):
+        stats.record_admitted()
+        stats.record_done(e2e_ms=ms, queue_ms=1.0)
+    tracker = SLOTracker(spec, stats, queued_fn=lambda: 7)
+    s = tracker.sample(now=0.0)
+    assert s["latency_ok"] is False and s["latency_ms"] > 50.0
+    # derived gauges landed in the model's own registry
+    reg = stats.registry
+    assert reg.gauge("serve.queue_depth", model="m").value == 7.0
+    assert reg.gauge("serve.occupancy_mean_window",
+                     model="m").value == pytest.approx(16 / 3, abs=1e-3)
+    # replica skew from the replica_batches counters: 2 vs 1 → 0.5
+    assert reg.gauge("serve.replica_skew", model="m").value \
+        == pytest.approx(0.5)
+    assert s["replica_skew"] == pytest.approx(0.5)
+
+
+def test_slo_spec_validation_and_parse():
+    from mmlspark_tpu.obs.slo import SLOSpec
+    with pytest.raises(ValueError):
+        SLOSpec(objective=1.0)
+    with pytest.raises(ValueError):
+        SLOSpec(latency_quantile="p90")
+    with pytest.raises(ValueError):
+        SLOSpec(window_s=60.0, long_window_s=30.0)
+    with pytest.raises(ValueError):
+        SLOSpec(min_requests=0)  # would divide by a zero-traffic window
+    with pytest.raises(ValueError):
+        SLOSpec(fast_burn=0.0)
+    with pytest.raises(ValueError):
+        SLOSpec(slow_burn=-1.0)
+    assert SLOSpec.parse(None).objective == 0.999
+    parsed = SLOSpec.parse({"objective": 0.95, "latency_ms": 100.0})
+    assert parsed.objective == 0.95 and parsed.budget == \
+        pytest.approx(0.05)
+    assert SLOSpec.parse(parsed) is parsed
+    with pytest.raises(TypeError):
+        SLOSpec.parse("p99<100ms")
+
+
+def test_slow_step_detector_flags_outliers_and_rebaselines():
+    from mmlspark_tpu.obs.slo import SlowStepDetector
+    obs.enable()
+    det = SlowStepDetector(loop="t", factor=3.0, min_samples=4,
+                           window=8)
+    assert not any(det.observe(10.0) for _ in range(4))  # baseline
+    assert det.observe(100.0) is True  # 10x the median
+    assert det.observe(12.0) is False
+    assert obs.registry().value("train.slow_steps", loop="t") == 1
+    events = [r for r in obs.captured()
+              if getattr(r, "name", "") == "train/slow_step"]
+    assert len(events) == 1 and events[0].labels["step_ms"] == 100.0
+    # regime change: consistently slower steps re-baseline via the
+    # window median instead of flagging forever
+    for _ in range(8):
+        det.observe(100.0)
+    assert det.observe(110.0) is False
+
+
+def test_slow_step_detector_baseline_is_per_instance():
+    """The train.step_ms{loop=...} histogram is interned process-wide,
+    but a fresh detector (a new fit) must baseline against ITS OWN
+    steps — not the previous fit's window, which would flag every step
+    of a legitimately slower run."""
+    from mmlspark_tpu.obs.slo import SlowStepDetector
+    obs.enable()
+    fast = SlowStepDetector(loop="t2", factor=3.0, min_samples=4,
+                            window=8)
+    for _ in range(8):
+        fast.observe(0.5)
+    slow = SlowStepDetector(loop="t2", factor=3.0, min_samples=4,
+                            window=8)
+    # 5.0 ms steps are 10x the previous fit's median, but this fit's
+    # own baseline is 5.0 — nothing is slow
+    assert not any(slow.observe(5.0) for _ in range(8))
+    assert obs.registry().value("train.slow_steps", loop="t2") == 0
+
+
+def test_trainer_publishes_step_histogram_and_slow_counter():
+    from mmlspark_tpu.models.zoo import MLP
+    from mmlspark_tpu.train.loop import TrainConfig, Trainer
+
+    obs.enable()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 6)).astype(np.float32)
+    y = rng.integers(0, 4, 64).astype(np.int64)
+    tr = Trainer(MLP(features=(8,), num_outputs=4),
+                 TrainConfig(batch_size=16, epochs=1, prefetch_depth=2))
+    tr.fit_arrays(x, y)
+    h = obs.registry().histogram("train.step_ms", loop="fit_arrays")
+    assert h.count == 4  # one observation per step
+    assert obs.registry().value("train.slow_steps",
+                                loop="fit_arrays") is not None
+
+
+# ---- health state machine (obs/health.py) ----
+
+
+def _status(burn_short=None, burn_long=None, latency_ok=None,
+            admitted=0, rejected=0, terminal=0):
+    return {
+        "burn_rate_short": burn_short,
+        "burn_rate_long": burn_long,
+        "latency_ok": latency_ok,
+        "latency_ms": 10.0,
+        "slo": {"latency_ms": 5.0, "latency_quantile": "p99"},
+        "window_short": {"admitted": admitted, "rejected": rejected,
+                         "terminal": terminal},
+    }
+
+
+def test_health_classification_levels():
+    from mmlspark_tpu.obs.health import (
+        DEGRADED, OK, UNHEALTHY, HealthPolicy, classify,
+    )
+    pol = HealthPolicy(fast_burn=10.0, slow_burn=2.0, min_events=5)
+    assert classify(_status(), pol) == (OK, "")
+    lvl, why = classify(_status(burn_short=12.0), pol)
+    assert lvl == UNHEALTHY and "burn" in why
+    lvl, why = classify(_status(burn_long=3.0), pol)
+    assert lvl == DEGRADED and "long-window" in why
+    lvl, why = classify(_status(latency_ok=False, terminal=10), pol)
+    assert lvl == DEGRADED and "latency" in why
+    # a frozen e2e reservoir (violating percentiles, no fresh window
+    # traffic) is NOT a live violation — otherwise one cold-compile
+    # spike would hold DEGRADED forever after traffic stops
+    assert classify(_status(latency_ok=False), pol) == (OK, "")
+    # admission bouncing most arrivals is unhealthy even with no
+    # completed-request errors (Overloaded is backpressure)
+    lvl, why = classify(_status(admitted=4, rejected=8), pol)
+    assert lvl == UNHEALTHY and "rejecting" in why
+    # ... but not below the event floor
+    assert classify(_status(admitted=1, rejected=2), pol) == (OK, "")
+
+
+def test_health_monitor_hysteresis():
+    from mmlspark_tpu.obs.health import (
+        DEGRADED, OK, UNHEALTHY, HealthMonitor, HealthPolicy,
+    )
+    mon = HealthMonitor(HealthPolicy(fast_burn=10.0, slow_burn=2.0,
+                                     recover_after=3))
+    assert mon.update(_status()) == OK
+    # worsening applies immediately
+    assert mon.update(_status(burn_short=20.0)) == UNHEALTHY
+    assert mon.reason
+    # recovery needs recover_after consecutive better samples
+    assert mon.update(_status()) == UNHEALTHY
+    assert mon.update(_status()) == UNHEALTHY
+    assert mon.update(_status()) == OK
+    # a relapse mid-streak resets it
+    assert mon.update(_status(burn_long=5.0)) == DEGRADED
+    assert mon.update(_status()) == DEGRADED
+    assert mon.update(_status(burn_long=5.0)) == DEGRADED
+    assert mon.update(_status()) == DEGRADED
+    assert mon.update(_status()) == DEGRADED
+    assert mon.update(_status()) == OK
+
+
+def test_health_recovers_after_latency_spike_traffic_stops():
+    """A latency violation backed by window traffic degrades; once
+    traffic stops the reservoir stays frozen at the bad percentiles,
+    but the verdict expires with the window and hysteresis recovers."""
+    from mmlspark_tpu.obs.health import (
+        DEGRADED, OK, HealthMonitor, HealthPolicy,
+    )
+    mon = HealthMonitor(HealthPolicy(min_events=5, recover_after=3))
+    assert mon.update(_status(latency_ok=False, terminal=10)) == DEGRADED
+    # traffic stops: percentiles still violating, window empty
+    assert mon.update(_status(latency_ok=False)) == DEGRADED
+    assert mon.update(_status(latency_ok=False)) == DEGRADED
+    assert mon.update(_status(latency_ok=False)) == OK
+
+
+def test_worst_of_states():
+    from mmlspark_tpu.obs.health import worst
+    assert worst([]) == "ok"
+    assert worst(["ok", "degraded", "ok"]) == "degraded"
+    assert worst(["degraded", "unhealthy"]) == "unhealthy"
+
+
+# ---- Prometheus text exposition ----
+
+
+def test_prometheus_text_exposition_format():
+    from mmlspark_tpu.obs.export import prometheus_text
+    reg = obs.registry()
+    reg.counter("serve.admitted", model="m").add(3)
+    reg.gauge("serve.queue_depth", model="m").set(2)
+    reg.gauge("never.set")  # unset gauge: skipped (no null in prom)
+    h = reg.histogram("serve.e2e_ms", window=16, model="m")
+    for v in (1.0, 2.0, 3.0, 4.0):
+        h.observe(v)
+    text = prometheus_text()
+    lines = text.splitlines()
+    assert "# TYPE serve_admitted counter" in lines
+    assert 'serve_admitted{model="m"} 3' in lines
+    assert "# TYPE serve_queue_depth gauge" in lines
+    assert 'serve_queue_depth{model="m"} 2' in lines
+    assert "# TYPE serve_e2e_ms summary" in lines
+    assert 'serve_e2e_ms{model="m",quantile="0.5"} 2.5' in lines
+    assert 'serve_e2e_ms_count{model="m"} 4' in lines
+    assert 'serve_e2e_ms_sum{model="m"} 10' in lines
+    assert not any("never_set" in ln for ln in lines)
+    # names are sanitized to the prom grammar; output ends with newline
+    assert all(" " in ln or ln.startswith("#") for ln in lines)
+    assert text.endswith("\n")
+
+
+def test_prometheus_text_survives_non_finite_values():
+    """One NaN/Inf series must not 500 the whole scrape — the registry
+    is the shared substrate and any client can record a bad ratio.
+    Non-finite samples render as the Prometheus literals."""
+    from mmlspark_tpu.obs.export import prometheus_text
+    reg = obs.registry()
+    reg.gauge("bad.ratio", model="m").set(float("nan"))
+    reg.gauge("bad.pos", model="m").set(float("inf"))
+    reg.gauge("bad.neg", model="m").set(float("-inf"))
+    reg.counter("still.fine").add(2)
+    lines = prometheus_text().splitlines()
+    assert 'bad_ratio{model="m"} NaN' in lines
+    assert 'bad_pos{model="m"} +Inf' in lines
+    assert 'bad_neg{model="m"} -Inf' in lines
+    assert "still_fine 2" in lines
+
+
+def test_prometheus_text_merges_registries_and_escapes_labels():
+    from mmlspark_tpu.obs.export import prometheus_text
+    from mmlspark_tpu.obs.metrics import MetricsRegistry
+    r1, r2 = MetricsRegistry(), MetricsRegistry()
+    r1.counter("serve.admitted", model="a").add(1)
+    r2.counter("serve.admitted", model='b"\\q').add(2)
+    text = prometheus_text([r1, r2])
+    # ONE TYPE header for the shared name, both series present
+    assert text.count("# TYPE serve_admitted counter") == 1
+    assert 'serve_admitted{model="a"} 1' in text
+    assert 'serve_admitted{model="b\\"\\\\q"} 2' in text

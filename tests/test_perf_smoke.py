@@ -13,8 +13,9 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
 
 from perf_smoke import (  # noqa: E402
-    check_fused_crossings, check_obs_overhead, check_serve_batching,
-    check_serve_sharded, check_spmd_clean, check_train_prefetch,
+    check_fused_crossings, check_obs_overhead, check_obs_request_tracing,
+    check_serve_batching, check_serve_sharded, check_spmd_clean,
+    check_train_prefetch,
 )
 
 
@@ -36,6 +37,20 @@ def test_obs_disabled_path_overhead_bounded():
     result = check_obs_overhead()
     assert result["overhead_fraction_bound"] < result["max_fraction"]
     assert result["spans_when_enabled"] > 0  # the seams actually exist
+
+
+def test_obs_request_tracing_links_intact_across_replica_lanes():
+    """Request-scoped tracing: a 200-request burst over dp=4 replica
+    lanes yields exactly one trace per completed request with the
+    admission -> pack -> dispatch -> drain -> complete links intact,
+    real fan-in on the bucket-batch spans, all four lanes used, and one
+    exported Perfetto flow per request."""
+    result = check_obs_request_tracing()
+    assert result["traces"] == result["requests"] == 200
+    assert result["intact"] == result["requests"]
+    assert result["replicas_used"] == [0, 1, 2, 3]
+    assert result["max_pack_fan_in"] > 1
+    assert result["flow_ids_exported"] == result["requests"]
 
 
 def test_spmd_verifier_and_lint_are_clean():

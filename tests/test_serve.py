@@ -502,6 +502,204 @@ class TestHTTP:
         assert "scores" in out and len(out) == 2
 
 
+class TestHealthAndSLOSurfaces:
+    def test_healthz_is_ready_and_drain_aware(self, http_mlp_server):
+        server, base = http_mlp_server
+        with urllib.request.urlopen(f"{base}/healthz") as r:
+            body = json.loads(r.read())
+        assert r.status == 200
+        assert body["status"] == "ok" and body["ready"] is True
+        assert body["draining"] is False and body["models"] == ["mlp"]
+        assert body["model_health"]["mlp"]["state"] == "ok"
+        # draining: readiness drops to 503 while the body keeps
+        # answering
+        server.close(drain=True)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"{base}/healthz")
+        assert exc.value.code == 503
+        drained = json.loads(exc.value.read())
+        assert drained["status"] == "draining"
+        assert drained["ready"] is False and drained["draining"] is True
+        # liveness is a separate surface: /livez stays 200 through the
+        # drain, so a restart probe never kills a draining server
+        with urllib.request.urlopen(f"{base}/livez") as r:
+            assert r.status == 200
+            assert json.loads(r.read()) == {"alive": True}
+
+    def test_slo_endpoint_reports_burn_and_budget(self, http_mlp_server):
+        server, base = http_mlp_server
+        rng = np.random.default_rng(5)
+        for _ in range(4):
+            server.predict("mlp", DataTable({"input": list(
+                rng.normal(size=(2, 6)).astype(np.float32))}))
+        with urllib.request.urlopen(f"{base}/slo") as r:
+            body = json.loads(r.read())
+        slo = body["mlp"]
+        assert slo["slo"]["objective"] == 0.999
+        assert slo["budget_remaining"] == 1.0  # nothing failed
+        assert slo["counters"]["completed"] == 4
+        assert slo["health"]["state"] == "ok"
+        assert slo["queue_depth"] == 0
+        # a second poll is a second burn sample over real deltas: the
+        # quiet window has no verdict, never a crash
+        with urllib.request.urlopen(f"{base}/slo") as r:
+            again = json.loads(r.read())
+        assert again["mlp"]["burn_rate_short"] is None
+
+    def test_unhealthy_model_fails_readiness(self):
+        """Burn past the fast-burn threshold -> /healthz goes 503 with
+        the unhealthy verdict (the state machine is wired to the real
+        counters, not a synthetic status)."""
+        from mmlspark_tpu.obs.slo import SLOSpec
+        from mmlspark_tpu.serve.http import start_http_server
+        # 50% objective, tiny short window, verdicts from 4 requests
+        # up; long_window_s stays generous so the tracker's 2x-long
+        # ring pruning can never drop the baseline sample on a slow box
+        spec = SLOSpec(objective=0.5, window_s=0.05, long_window_s=10.0,
+                       min_requests=4, fast_burn=1.5)
+        server = ModelServer(ServeConfig(buckets=(1, 4), max_queue=64,
+                                         slo=spec))
+        httpd = start_http_server(server, host="127.0.0.1", port=0)
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            server.add_model("mlp", mlp_bundle())
+            with urllib.request.urlopen(f"{base}/healthz") as r:
+                assert json.loads(r.read())["ready"] is True
+            # every request fails: the bundle wants 6-wide vectors
+            bad = vector_table(np.zeros((1, 3), np.float32))
+            for _ in range(8):
+                with pytest.raises(Exception):
+                    server.predict("mlp", bad, timeout=30)
+            time.sleep(0.06)  # let the short window age past window_s
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(f"{base}/healthz")
+            assert exc.value.code == 503
+            body = json.loads(exc.value.read())
+            assert body["model_health"]["mlp"]["state"] == "unhealthy"
+            assert "burn" in body["model_health"]["mlp"]["reason"]
+            # an alive-but-burning server must NOT fail liveness: a
+            # restart would only amplify the incident
+            with urllib.request.urlopen(f"{base}/livez") as r:
+                assert r.status == 200
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            server.close()
+
+    def test_metrics_prometheus_content_negotiation(self,
+                                                    http_mlp_server):
+        server, base = http_mlp_server
+        rng = np.random.default_rng(6)
+        server.predict("mlp", DataTable({"input": list(
+            rng.normal(size=(3, 6)).astype(np.float32))}))
+        req = urllib.request.Request(
+            f"{base}/metrics",
+            headers={"Accept": "text/plain;version=0.0.4"})
+        with urllib.request.urlopen(req) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode("utf-8")
+        assert "# TYPE serve_admitted counter" in text
+        assert 'serve_admitted{model="mlp"} 1' in text
+        assert 'serve_rows_dispatched{model="mlp"} 3' in text
+        assert "# TYPE serve_e2e_ms summary" in text
+        # the default stays the JSON snapshot, byte-compatible shape
+        with urllib.request.urlopen(f"{base}/metrics") as r:
+            body = json.loads(r.read())
+        assert "metrics" in body and "models" in body
+        assert body["models"]["mlp"]["admitted"] == 1
+        assert body["models"]["mlp"]["rows_dispatched"] == 3
+
+
+class TestObsEndpointsUnderTraffic:
+    def test_metrics_and_trace_consistent_during_drain(self):
+        """Satellite pin: /metrics and /trace polled from other threads
+        while requests are in flight AND while drain-on-close runs must
+        always answer (200, valid JSON, monotonic counters) and must
+        never block the drain."""
+        from mmlspark_tpu import obs
+        from mmlspark_tpu.serve.http import start_http_server
+        polls: list[tuple] = []
+        stop = threading.Event()
+        server = httpd = poller = None
+        try:
+            # everything that leaks on failure (global tracer flag,
+            # batcher/HTTP threads) is created inside the try so a bind
+            # error can't poison later tests in the session
+            obs.enable()
+            server = ModelServer(ServeConfig(
+                buckets=(1, 4), max_queue=64,
+                deadline_ms=None, warmup=False))
+            httpd = start_http_server(server, host="127.0.0.1", port=0)
+            base = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+            def poll_loop():
+                while not stop.is_set():
+                    for path in ("/metrics", "/trace", "/healthz",
+                                 "/livez", "/slo"):
+                        try:
+                            with urllib.request.urlopen(
+                                    base + path, timeout=10) as r:
+                                polls.append((path, r.status,
+                                              json.loads(r.read())))
+                        except urllib.error.HTTPError as e:
+                            # only the drain-aware readiness flip is
+                            # legal
+                            polls.append((path, e.code,
+                                          json.loads(e.read())))
+                    time.sleep(0.005)
+
+            poller = threading.Thread(target=poll_loop, daemon=True)
+            server.add_model("m", sleepy_model(0.03))
+            rng = np.random.default_rng(7)
+            rows = rng.normal(size=(16, 4)).astype(np.float32)
+            handles = [server.submit("m", vector_table(rows[i:i + 1]))
+                       for i in range(16)]
+            poller.start()
+            t0 = time.monotonic()
+            server.close(drain=True)  # drains ~16 x 30 ms of work
+            drain_s = time.monotonic() - t0
+            for h in handles:  # every admitted request was answered
+                assert len(h.result(timeout=1)) == 1
+        finally:
+            stop.set()
+            if poller is not None and poller.ident is not None:
+                poller.join(timeout=10)
+            if httpd is not None:
+                httpd.shutdown()
+                httpd.server_close()
+            if server is not None:
+                server.close()
+            obs.disable()
+            obs.clear()
+        assert drain_s < 20.0, f"drain took {drain_s:.1f}s — an obs " \
+            "poll blocked the drain"
+        metrics = [p for p in polls if p[0] == "/metrics"]
+        traces = [p for p in polls if p[0] == "/trace"]
+        healths = [p for p in polls if p[0] == "/healthz"]
+        assert metrics and traces and healths, polls
+        # every poll answered with valid JSON; /metrics and /trace and
+        # /slo never fail, /healthz only ever flips to the typed 503
+        for path, status, _body in polls:
+            assert status == 200 or (path == "/healthz"
+                                     and status == 503), (path, status)
+        # counter consistency across concurrent snapshots: admitted and
+        # completed are monotonic, and completed never exceeds admitted
+        seen_admitted = seen_completed = 0
+        for _path, _status, body in metrics:
+            snap = body["models"].get("m")
+            if snap is None:
+                continue
+            assert snap["completed"] <= snap["admitted"] == 16
+            assert snap["admitted"] >= seen_admitted
+            assert snap["completed"] >= seen_completed
+            seen_admitted = snap["admitted"]
+            seen_completed = snap["completed"]
+        # the trace bodies are well-formed Chrome traces throughout
+        for _path, _status, body in traces:
+            assert isinstance(body["traceEvents"], list)
+
+
 class TestStatsPreTraffic:
     def test_snapshot_safe_before_any_traffic(self):
         """Regression (obs satellite): a freshly created ServerStats —

@@ -1,0 +1,130 @@
+"""tools/trace.py CLI: the render path must consume exactly what the
+exporter writes (valid Chrome-trace JSON with per-thread AND per-replica
+lanes, request flows included), and missing/malformed trace input must be
+a typed one-line error with exit code 2 — never an unhandled traceback."""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from mmlspark_tpu import obs
+from mmlspark_tpu.obs import context as obs_context
+from mmlspark_tpu.obs.export import REPLICA_TID_BASE
+
+_TOOLS = os.path.join(os.path.dirname(__file__), "..", "tools")
+
+
+def _load_trace_cli():
+    """Import tools/trace.py under a private name (plain ``import
+    trace`` would shadow the stdlib module for the whole test process)."""
+    spec = importlib.util.spec_from_file_location(
+        "mmlspark_tools_trace", os.path.join(_TOOLS, "trace.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+trace_cli = _load_trace_cli()
+
+
+@pytest.fixture(autouse=True)
+def obs_isolated():
+    obs.disable()
+    obs.clear()
+    obs.registry().reset()
+    yield
+    obs.disable()
+    obs.clear()
+    obs.registry().reset()
+
+
+def _write_capture(path: str) -> int:
+    """Record a small capture with a request flow and replica-labeled
+    spans (two lanes), write it, return the trace id."""
+    obs.enable()
+    t = obs.mint()
+    with obs_context.bind(t):
+        with obs.span("serve/admit", "serve", {"model": "m"}):
+            pass
+    for replica in (0, 1):
+        with obs.span("serve/dispatch", "serve",
+                      {"model": "m", "replica": replica}, (t,)):
+            pass
+    with obs_context.bind(t):
+        with obs.span("serve/complete", "serve", {"model": "m"}):
+            pass
+    obs.write_chrome_trace(path)
+    return t
+
+
+class TestRender:
+    def test_render_validates_and_summarizes_written_trace(
+            self, tmp_path, capsys):
+        path = str(tmp_path / "trace.json")
+        t = _write_capture(path)
+        # the emitted JSON loads and carries per-thread AND per-replica
+        # lanes (thread_name metadata), exactly what Perfetto groups by
+        with open(path, "r", encoding="utf-8") as fh:
+            payload = json.load(fh)
+        events = payload["traceEvents"]
+        lanes = {e["args"]["name"]: e["tid"] for e in events
+                 if e.get("ph") == "M"}
+        replica_lanes = {n for n in lanes if n.startswith("serve-replica-")}
+        assert replica_lanes == {"serve-replica-0 [m]",
+                                 "serve-replica-1 [m]"}
+        assert all(lanes[n] >= REPLICA_TID_BASE for n in replica_lanes)
+        thread_lanes = set(lanes) - replica_lanes
+        assert thread_lanes  # the recording thread's own lane
+        assert len({lanes[n] for n in lanes}) == len(lanes)  # distinct
+        # the request flow survived serialization
+        flow = [e for e in events if e.get("ph") in ("s", "t", "f")]
+        assert [e["ph"] for e in flow] == ["s", "t", "t", "f"]
+        assert all(e["id"] == t for e in flow)
+
+        # render succeeds and aggregates the span names
+        rc = trace_cli.main(["render", path])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "serve/dispatch" in out and "serve/admit" in out
+        assert "1 request flow(s)" in out
+
+    def test_render_missing_file_is_typed_exit_2(self, tmp_path, capsys):
+        rc = trace_cli.main(["render", str(tmp_path / "nope.json")])
+        err = capsys.readouterr().err
+        assert rc == 2
+        assert err.startswith("trace:") and "cannot read" in err
+
+    def test_render_malformed_json_is_typed_exit_2(self, tmp_path,
+                                                   capsys):
+        bad = tmp_path / "bad.json"
+        bad.write_text("{not json", encoding="utf-8")
+        rc = trace_cli.main(["render", str(bad)])
+        err = capsys.readouterr().err
+        assert rc == 2
+        assert "not valid JSON" in err
+
+    def test_render_non_trace_json_is_typed_exit_2(self, tmp_path,
+                                                   capsys):
+        for doc in ("[1, 2, 3]", '{"spans": []}'):
+            f = tmp_path / "doc.json"
+            f.write_text(doc, encoding="utf-8")
+            rc = trace_cli.main(["render", str(f)])
+            err = capsys.readouterr().err
+            assert rc == 2
+            assert "traceEvents" in err
+
+    def test_render_malformed_event_is_typed_exit_2(self, tmp_path,
+                                                    capsys):
+        f = tmp_path / "evil.json"
+        f.write_text(json.dumps({"traceEvents": [
+            {"ph": "X", "dur": 1.0},  # no name
+        ]}), encoding="utf-8")
+        rc = trace_cli.main(["render", str(f)])
+        err = capsys.readouterr().err
+        assert rc == 2
+        assert err.startswith("trace:")

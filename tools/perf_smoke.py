@@ -33,6 +33,14 @@ flake on a loaded CI box):
   bound (per-call disabled-seam cost × the number of seams one transform
   actually hits, against the transform's own wall time) rather than an
   A/B wall-clock diff, so a loaded CI box cannot flake it.
+* **obs request tracing** — a ≥200-request serve burst across dp=4
+  replica lanes must yield exactly ONE trace per completed request with
+  the admission → pack → dispatch → drain → complete links intact
+  (``obs/context.py``): every request's trace id appears on its own
+  admit/complete spans and in the links of the bucket-batch spans it
+  was coalesced into, every flow exports as Perfetto flow events, and
+  all four replica lanes participate (the latency-bound model makes the
+  fan-out deterministic, as in the sharded gate).
 * **spmd clean** — the symbolic SPMD verifier
   (mmlspark_tpu/analysis/spmd.py, docs/spmd_analysis.md) over every
   declared parallel entry point (sharding contracts, partial-sum
@@ -418,6 +426,119 @@ def check_serve_sharded(min_speedup: float = 2.5) -> dict:
     }
 
 
+def check_obs_request_tracing(n_req: int = 200, dp: int = 4) -> dict:
+    """A serve burst across dp replica lanes; raise AssertionError
+    unless every completed request resolves to exactly one request
+    trace with intact fan-in/fan-out links.
+
+    The request-scoped tracing contract (docs/observability.md): a
+    trace id is minted at admission, the admit/complete spans carry it,
+    and the pack/dispatch/drain bucket-batch spans link every coalesced
+    member — so the registry of captured spans reconstructs each
+    request's whole journey across the scheduler and replica-lane
+    threads, and the Chrome-trace export draws it as one flow. Uses the
+    latency-bound callback-hold model of :func:`check_serve_sharded` so
+    all ``dp`` lanes deterministically participate."""
+    import jax
+
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.data.table import DataTable
+    from mmlspark_tpu.models.jax_model import JaxModel
+    from mmlspark_tpu.obs import context as obs_context
+    from mmlspark_tpu.serve import ModelServer, ServeConfig
+
+    if len(jax.devices()) < dp:
+        raise AssertionError(
+            f"check_obs_request_tracing needs >= {dp} devices for the "
+            f"dp={dp} fan-out; got {len(jax.devices())}")
+    buckets = (1, 8, 32)
+    bundle, probe = _latency_bundle(0.004)
+    rng = np.random.default_rng(0)
+    rows = rng.normal(size=(n_req, 24)).astype(np.float32)
+
+    obs.disable()
+    obs.clear()
+    obs.registry().reset()
+    obs.enable()
+    try:
+        jm = JaxModel(model=bundle, input_col="x", output_col="scores")
+        server = ModelServer(ServeConfig(
+            buckets=buckets, max_queue=n_req + 8, deadline_ms=None,
+            mesh=f"dp={dp}"))
+        try:
+            server.add_model("m", jm,
+                             example=DataTable({"x": [rows[0]]}))
+            obs.clear()  # warmup spans out: count the burst only
+            handles = [server.submit("m", DataTable({"x": [rows[i]]}))
+                       for i in range(n_req)]
+            outs = [h.result(timeout=300) for h in handles]
+            snap = server.stats("m").snapshot()
+        finally:
+            server.close()
+        assert all(len(o) == 1 and "scores" in o for o in outs)
+        assert snap["completed"] == n_req
+
+        trace_ids = [h.trace_id for h in handles]
+        assert all(t is not None for t in trace_ids), (
+            "tracer enabled but requests carry no trace id — minting "
+            "at admission regressed")
+        assert len(set(trace_ids)) == n_req, (
+            f"{len(set(trace_ids))} distinct trace ids for {n_req} "
+            "requests — trace ids must be unique per request")
+        traces = obs_context.request_traces()
+        broken = []
+        for h in handles:
+            spans = traces.get(h.trace_id)
+            if spans is None:
+                broken.append((h.trace_id, "no spans captured"))
+                continue
+            why = obs_context.check_journey(spans)
+            if why is not None:
+                broken.append((h.trace_id, why))
+        assert not broken, (
+            f"{len(broken)}/{n_req} completed requests lack an intact "
+            f"admission → pack → dispatch → drain → complete trace; "
+            f"first failures: {broken[:5]}")
+
+        # the fan-in is real: at least one bucket-batch span links >1
+        # request (the burst coalesces), and the fan-out reached every
+        # replica lane
+        pack_links = [len(s.links or ()) for s in obs.captured()
+                      if getattr(s, "name", "") == "serve/pack"]
+        assert pack_links and max(pack_links) > 1, (
+            f"no pack span linked more than one request "
+            f"({pack_links}) — fan-in links regressed")
+        assert sorted(snap["replicas"]) == list(range(dp)), (
+            f"burst used replicas {sorted(snap['replicas'])} of "
+            f"{list(range(dp))}")
+
+        # every trace renders as one flow in the export
+        trace = obs.chrome_trace()
+        flow_ids = {e["id"] for e in trace["traceEvents"]
+                    if e.get("ph") in ("s", "t", "f")}
+        missing_flows = set(trace_ids) - flow_ids
+        assert not missing_flows, (
+            f"{len(missing_flows)} request traces have no Perfetto "
+            "flow events in the export")
+    finally:
+        obs.disable()
+        obs.clear()
+        obs.registry().reset()
+
+    return {
+        "requests": n_req,
+        "dp": dp,
+        "buckets": list(buckets),
+        "traces": len(set(trace_ids)),
+        "intact": n_req - len(broken),
+        "batches": snap["batches"],
+        "batch_occupancy_mean": snap["batch_occupancy_mean"],
+        "max_pack_fan_in": max(pack_links),
+        "replicas_used": sorted(snap["replicas"]),
+        "flow_ids_exported": len(flow_ids & set(trace_ids)),
+    }
+
+
 def check_obs_overhead(max_fraction: float = 0.02) -> dict:
     """The obs seams' disabled-path cost on the fused-pipeline microbench
     must stay under ``max_fraction`` (2%) of the transform itself.
@@ -592,6 +713,7 @@ def main() -> int:
         serve = check_serve_batching()
         serve_sharded = check_serve_sharded()
         obs_overhead = check_obs_overhead()
+        obs_tracing = check_obs_request_tracing()
         spmd = check_spmd_clean()
     except AssertionError as e:
         print(json.dumps({"perf_smoke": "FAIL", "reason": str(e)}))
@@ -599,7 +721,8 @@ def main() -> int:
     print(json.dumps({"perf_smoke": "OK", **result,
                       "train_prefetch": train, "serve": serve,
                       "serve_sharded": serve_sharded,
-                      "obs_overhead": obs_overhead, "spmd": spmd}))
+                      "obs_overhead": obs_overhead,
+                      "obs_request_tracing": obs_tracing, "spmd": spmd}))
     return 0
 
 

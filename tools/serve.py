@@ -5,6 +5,7 @@ Usage::
     python tools/serve.py <model-path> [--name NAME] [--host H] [--port P]
         [--buckets 1,8,32,128] [--max-queue N] [--deadline-ms D]
         [--mesh dp=N[,tp=M][,pp=K]] [--schema schema.json] [--no-warmup]
+        [--obs] [--slo-objective 0.999] [--slo-latency-ms P99_MS]
 
 ``<model-path>`` is any of
 
@@ -21,6 +22,18 @@ and the bucket ladder is warmed when a concrete input schema is known
 (``--schema``, or derived from the bundle's input_spec).
 
 ``--schema`` takes the same JSON column-spec file as ``tools/analyze.py``.
+
+Every server exposes ``/healthz`` (drain-aware readiness: 200 while
+ready, 503 when draining or the SLO burn rate turns the model
+unhealthy), ``/livez`` (liveness: always 200 while the process answers
+HTTP — restart probes go here, never at ``/healthz``) and ``/slo``
+(burn rates, error-budget remaining, latency
+verdict, queue-depth/occupancy/replica-skew signals) — tune the
+objective with ``--slo-objective``/``--slo-latency-ms``. ``--obs``
+additionally enables the span tracer so ``/metrics`` (JSON, or
+Prometheus text under ``Accept: text/plain``) and ``/trace``
+(Chrome-trace JSON with per-request flows) carry a live timeline. See
+docs/observability.md.
 
 Prints one JSON line when serving starts; Ctrl-C drains in-flight
 requests and exits.
@@ -92,7 +105,17 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--obs", action="store_true",
                     help="enable the obs tracer (docs/observability.md): "
                          "GET /metrics and /trace expose the registry "
-                         "snapshot and the Chrome-trace span timeline")
+                         "snapshot (JSON, or Prometheus text under "
+                         "content negotiation) and the Chrome-trace "
+                         "span timeline with per-request flows")
+    ap.add_argument("--slo-objective", type=float, default=0.999,
+                    help="SLO success-ratio objective; its complement "
+                         "is the error budget the /healthz burn-rate "
+                         "state machine meters (default 0.999)")
+    ap.add_argument("--slo-latency-ms", type=float, default=None,
+                    help="optional p99 latency objective in ms; when "
+                         "violated the model reports degraded on "
+                         "/healthz and /slo")
     args = ap.parse_args(argv if argv is not None else sys.argv[1:])
 
     from mmlspark_tpu.serve import ModelLoadError, ModelServer, ServeConfig
@@ -117,12 +140,21 @@ def main(argv: list[str] | None = None) -> int:
             print(str(e), file=sys.stderr)
             return 2
 
+    from mmlspark_tpu.obs.slo import SLOSpec
+    try:
+        slo = SLOSpec(objective=args.slo_objective,
+                      latency_ms=args.slo_latency_ms)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+
     config = ServeConfig(
         buckets=tuple(int(b) for b in args.buckets.split(",")),
         max_queue=args.max_queue,
         deadline_ms=args.deadline_ms or None,
         warmup=not args.no_warmup,
-        mesh=mesh)
+        mesh=mesh,
+        slo=slo)
     server = ModelServer(config)
     try:
         for model_name, model in _load_models(args.model, args.name):
@@ -141,6 +173,9 @@ def main(argv: list[str] | None = None) -> int:
         "max_queue": config.max_queue,
         "deadline_ms": config.deadline_ms,
         "mesh": mesh.describe() if mesh is not None else None,
+        "slo": slo.describe(),
+        "endpoints": ["/healthz", "/livez", "/slo", "/metrics",
+                      "/trace", "/v1/models", "/v1/stats"],
     }), flush=True)
     try:
         httpd.serve_forever()

@@ -18,7 +18,11 @@ Subcommands::
 
     python tools/trace.py render <trace.json> [--top N]
         Aggregate a previously written trace file into a per-span-name
-        table (calls, total/mean ms), longest first.
+        table (calls, total/mean ms), longest first, plus a one-line
+        flow summary (request traces, if the capture carried any).
+        A missing, unreadable, or malformed trace file is a typed
+        :class:`TraceInputError` — one diagnostic line on stderr and
+        exit code 2, never a traceback.
 
 Open trace.json in https://ui.perfetto.dev (or chrome://tracing). For a
 device-interleaved view capture ``utils/profiling.trace`` simultaneously
@@ -36,6 +40,37 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
+
+
+class TraceInputError(Exception):
+    """A trace input file is missing, unreadable, or not Chrome-trace
+    JSON. The CLI maps it to one stderr line + exit 2 (the typed-error
+    contract of the serving CLIs, applied to the offline renderer)."""
+
+
+def _load_trace(path: str) -> dict:
+    """Read + validate a Chrome-trace JSON file; raises
+    :class:`TraceInputError` with a message naming exactly what is
+    wrong (no-such-file, bad JSON, or a JSON document that is not a
+    ``{"traceEvents": [...]}`` object)."""
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            payload = json.load(fh)
+    except OSError as e:
+        raise TraceInputError(f"cannot read trace file {path!r}: "
+                              f"{e.strerror or e}") from e
+    except ValueError as e:
+        raise TraceInputError(
+            f"{path!r} is not valid JSON ({e}) — expected the "
+            "Chrome-trace file written by tools/trace.py or the /trace "
+            "endpoint") from e
+    if not isinstance(payload, dict) \
+            or not isinstance(payload.get("traceEvents"), list):
+        raise TraceInputError(
+            f"{path!r} is JSON but not a Chrome trace: expected an "
+            'object with a "traceEvents" list (got '
+            f"{type(payload).__name__})")
+    return payload
 
 
 def _write_artifacts(out_dir: str) -> dict:
@@ -101,8 +136,17 @@ def cmd_pipeline(args: argparse.Namespace) -> int:
     schema = None
     if args.schema:
         from mmlspark_tpu.analysis import TableSchema
-        with open(args.schema, "r", encoding="utf-8") as fh:
-            schema = TableSchema.from_spec(json.load(fh))
+        try:
+            with open(args.schema, "r", encoding="utf-8") as fh:
+                schema = TableSchema.from_spec(json.load(fh))
+        except OSError as e:
+            raise TraceInputError(
+                f"cannot read schema file {args.schema!r}: "
+                f"{e.strerror or e}") from e
+        except ValueError as e:
+            raise TraceInputError(
+                f"{args.schema!r} is not a valid JSON column spec "
+                f"({e})") from e
     if schema is None:
         schema = _derived_schema(stages)
     if schema is None:
@@ -126,22 +170,39 @@ def cmd_pipeline(args: argparse.Namespace) -> int:
 
 
 def cmd_render(args: argparse.Namespace) -> int:
-    with open(args.trace, "r", encoding="utf-8") as fh:
-        payload = json.load(fh)
-    events = payload.get("traceEvents", [])
+    payload = _load_trace(args.trace)
+    events = payload["traceEvents"]
     agg: dict[str, dict] = {}
-    for ev in events:
+    flow_ids: set = set()
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            raise TraceInputError(
+                f"{args.trace!r}: traceEvents[{i}] is "
+                f"not an object (got {type(ev).__name__})")
+        if ev.get("ph") in ("s", "t", "f"):
+            flow_ids.add(ev.get("id"))
         if ev.get("ph") != "X":
             continue
-        row = agg.setdefault(ev["name"], {"name": ev["name"],
-                                          "calls": 0, "total_ms": 0.0})
+        try:
+            name = ev["name"]
+            dur = float(ev.get("dur", 0.0))
+        except (KeyError, TypeError, ValueError) as e:
+            raise TraceInputError(
+                f"{args.trace!r}: malformed complete event "
+                f"({e.__class__.__name__}: {e}) — was this file "
+                "written by tools/trace.py?") from e
+        row = agg.setdefault(name, {"name": name,
+                                    "calls": 0, "total_ms": 0.0})
         row["calls"] += 1
-        row["total_ms"] += float(ev.get("dur", 0.0)) / 1e3
+        row["total_ms"] += dur / 1e3
     rows = sorted(agg.values(), key=lambda d: -d["total_ms"])[:args.top]
     for row in rows:
         row["total_ms"] = round(row["total_ms"], 3)
         row["mean_ms"] = round(row["total_ms"] / row["calls"], 3)
     _print_summary(rows)
+    if flow_ids:
+        print(f"({len(flow_ids)} request flow(s) in the capture — open "
+              "in ui.perfetto.dev to see the arrows)")
     return 0
 
 
@@ -169,11 +230,15 @@ def main(argv: list[str] | None = None) -> int:
     rend.add_argument("--top", type=int, default=20)
 
     args = ap.parse_args(argv if argv is not None else sys.argv[1:])
-    if args.cmd == "demo":
-        return cmd_demo(args)
-    if args.cmd == "pipeline":
-        return cmd_pipeline(args)
-    return cmd_render(args)
+    try:
+        if args.cmd == "demo":
+            return cmd_demo(args)
+        if args.cmd == "pipeline":
+            return cmd_pipeline(args)
+        return cmd_render(args)
+    except TraceInputError as e:
+        print(f"trace: {e}", file=sys.stderr)
+        return 2
 
 
 if __name__ == "__main__":
