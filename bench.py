@@ -583,7 +583,11 @@ def main() -> None:
                           "fused_h2d_mb": round(cnt.upload_bytes / 2**20, 2)}
         from mmlspark_tpu import obs
         obs.registry().reset()
-        obs.enable()
+        # device=True: the traced pass also captures per-segment compile
+        # cost + XLA cost/memory gauges (plan.segment.*) and the
+        # compute/transfer/idle split — the attribution behind any
+        # "input-bound" or HBM claim a PERF_NOTES round makes
+        obs.enable(device=True)
         try:
             with plan_lib.count_crossings() as chk:
                 pm.transform(warm)  # untimed: the obs-agreement pass
@@ -595,12 +599,18 @@ def main() -> None:
         # snapshot schema as the /metrics endpoint
         obs_snapshot = obs.registry().snapshot()
         obs_counters = obs_snapshot["counters"]
+        device_split = obs.device_time_split()
         obs.clear()
         obs.registry().reset()
+        obs.device.reset()
         pipe_crossings["obs_agrees"] = (
             obs_counters.get("plan.h2d_uploads", 0) == chk.uploads
             and obs_counters.get("plan.d2h_fetches", 0) == chk.fetches
             and obs_counters.get("plan.h2d_bytes", 0) == chk.upload_bytes)
+        pipe_crossings["device_split"] = device_split
+        pipe_crossings["segment_gauges"] = {
+            k: v for k, v in obs_snapshot["gauges"].items()
+            if k.startswith("plan.segment.")}
         with plan_lib.count_crossings() as cnt:
             t0 = time.perf_counter()
             cur = ptable

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import contextlib
 import threading
+import time
 import weakref
 from collections import deque
 from typing import Any, Callable, Iterator
@@ -39,6 +40,7 @@ from mmlspark_tpu.core.logging_utils import get_logger, timed
 from mmlspark_tpu.core.schema import is_image_column
 from mmlspark_tpu.core.stage import ArrayMeta, DeviceOp, DeviceStage
 from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.obs import device as _obs_dev
 from mmlspark_tpu.obs import runtime as _obs_rt
 from mmlspark_tpu.obs.metrics import registry as _obs_registry
 from mmlspark_tpu.obs.spans import span as _obs_span
@@ -145,7 +147,8 @@ def count_crossings():
 
 
 def _windowed_dispatch(fn: Callable, dev_params: Any, batch: np.ndarray,
-                       size: int, target: Any, max_inflight: int
+                       size: int, target: Any, max_inflight: int,
+                       label: str | None = None
                        ) -> tuple[list, list, Callable[[], None]]:
     """The ONE definition of the upload → call → async-fetch → bounded-
     window discipline, shared by batch execution
@@ -155,7 +158,9 @@ def _windowed_dispatch(fn: Callable, dev_params: Any, batch: np.ndarray,
     ``(pieces, shapes, drain_rest)`` where ``pieces`` accumulates one
     ``[trimmed host array per output]`` list per drained chunk (in chunk
     order), ``shapes`` is the observed upload shapes, and ``drain_rest()``
-    blocks until the window is empty — callers choose when to pay it."""
+    blocks until the window is empty — callers choose when to pay it.
+    ``label`` names the segment for device attribution
+    (:mod:`mmlspark_tpu.obs.device`) when that pillar is enabled."""
     window: deque = deque()
     pieces: list[list[np.ndarray]] = []
     shapes: list[tuple] = []
@@ -174,13 +179,29 @@ def _windowed_dispatch(fn: Callable, dev_params: Any, batch: np.ndarray,
         shapes.append(tuple(chunk.shape))
         # labels built only when tracing: the disabled path allocates
         # nothing beyond the span() call itself (perf_smoke's < 2% gate)
+        attrib = _obs_rt._enabled and _obs_dev._enabled
         labels = ({"shape": str(tuple(chunk.shape))}
                   if _obs_rt._enabled else None)
         with _obs_span("plan/dispatch", "plan", labels):
-            outs = fn(dev_params, _upload(chunk, target))
+            committed = _upload(chunk, target)
+            if attrib:
+                # device attribution: detect a fresh XLA compile via
+                # compile-cache growth around the call and attribute
+                # its time + cost/memory analyses (obs/device.py)
+                cache_before = _obs_rt.jit_cache_size(fn)
+                t_call = time.perf_counter()
+            outs = fn(dev_params, committed)
+            if attrib:
+                dur_call = time.perf_counter() - t_call
             if not isinstance(outs, tuple):
                 outs = (outs,)
             _issue_fetch(outs)
+        if attrib:
+            # outside the dispatch span: cost capture AOT-recompiles the
+            # program once per entry shape, and that second compile must
+            # not inflate the compute side of device_time_split()
+            _obs_dev.note_dispatch(fn, dev_params, chunk, label,
+                                   cache_before, dur_call)
         window.append((outs, valid))
         # drain to inflight-1 so at most max_inflight minibatch outputs are
         # ever device-resident (the documented HBM bound)
@@ -204,8 +225,8 @@ def _assemble_outputs(pieces: list) -> list[np.ndarray]:
 
 
 def pipeline_minibatches(fn: Callable, dev_params: Any, batch: np.ndarray,
-                         size: int, target: Any, max_inflight: int
-                         ) -> list[np.ndarray]:
+                         size: int, target: Any, max_inflight: int,
+                         label: str | None = None) -> list[np.ndarray]:
     """Run ``fn(dev_params, minibatch)`` over ``batch`` with the three-stage
     software pipeline: upload of batch i+1 and device→host copy of batch
     i-1 both overlap compute of batch i (async dispatch +
@@ -218,7 +239,7 @@ def pipeline_minibatches(fn: Callable, dev_params: Any, batch: np.ndarray,
     array per output.
     """
     pieces, _shapes, drain_rest = _windowed_dispatch(
-        fn, dev_params, batch, size, target, max_inflight)
+        fn, dev_params, batch, size, target, max_inflight, label=label)
     drain_rest()
     return _assemble_outputs(pieces)
 
@@ -701,7 +722,7 @@ def _run_segment(seg: _Segment, table: DataTable,
     names = "→".join(type(s).__name__ for s in seg.stages)
     with timed(f"FusedSegment[{names}]", _log, len(table)):
         outs = pipeline_minibatches(fn, dev_params, batch, size, target,
-                                    max_inflight)
+                                    max_inflight, label=names)
     for col, values in zip(seg.out_cols, outs):
         emitter = seg.stages[seg.emitters[col]]
         table = emitter.device_emit(table, values, seg.out_metas[col], ctx)
@@ -771,9 +792,12 @@ def dispatch_segment(seg: _Segment, table: DataTable,
     bound, max_inflight = _segment_minibatch(seg)
     size = dp_rounded_minibatch(min(bound, len(batch)), dp, len(batch))
     labels = {"rows": len(batch)} if _obs_rt._enabled else None
+    seg_label = ("→".join(type(s).__name__ for s in seg.stages)
+                 if _obs_rt._enabled else None)
     with _obs_span("plan/serve_dispatch", "plan", labels):
         pieces, shapes, drain_rest = _windowed_dispatch(
-            fn, dev_params, batch, size, target, max_inflight)
+            fn, dev_params, batch, size, target, max_inflight,
+            label=seg_label)
 
     def finish() -> DataTable:
         drain_rest()

@@ -250,7 +250,8 @@ class JaxModel(Transformer, DeviceStage, HasInputCol, HasOutputCol):
             # segments (core.plan)
             result = pipeline_minibatches(
                 fn, dev_params, batch, size, data,
-                int(self.max_inflight))[0]
+                int(self.max_inflight),
+                label=f"JaxModel[{bundle.name}:{node}]")[0]
         if result.ndim == 1:
             out_col: Any = result
         else:

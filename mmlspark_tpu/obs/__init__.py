@@ -33,6 +33,17 @@ timeline:
 * :mod:`~mmlspark_tpu.obs.health` — the **ok/degraded/unhealthy state
   machine** (fast/slow burn + reject-ratio classification, hysteretic
   recovery) behind the serving health surfaces.
+* :mod:`~mmlspark_tpu.obs.flight` — the **flight recorder**: an
+  always-on post-mortem ring + watchdog that dumps recent spans,
+  per-thread stacks, and the registry snapshot on crash, signal, or
+  hang (``MMLSPARK_TPU_FLIGHT=<dir>``).
+* :mod:`~mmlspark_tpu.obs.device` — **device attribution**: per-segment
+  compile-time histograms, XLA cost/memory gauges
+  (``plan.segment.*``), live device-memory polling, and the
+  compute/transfer/idle timeline split.
+* :mod:`~mmlspark_tpu.obs.anomaly` — the **train anomaly plane**:
+  non-finite loss sentinel (typed :class:`NonFiniteLossError`) and
+  multi-host straggler detection (``train.host_skew``).
 
 Everything is CPU-safe and jax-free at import time. See
 docs/observability.md for the architecture and the instrumented seams.
@@ -60,6 +71,15 @@ from mmlspark_tpu.obs.slo import (  # noqa: F401
 from mmlspark_tpu.obs.health import (  # noqa: F401
     HealthMonitor, HealthPolicy,
 )
+from mmlspark_tpu.obs import anomaly  # noqa: F401
+from mmlspark_tpu.obs import device  # noqa: F401
+from mmlspark_tpu.obs import flight  # noqa: F401
+from mmlspark_tpu.obs.anomaly import (  # noqa: F401
+    NonFiniteLossError, NonFiniteSentinel, StragglerDetector,
+)
+from mmlspark_tpu.obs.device import (  # noqa: F401
+    device_time_split, poll_memory,
+)
 
 __all__ = [
     "Counter",
@@ -69,23 +89,31 @@ __all__ = [
     "HealthPolicy",
     "Histogram",
     "MetricsRegistry",
+    "NonFiniteLossError",
+    "NonFiniteSentinel",
     "REQUEST_JOURNEY",
     "SLOSpec",
     "SLOTracker",
     "SlowStepDetector",
     "SpanRecord",
+    "StragglerDetector",
+    "anomaly",
     "bind",
     "captured",
     "check_journey",
     "chrome_trace",
     "clear",
     "compiled_programs",
+    "device",
+    "device_time_split",
     "disable",
     "enable",
     "enabled",
     "event",
+    "flight",
     "metrics_snapshot",
     "mint",
+    "poll_memory",
     "prometheus_text",
     "registry",
     "request_traces",

@@ -1,0 +1,192 @@
+"""Train-path anomaly plane — non-finite sentinel and straggler detection.
+
+The serving path got its anomaly machinery in the SLO engine
+(``obs/slo.py``: burn rates, the :class:`SlowStepDetector`); this module
+is the training-side counterpart, built on the same registry/event
+substrate:
+
+* :class:`NonFiniteSentinel` — a NaN/Inf loss today surfaces (if ever)
+  as garbage history values many steps later. The sentinel rides the
+  **already-lagged** loss fetches of ``Trainer.fit_arrays``/
+  ``fit_stream`` (no new host sync — the fetch exists for the loss
+  history), fires **exactly once per offending step**, records a
+  ``train.nonfinite_losses{loop=…}`` counter plus a ``train/nonfinite``
+  event, and — in the default ``"raise"`` mode — raises the typed
+  :class:`NonFiniteLossError` so the run dies AT the divergence with a
+  flight-recorder dump, not hours later. ``TrainConfig.nonfinite_loss``
+  selects ``"raise"`` / ``"event"`` (record but continue) / ``"off"``.
+* :class:`StragglerDetector` — multi-host training is as fast as its
+  slowest host, and a straggler is invisible from any single process.
+  The consumer loop feeds per-step dispatch times in; the producer
+  exchanges each host's recent mean **through the existing
+  drain-barrier-fenced liveness allgather** of ``fit_stream`` (the
+  step-time pair rides the same collective as the batch counts — no new
+  exchange site, so the SPMD203 fence discipline holds by construction),
+  and every host publishes ``train.host_skew{loop=…}`` and flags the
+  slow host with a ``train/straggler`` event naming its process index.
+
+:class:`~mmlspark_tpu.obs.slo.SlowStepDetector` (the single-host
+step-outlier detector this generalizes) is re-exported here so the
+anomaly plane is one import surface.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+
+import numpy as np
+
+from mmlspark_tpu.obs import runtime as _rt
+from mmlspark_tpu.obs.metrics import registry as _registry
+from mmlspark_tpu.obs.slo import SlowStepDetector  # noqa: F401 — re-export
+from mmlspark_tpu.obs.spans import event as _event
+
+NONFINITE_MODES = ("raise", "event", "off")
+
+
+class NonFiniteLossError(RuntimeError):
+    """The training loss went NaN/Inf. Carries the offending step and
+    value so the failure is actionable without re-running."""
+
+    def __init__(self, loop: str, step: int, value: float):
+        self.loop = loop
+        self.step = step
+        self.value = value
+        super().__init__(
+            f"{loop}: loss became non-finite ({value}) at global step "
+            f"{step} — the run has diverged (bad learning rate, bad "
+            "batch, or numerical overflow). Set TrainConfig."
+            "nonfinite_loss='event' to record-and-continue instead")
+
+
+class NonFiniteSentinel:
+    """Check each (lagged) fetched loss value; fire once per bad step.
+
+    The check itself is a ``math.isfinite`` on a float the loop already
+    fetched — zero additional device syncs. Counters/events record only
+    when the tracer is enabled; the typed raise works regardless (a
+    correctness guard must not depend on telemetry being on)."""
+
+    __slots__ = ("loop", "mode", "_last_step")
+
+    def __init__(self, loop: str, mode: str = "raise"):
+        if mode not in NONFINITE_MODES:
+            raise ValueError(
+                f"nonfinite_loss must be one of {NONFINITE_MODES}: "
+                f"{mode!r}")
+        self.loop = loop
+        self.mode = mode
+        self._last_step: int | None = None
+
+    def check(self, step: int, value: float) -> float:
+        """Validate one fetched loss; returns it as a float. Exactly one
+        counter/event/raise per offending step even if the same step's
+        value is consulted twice."""
+        value = float(value)
+        if self.mode == "off" or math.isfinite(value):
+            return value
+        if step == self._last_step:
+            return value  # this step already fired
+        self._last_step = step
+        if _rt._enabled:
+            _registry().counter("train.nonfinite_losses",
+                                loop=self.loop).add()
+            _event("train/nonfinite", "train",
+                   {"loop": self.loop, "step": int(step),
+                    "value": str(value)})
+        if self.mode == "raise":
+            raise NonFiniteLossError(self.loop, int(step), value)
+        return value
+
+
+class StragglerDetector:
+    """Per-host step-time skew over the multi-host liveness exchange.
+
+    ``observe(dur_ms)`` accumulates step dispatch times on the consumer
+    thread; ``local_mean_ms()`` drains the accumulator on the producer
+    thread (the value that rides the fenced allgather); ``ingest``
+    takes the gathered ``[nproc]`` vector of per-host means, publishes
+    the ``train.host_skew`` gauge ((max − min) / max ∈ [0, 1]) and
+    per-host ``train.host_step_ms`` gauges, and flags the slowest host
+    with a ``train/straggler`` event + ``train.stragglers`` counter when
+    its mean exceeds ``factor ×`` the median of the *other* active
+    hosts (leave-one-out — a self-inclusive median can never flag the
+    slow half of a 2-host mesh). Hosts that
+    contributed no steps in the window (mean 0 — filler-only blocks)
+    are excluded from the baseline but can still be named slow by their
+    peers' exchange."""
+
+    __slots__ = ("loop", "factor", "_lock", "_sum_ms", "_count", "last")
+
+    def __init__(self, loop: str, factor: float = 2.0):
+        self.loop = loop
+        self.factor = float(factor)
+        self._lock = threading.Lock()
+        self._sum_ms = 0.0
+        self._count = 0
+        self.last: dict | None = None  # most recent ingest verdict
+
+    # -- consumer side --
+
+    def observe(self, dur_ms: float) -> None:
+        with self._lock:
+            self._sum_ms += float(dur_ms)
+            self._count += 1
+
+    # -- producer side (at the fenced exchange) --
+
+    def local_mean_ms(self) -> float:
+        """Mean step time since the last exchange; 0.0 with no steps
+        (the no-data marker peers exclude from the baseline)."""
+        with self._lock:
+            mean = self._sum_ms / self._count if self._count else 0.0
+            self._sum_ms = 0.0
+            self._count = 0
+        return mean
+
+    def ingest(self, host_means_ms: np.ndarray,
+               process_index: int = 0) -> dict | None:
+        """Evaluate one gathered ``[nproc]`` step-time vector; publishes
+        gauges/events and returns the verdict dict (None when no host
+        reported any steps this window)."""
+        means = np.asarray(host_means_ms, np.float64).reshape(-1)
+        active = means[means > 0.0]
+        if active.size == 0:
+            return None
+        hi = float(means.max())
+        lo = float(active.min())
+        skew = 0.0 if hi <= 0 else (hi - lo) / hi
+        slow_host = int(np.argmax(means))
+        # baseline = the OTHER active hosts: including the candidate in
+        # its own median makes a 2-host straggler unflaggable (hi >
+        # factor*(hi+lo)/2 has no solution for factor >= 2), and the
+        # 2-process mesh is the common multi-host config
+        idx_active = np.flatnonzero(means > 0.0)
+        baseline = means[idx_active[idx_active != slow_host]]
+        median = float(np.median(baseline)) if baseline.size else 0.0
+        is_straggler = (baseline.size > 0 and median > 0.0
+                        and hi > self.factor * median)
+        verdict = {
+            "loop": self.loop,
+            "host_means_ms": [round(float(m), 3) for m in means],
+            "skew": round(skew, 4),
+            "slow_host": slow_host,
+            "median_ms": round(median, 3),
+            "straggler": is_straggler,
+        }
+        self.last = verdict
+        if _rt._enabled:
+            reg = _registry()
+            reg.gauge("train.host_skew", loop=self.loop).set(skew)
+            for host, mean in enumerate(means):
+                reg.gauge("train.host_step_ms", loop=self.loop,
+                          host=host).set(round(float(mean), 3))
+            if is_straggler:
+                reg.counter("train.stragglers", loop=self.loop).add()
+                _event("train/straggler", "train",
+                       {"loop": self.loop, "host": slow_host,
+                        "step_ms": round(hi, 3),
+                        "median_ms": round(median, 3),
+                        "observed_from": int(process_index)})
+        return verdict

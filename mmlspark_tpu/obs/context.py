@@ -106,14 +106,25 @@ def request_traces(records: Iterable | None = None
     """Captured spans grouped by trace id (default: the runtime ring
     buffer), each group sorted by start time — one entry per request
     observed, containing its whole journey including the shared
-    bucket-batch spans it was coalesced into."""
+    bucket-batch spans it was coalesced into.
+
+    Retention is bounded (``obs.enable(max_traces=…)``, default 4096):
+    when the ring sees more distinct traces than the bound, the oldest
+    are evicted (drop-oldest; counted in ``obs.traces_dropped``) and no
+    longer grouped here — a batch span that linked both a live and a
+    dropped trace still appears under the live one. An explicit
+    ``records`` list bypasses the filter (the caller owns retention)."""
+    live: set | None = None
     if records is None:
         records = _rt.spans()
+        live = _rt.live_traces()
     out: dict[int, list[SpanRecord]] = {}
     for r in records:
         if not isinstance(r, SpanRecord):
             continue
         for tid in span_trace_ids(r):
+            if live is not None and tid not in live:
+                continue
             out.setdefault(tid, []).append(r)
     for spans in out.values():
         spans.sort(key=lambda s: (s.start_ns, s.span_id))

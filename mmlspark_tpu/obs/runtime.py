@@ -29,6 +29,9 @@ from mmlspark_tpu.core import config
 from mmlspark_tpu.obs.events import EventRecord, SpanRecord
 
 DEFAULT_BUFFER = 65536
+# distinct request traces retained for grouping (obs/context.py) before
+# drop-oldest eviction kicks in — see note_traces below
+DEFAULT_MAX_TRACES = 4096
 
 # single module-level flag instrumented seams check; mutate only through
 # enable()/disable()
@@ -39,25 +42,79 @@ _device_annotations = False
 # bounded ring buffer of completed SpanRecord/EventRecord (oldest evicted)
 _buffer: deque = deque(maxlen=DEFAULT_BUFFER)
 _lock = threading.Lock()
+# total records ever appended — lets the trace evictor compute how many
+# records arrived while it filtered outside the lock (len() can't: a
+# full ring stays at maxlen while still receiving appends)
+_append_seq = 0
+# one physical span-eviction at a time; a thread that loses the race
+# skips — the live-set filter already bounds what readers group, the
+# next eviction round reclaims the spans
+_evict_lock = threading.Lock()
+
+# ---- trace retention (the request_traces eviction policy) ----
+# The span ring is bounded by record COUNT, which bounded nothing per
+# TRACE: a sustained request burst filled the ring with thousands of
+# completed traces that request_traces() kept grouping (and the export
+# kept rendering as flows) until someone called clear(). Retention is
+# now explicit: the first `_max_traces` distinct trace ids stay live;
+# beyond that the OLDEST traces are dropped in batches — their spans
+# evicted from the ring, the drop counted in `obs.traces_dropped` — so
+# a server left tracing for days holds a bounded, recent trace set.
+_max_traces = DEFAULT_MAX_TRACES
+_trace_order: dict[int, None] = {}  # insertion-ordered live trace ids
+# recently dropped ids (bounded): a dropped trace whose in-flight spans
+# complete later must NOT be resurrected as the "newest" trace — that
+# would group a tail-only partial trace and double-count the drop
+_dropped_ids: dict[int, None] = {}
+_trace_lock = threading.Lock()
+_traces_dropped = 0
 
 
 def enable(buffer_size: int = DEFAULT_BUFFER,
-           device_annotations: bool = False) -> None:
+           device_annotations: bool = False,
+           device: bool | None = None,
+           max_traces: int | None = None) -> None:
     """Turn the tracer on. Idempotent; a changed ``buffer_size`` rebuilds
-    the ring buffer (keeping the newest records that fit)."""
-    global _enabled, _device_annotations, _buffer
+    the ring buffer (keeping the newest records that fit).
+
+    ``device=True`` additionally enables the device-attribution pillar
+    (:mod:`mmlspark_tpu.obs.device`: compile-time histograms,
+    ``plan.segment.*`` cost/memory gauges, live memory polling) and
+    implies ``device_annotations``; ``device=False`` switches it off.
+    Omitted kwargs restore their DEFAULTS, not the previous call's
+    values — and the default for ``device`` is the environment baseline
+    (``MMLSPARK_TPU_OBS_DEVICE``), so a library's plain ``enable()``
+    (e.g. ``tools/serve.py --obs``) never silently defeats the
+    documented no-code-changes env path. ``max_traces`` re-bounds the
+    live request-trace retention (drop-oldest); omitting it restores
+    the default bound, same as ``buffer_size`` restores the default
+    ring."""
+    global _enabled, _device_annotations, _buffer, _max_traces
+    dev = (bool(config.get("obs_device", False)) if device is None
+           else bool(device))
     with _lock:
         if _buffer.maxlen != buffer_size:
             _buffer = deque(_buffer, maxlen=int(buffer_size))
-        _device_annotations = bool(device_annotations)
+        _device_annotations = bool(device_annotations) or dev
+        _max_traces = (DEFAULT_MAX_TRACES if max_traces is None
+                       else max(int(max_traces), 1))
         _enabled = True
+    from mmlspark_tpu.obs import device as _device_mod
+    if dev:
+        _device_mod.enable()
+    else:
+        _device_mod.disable()
 
 
 def disable() -> None:
-    """Turn the tracer off (records already captured stay readable)."""
+    """Turn the tracer off (records already captured stay readable).
+    The device-attribution pillar rides the tracer: it is switched off
+    here too (re-enable with ``enable(device=True)``)."""
     global _enabled
     with _lock:
         _enabled = False
+    from mmlspark_tpu.obs import device as _device_mod
+    _device_mod.disable()
 
 
 def enabled() -> bool:
@@ -65,16 +122,123 @@ def enabled() -> bool:
 
 
 def clear() -> None:
-    """Drop captured spans/events (metrics live in obs.metrics; clear
-    those via ``obs.registry().reset()``)."""
+    """Drop captured spans/events, the live-trace retention set, and
+    the dropped-trace tally (metrics live in obs.metrics; clear those
+    via ``obs.registry().reset()``)."""
+    global _traces_dropped
     with _lock:
         _buffer.clear()
+    with _trace_lock:
+        _trace_order.clear()
+        _dropped_ids.clear()
+        _traces_dropped = 0
 
 
 def record(item: SpanRecord | EventRecord) -> None:
-    """Append one finished record (deque.append is atomic under the GIL;
-    the ring bound makes the buffer safe to leave enabled forever)."""
-    _buffer.append(item)
+    """Append one finished record. The append takes ``_lock`` so it
+    serializes against the trace-eviction ring rebuild in
+    :func:`note_traces` — a lock-free append could land on the ring
+    object being swapped out and silently vanish. (Uncontended acquire
+    is ~100 ns on a path that already allocates a record; the disabled
+    path never reaches here.) Records carrying request trace ids also
+    register in the live-trace set, which enforces the drop-oldest
+    retention bound."""
+    global _append_seq
+    with _lock:
+        _buffer.append(item)
+        _append_seq += 1
+    trace = getattr(item, "trace", None)
+    links = getattr(item, "links", None)
+    if trace is not None or links:
+        note_traces(trace, links)
+
+
+def note_traces(trace: int | None, links: tuple | None) -> None:
+    """Register a record's trace ids as live; evict the oldest traces
+    (batched — each eviction rebuilds the ring once) past the bound."""
+    global _traces_dropped, _buffer
+    with _trace_lock:
+        if trace is not None and trace not in _dropped_ids:
+            _trace_order.setdefault(trace, None)
+        for t in links or ():
+            if t not in _dropped_ids:
+                _trace_order.setdefault(t, None)
+        excess = len(_trace_order) - _max_traces
+        if excess <= 0:
+            return
+        # drop in batches of at least max_traces/8 so the O(ring) span
+        # eviction amortizes over many new traces, not one rebuild each
+        n_drop = max(excess, _max_traces // 8, 1)
+        it = iter(_trace_order)
+        dropped = {next(it) for _ in range(min(n_drop,
+                                               len(_trace_order)))}
+        for t in dropped:
+            del _trace_order[t]
+            _dropped_ids[t] = None
+        _traces_dropped += len(dropped)
+        # the resurrection guard is itself bounded: only an id dropped
+        # while its request was STILL IN FLIGHT can come back, so
+        # remembering the most recent max(max_traces, 1024) drops is
+        # plenty (the floor keeps the guard meaningful under a tiny
+        # test-sized max_traces; the cost is a few thousand ints)
+        cap = max(_max_traces, 1024)
+        while len(_dropped_ids) > cap:
+            del _dropped_ids[next(iter(_dropped_ids))]
+        # filter against the ACCUMULATED dropped memo, not just this
+        # round's batch: a round that loses the evict race below skips
+        # its rebuild, and only the memo lets a later round reclaim
+        # those spans too
+        dropped_all = set(_dropped_ids)
+
+    def keep(r) -> bool:
+        tr = getattr(r, "trace", None)
+        ln = getattr(r, "links", None)
+        if tr is None and not ln:
+            return True  # non-request records are never trace-evicted
+        if tr is not None and tr not in dropped_all:
+            return True
+        return any(t not in dropped_all for t in ln or ())
+
+    # physically evict the dropped traces' spans — but run the O(ring)
+    # Python filter OUTSIDE the record lock: under sustained serve
+    # traffic this fires every max_traces/8 new traces, and holding
+    # _lock for a 65536-record pass would stall every lane's span
+    # completion for milliseconds. The locked sections are two C-level
+    # list() copies plus the (small) tail that arrived mid-filter; a
+    # concurrent evictor skips — readers already filter by the live
+    # set, so deferred spans are invisible until the next round.
+    if _evict_lock.acquire(blocking=False):
+        try:
+            with _lock:
+                snapshot = list(_buffer)
+                seq0 = _append_seq
+            kept = [r for r in snapshot if keep(r)]
+            with _lock:
+                n_new = min(_append_seq - seq0, len(_buffer))
+                tail = list(_buffer)[len(_buffer) - n_new:]
+                _buffer = deque(
+                    kept + [r for r in tail if keep(r)],
+                    maxlen=_buffer.maxlen)
+        finally:
+            _evict_lock.release()
+    from mmlspark_tpu.obs.metrics import registry as _reg
+    _reg().counter("obs.traces_dropped").add(len(dropped))
+
+
+def live_traces() -> set:
+    """The trace ids currently retained for grouping (newest
+    ``max_traces`` distinct ids seen by the ring)."""
+    with _trace_lock:
+        return set(_trace_order)
+
+
+def dropped_trace_count() -> int:
+    """Total traces evicted by the retention policy since the last
+    :func:`clear`. Mirrors the ``obs.traces_dropped`` registry counter
+    when tracer and registry are reset together (``obs.clear()`` +
+    ``obs.registry().reset()``, as the test fixtures do); the two
+    diverge if only one side is reset."""
+    return _traces_dropped
 
 
 def spans() -> list:
@@ -148,6 +312,10 @@ def compiled_programs(cache_host: Any) -> int | None:
 
 
 # honor MMLSPARK_TPU_OBS=1 (or config.set("obs", True) before first
-# import) — the env-var path for tracing a production run without code
-if config.get("obs", False):  # pragma: no cover - env-dependent
-    enable()
+# import) — the env-var path for tracing a production run without code.
+# MMLSPARK_TPU_OBS_DEVICE=1 additionally turns on the device-attribution
+# pillar (+ jax.profiler annotations); it implies the tracer. Explicit
+# obs.enable(...) kwargs later override both (the env is read ONCE here)
+if config.get("obs", False) \
+        or config.get("obs_device", False):  # pragma: no cover - env
+    enable(device=bool(config.get("obs_device", False)))

@@ -56,6 +56,7 @@ import numpy as np
 from mmlspark_tpu.core.logging_utils import get_logger
 from mmlspark_tpu.data.table import DataTable
 from mmlspark_tpu.obs import context as _obs_ctx
+from mmlspark_tpu.obs import flight as _obs_flight
 from mmlspark_tpu.obs import runtime as _obs_rt
 from mmlspark_tpu.obs.spans import event as _obs_event
 from mmlspark_tpu.obs.spans import span as _obs_span
@@ -259,7 +260,7 @@ class _Lane:
 
     __slots__ = ("batcher", "index", "cache_host", "mesh", "shard_params",
                  "replica", "_cv", "_queue", "_window", "_closing",
-                 "_thread", "load")
+                 "_thread", "load", "_hb")
 
     def __init__(self, batcher: "DynamicBatcher", index: int,
                  cache_host: Any, mesh: Any = None,
@@ -276,6 +277,10 @@ class _Lane:
         self._closing = False
         self.load = 0  # queued + in-flight batches; guarded by the
         #                batcher's scheduler condition, not this lane's
+        # flight-recorder heartbeat: busy while work is assigned, idle
+        # (disarmed) while parked on the condition — an idle lane is
+        # never a hang, a lane stuck inside a dispatch or drain is
+        self._hb = f"serve/{batcher.name}#{index}"
         self._thread = threading.Thread(
             target=self._run,
             name=f"{THREAD_PREFIX}[{batcher.name}]#{index}", daemon=True)
@@ -325,15 +330,21 @@ class _Lane:
             with self._cv:
                 while (not self._queue and not self._window
                        and not self._closing):
+                    if _obs_flight._rec is not None:
+                        _obs_flight._rec.disarm(self._hb)
                     self._cv.wait()
                 item = self._queue.popleft() if self._queue else None
                 closing = self._closing
+            if _obs_flight._rec is not None:
+                _obs_flight._rec.beat(self._hb)
             if item is None:
                 if self._window:
                     # idle: finish outstanding batches promptly
                     self._drain_one()
                     continue
                 if closing:
+                    if _obs_flight._rec is not None:
+                        _obs_flight._rec.disarm(self._hb)
                     return
                 continue
             self._dispatch(*item)
@@ -381,6 +392,8 @@ class _Lane:
 
     def _drain_one(self) -> None:
         pending, batch, rows, bucket, t0 = self._window.popleft()
+        if _obs_flight._rec is not None:
+            _obs_flight._rec.beat(self._hb)
         labels = self._labels()
         try:
             with _obs_span("serve/drain", "serve",
@@ -595,6 +608,11 @@ class DynamicBatcher:
                 if lane.load < self.config.max_inflight:
                     lane.load += 1
                     return lane
+                # waiting for a lane slot is the LANES' business, not a
+                # scheduler hang: keep its flight heartbeat fresh (a
+                # stuck lane raises its own)
+                if _obs_flight._rec is not None:
+                    _obs_flight._rec.beat(f"serve/{self.name}/scheduler")
                 self._sched_cv.wait(timeout=0.1)
         return None
 
@@ -604,9 +622,17 @@ class DynamicBatcher:
         ``DeviceLoader.drain_barrier`` (PR 3): multi-host lockstep calls
         this before the cross-process signature exchange so no process
         interleaves the exchange with in-flight device work."""
+        # beat the scheduler's flight heartbeat only when running ON the
+        # scheduler thread (the in-repo lockstep path): its work-unit
+        # bracket disarms afterwards. A foreign caller beating it would
+        # mark the scheduler busy with nothing to ever disarm it — an
+        # idle server would ripen into a spurious watchdog "hang" dump
+        on_sched = threading.current_thread() is self._thread
         with self._sched_cv:
             while (not self._abort
                    and any(lane.load for lane in self._lanes)):
+                if on_sched and _obs_flight._rec is not None:
+                    _obs_flight._rec.beat(f"serve/{self.name}/scheduler")
                 self._sched_cv.wait(timeout=poll_s)
 
     def _dispatch(self, batch: list, rows: int) -> None:
@@ -646,6 +672,7 @@ class DynamicBatcher:
         lane.assign(packed, batch, rows, bucket)
 
     def _run(self) -> None:
+        hb = f"serve/{self.name}/scheduler"
         while not self._abort:
             batch, expired, rows = self._collect(time.monotonic())
             for r in expired:
@@ -654,12 +681,20 @@ class DynamicBatcher:
                                             "queued")):
                     self.stats.record_expired()
             if batch:
+                # flight heartbeat: busy only while work is in hand — a
+                # scheduler wedged in pack/lane-acquire is a hang, an
+                # empty queue is not
+                if _obs_flight._rec is not None:
+                    _obs_flight._rec.beat(hb)
                 try:
                     self._dispatch(batch, rows)
                 except BaseException as e:  # noqa: BLE001 — per-request
                     for r in batch:
                         if r._fail(e):
                             self.stats.record_failed()
+                finally:
+                    if _obs_flight._rec is not None:
+                        _obs_flight._rec.disarm(hb)
                 continue
             with self._cv:
                 if self._queue:
@@ -737,6 +772,13 @@ class DynamicBatcher:
         if stuck:  # pragma: no cover - defensive
             _log.warning("ServeBatcher[%s] did not stop within %.1fs",
                          self.name, self.config.drain_timeout_s)
+        if _obs_flight._rec is not None and not stuck:
+            # these seams are gone for good: drop their heartbeats so a
+            # long-lived process with model churn doesn't accumulate
+            # dead idle rows in every dump's heartbeat table
+            _obs_flight._rec.forget(f"serve/{self.name}/scheduler")
+            for lane in self._lanes:
+                _obs_flight._rec.forget(lane._hb)
 
     def compiled_programs(self) -> int | None:
         """XLA executables compiled for this model's serving entry — the

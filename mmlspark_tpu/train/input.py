@@ -43,6 +43,7 @@ import time
 from typing import Any, Callable, Iterable, Iterator
 
 from mmlspark_tpu.core.logging_utils import get_logger
+from mmlspark_tpu.obs import flight as _obs_flight
 from mmlspark_tpu.obs import runtime as _obs_rt
 from mmlspark_tpu.obs.metrics import registry as _obs_registry
 from mmlspark_tpu.obs.spans import span as _annotate
@@ -117,6 +118,14 @@ class DeviceLoader:
     # ---- producer (worker thread) ----
 
     def _run(self) -> None:
+        # flight-recorder heartbeat: armed for the worker's lifetime —
+        # a producer stuck in assembly (a stalled stream source) or in
+        # the device commit is a hang; waiting on a full queue is not
+        # (self._put beats while it polls)
+        hb = f"loader/{self.name}"
+        rec = _obs_flight._rec
+        if rec is not None:
+            rec.arm(hb)
         try:
             while not self._stop.is_set():
                 t0 = time.perf_counter()
@@ -131,11 +140,16 @@ class DeviceLoader:
                     out = self._commit(item)
                 self.commit_s += time.perf_counter() - t0
                 self.committed += 1
+                if _obs_flight._rec is not None:
+                    _obs_flight._rec.beat(hb)
                 if not self._put((_ITEM, out)):
                     return  # closed while blocked on a full queue
             self._put((_DONE, None))
         except BaseException as e:  # noqa: BLE001 — relayed to consumer
             self._put((_ERROR, e))
+        finally:
+            if _obs_flight._rec is not None:
+                _obs_flight._rec.disarm(hb)
 
     def _put(self, msg: tuple) -> bool:
         """Bounded put that aborts when the loader is closed — a consumer
@@ -145,6 +159,10 @@ class DeviceLoader:
                 self._q.put(msg, timeout=0.05)
                 return True
             except queue.Full:
+                # waiting on the consumer is not a producer hang: keep
+                # the flight heartbeat fresh while the queue is full
+                if _obs_flight._rec is not None:
+                    _obs_flight._rec.beat(f"loader/{self.name}")
                 continue
         return False
 

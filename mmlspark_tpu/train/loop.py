@@ -24,7 +24,9 @@ from typing import Any, Callable, Iterator
 import numpy as np
 
 from mmlspark_tpu.core.logging_utils import get_logger, timed
+from mmlspark_tpu.obs import flight as _obs_flight
 from mmlspark_tpu.obs import runtime as _obs_rt
+from mmlspark_tpu.obs.anomaly import NonFiniteSentinel, StragglerDetector
 from mmlspark_tpu.obs.metrics import registry as _obs_registry
 from mmlspark_tpu.obs.spans import span as _obs_span
 from mmlspark_tpu.parallel import mesh as mesh_lib
@@ -101,6 +103,13 @@ class TrainConfig:
     # pad shorter shards with zero-weight rows (exact training — padded
     # rows contribute nothing); True restores the loud error instead
     strict_shards: bool = False
+    # non-finite loss sentinel (obs/anomaly.py), checked on the SAME
+    # one-step-lagged loss fetches the history already pays for (no new
+    # host sync): "raise" (default) dies AT the divergence with a typed
+    # NonFiniteLossError — and a flight-recorder dump when that is
+    # enabled — "event" records train/nonfinite + a counter and
+    # continues, "off" disables the check entirely
+    nonfinite_loss: str = "raise"
     # mid-training checkpoint/resume (beyond-reference capability; SURVEY §5)
     checkpoint_dir: str | None = None
     checkpoint_every: int = 0        # global steps between saves; 0 = end only
@@ -700,11 +709,17 @@ class Trainer:
 
         # one-step-lagged loss fetch: resolving the PREVIOUS log point's
         # device scalar never stalls the in-flight prefetch window (the
-        # inline float() was a host sync mid-pipeline every log_every steps)
-        pending = None
+        # inline float() was a host sync mid-pipeline every log_every
+        # steps). The non-finite sentinel rides these exact fetches
+        pending = None  # (global step, device loss scalar)
+        sentinel = NonFiniteSentinel("fit_arrays", cfg.nonfinite_loss)
         loader = DeviceLoader(host_batches(), commit_batch,
                               depth=cfg.prefetch_depth, name="fit_arrays")
         slow_steps = _slow_step_detector("fit_arrays")
+        hb = "train/fit_arrays"  # flight-recorder heartbeat: a step loop
+        #                          that stops stepping is a hang
+        if _obs_flight._rec is not None:
+            _obs_flight._rec.arm(hb)
         t_loop = time.perf_counter()
         try:
             with timed(f"Trainer[{type(self.module).__name__}]", _log,
@@ -718,6 +733,8 @@ class Trainer:
                     with _obs_span("train/step", "train"):
                         self.state, metrics = self.step_masked(
                             self.state, dx, dy, dw)
+                    if _obs_flight._rec is not None:
+                        _obs_flight._rec.beat(hb)
                     if _obs_rt._enabled:
                         _obs_registry().counter("train.steps").add()
                         if t_step is not None:
@@ -725,15 +742,25 @@ class Trainer:
                                 (time.perf_counter() - t_step) * 1e3)
                     if i % cfg.log_every == 0:
                         if pending is not None:
-                            self.history.append(float(pending))  # lint-jax: allow(JX105) — one-step-lagged fetch
-                        pending = metrics["loss"]
+                            self.history.append(sentinel.check(
+                                pending[0], float(pending[1])))  # lint-jax: allow(JX105) — one-step-lagged fetch
+                        pending = (gs, metrics["loss"])
                     if (ckpt is not None and cfg.checkpoint_every > 0
                             and gs % cfg.checkpoint_every == 0):
                         self.save_checkpoint()
+            if pending is not None:
+                self.history.append(sentinel.check(pending[0],
+                                                   float(pending[1])))
+                pending = None
+        except BaseException as e:
+            # the post-mortem happens AT the failure point, before any
+            # caller can swallow the exception (obs/flight.py)
+            _obs_flight.on_crash(e, context="Trainer.fit_arrays")
+            raise
         finally:
             loader.close()
-        if pending is not None:
-            self.history.append(float(pending))
+            if _obs_flight._rec is not None:
+                _obs_flight._rec.disarm(hb)
         self.input_stats = input_stats(loader, time.perf_counter() - t_loop)
         if ckpt is not None and total_steps > resumed:
             self.save_checkpoint()
@@ -870,14 +897,25 @@ class Trainer:
                         # of serializing every step — advisor round 3),
                         # and let short processes pad with zero-weight
                         # filler up to the block's max count. Step counts
-                        # are exact: the longest stream sets the walk
+                        # are exact: the longest stream sets the walk.
+                        # The liveness payload carries (count, mean step
+                        # ms): the straggler exchange RIDES the same
+                        # fenced collective — no new exchange site, and
+                        # the schedule is identical on every process
+                        # whether or not its tracer is enabled
                         block = list(_itertools.islice(it, sync_n))
                         fence()
                         from jax.experimental import multihost_utils
-                        counts = np.asarray(
+                        payload = np.asarray(
+                            [float(len(block)),
+                             straggler.local_mean_ms()], np.float64)
+                        gathered = np.asarray(
                             multihost_utils.process_allgather(
-                                np.asarray(len(block), np.int64)))
-                        block_steps = int(counts.max())
+                                payload)).reshape(-1, 2)
+                        block_steps = int(gathered[:, 0].max())
+                        if _obs_rt._enabled:
+                            straggler.ingest(gathered[:, 1],
+                                             jax.process_index())
                         if block_steps == 0:
                             break
                         block += [None] * (block_steps - len(block))
@@ -904,11 +942,18 @@ class Trainer:
             gs, (bx, by, bw) = item
             return gs, (commit(bx), commit(by), commit(bw))
 
-        pending = None  # one-step-lagged loss fetch (see fit_arrays)
+        pending = None  # (step, loss) one-step-lagged fetch (fit_arrays)
+        sentinel = NonFiniteSentinel("fit_stream", cfg.nonfinite_loss)
+        # created BEFORE the loader: its worker starts pulling
+        # host_batches immediately, and that closure reads `straggler`
+        straggler = StragglerDetector("fit_stream")
         loader = DeviceLoader(host_batches(), commit_batch,
                               depth=cfg.prefetch_depth, name="fit_stream")
         box["loader"] = loader
         slow_steps = _slow_step_detector("fit_stream")
+        hb = "train/fit_stream"
+        if _obs_flight._rec is not None:
+            _obs_flight._rec.arm(hb)
         t_loop = time.perf_counter()
         try:
             with timed(f"Trainer[{type(self.module).__name__}:stream]",
@@ -919,15 +964,19 @@ class Trainer:
                     with _obs_span("train/step", "train"):
                         self.state, metrics = self.step_masked(
                             self.state, dx, dy, dw)
+                    if _obs_flight._rec is not None:
+                        _obs_flight._rec.beat(hb)
                     if _obs_rt._enabled:
                         _obs_registry().counter("train.steps").add()
                         if t_step is not None:
-                            slow_steps().observe(
-                                (time.perf_counter() - t_step) * 1e3)
+                            dur_ms = (time.perf_counter() - t_step) * 1e3
+                            slow_steps().observe(dur_ms)
+                            straggler.observe(dur_ms)
                     if (gs - 1) % cfg.log_every == 0:
                         if pending is not None:
-                            self.history.append(float(pending))  # lint-jax: allow(JX105) — one-step-lagged fetch
-                        pending = metrics["loss"]
+                            self.history.append(sentinel.check(
+                                pending[0], float(pending[1])))  # lint-jax: allow(JX105) — one-step-lagged fetch
+                        pending = (gs, metrics["loss"])
                     if (ckpt is not None and cfg.checkpoint_every > 0
                             and gs % cfg.checkpoint_every == 0):
                         self.save_checkpoint()
@@ -938,10 +987,17 @@ class Trainer:
                     # dispatch would let the liveness allgather race the
                     # checkpoint barrier across processes
                     loader.note_dispatched()
+            if pending is not None:
+                self.history.append(sentinel.check(pending[0],
+                                                   float(pending[1])))
+                pending = None
+        except BaseException as e:
+            _obs_flight.on_crash(e, context="Trainer.fit_stream")
+            raise
         finally:
             loader.close()
-        if pending is not None:
-            self.history.append(float(pending))
+            if _obs_flight._rec is not None:
+                _obs_flight._rec.disarm(hb)
         self.input_stats = input_stats(loader, time.perf_counter() - t_loop)
         if prog["steps"] == 0:
             raise ValueError(

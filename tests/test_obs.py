@@ -568,6 +568,132 @@ def test_single_touch_trace_emits_no_flow():
     assert flows == []  # an arrow needs two ends
 
 
+# ---- trace retention (the request_traces eviction policy) ----
+
+
+def test_sustained_trace_burst_cannot_grow_memory_unboundedly():
+    """Regression (PR 9 satellite): completed traces used to be retained
+    for grouping until someone called clear() — a server left tracing
+    under sustained traffic grew request_traces() without bound. The
+    retention policy drops the OLDEST traces past ``max_traces``,
+    evicts their spans from the ring, and counts the drops."""
+    from mmlspark_tpu.obs import runtime as rt
+    obs.enable(max_traces=64)
+    n_burst = 2048
+    for _ in range(n_burst):
+        _journey(obs.mint())
+    live = rt.live_traces()
+    assert len(live) <= 64, (
+        f"{len(live)} live traces retained against a bound of 64")
+    traces = obs.request_traces()
+    assert len(traces) <= 64
+    # the newest traces survive, the oldest are gone (drop-OLDEST)
+    assert max(traces) == max(live)
+    assert min(traces) > n_burst - 128
+    # the dropped traces' spans actually left the ring (memory, not
+    # just the grouping view)
+    for r in rt.spans():
+        tr = getattr(r, "trace", None)
+        links = getattr(r, "links", None) or ()
+        if tr is not None or links:
+            assert (tr in live) or any(t in live for t in links)
+    dropped = obs.registry().value("obs.traces_dropped")
+    assert dropped is not None and dropped >= n_burst - 64
+    assert rt.dropped_trace_count() == dropped
+
+
+def test_trace_eviction_spares_non_request_records():
+    from mmlspark_tpu.obs import runtime as rt
+    obs.enable(max_traces=8)
+    with obs.span("train/step", "train"):  # no trace id: never evicted
+        pass
+    for _ in range(64):
+        _journey(obs.mint())
+    names = [getattr(r, "name", "") for r in rt.spans()]
+    assert "train/step" in names, (
+        "trace eviction evicted a span that carries no trace id")
+
+
+def test_evicted_trace_is_not_resurrected_by_late_spans():
+    """Regression: a trace dropped while its request was still in
+    flight was re-registered as the NEWEST trace when its tail span
+    completed — request_traces() then reported a broken journey for a
+    partial, tail-only trace (and a second eviction double-counted the
+    drop)."""
+    from mmlspark_tpu.obs import context, runtime as rt
+    obs.enable(max_traces=8)
+    victim = obs.mint()
+    with context.bind(victim):
+        with obs.span("serve/admit", "serve"):
+            pass
+    # push the victim out of retention while it is "in flight"
+    for _ in range(64):
+        _journey(obs.mint())
+    assert victim not in rt.live_traces()
+    dropped_before = rt.dropped_trace_count()
+    # the late tail span completes AFTER eviction
+    with context.bind(victim):
+        with obs.span("serve/complete", "serve"):
+            pass
+    assert victim not in rt.live_traces(), "dropped trace resurrected"
+    assert victim not in obs.request_traces(), (
+        "tail-only partial trace grouped after eviction")
+    # and the drop is never double-counted by later evictions
+    for _ in range(64):
+        _journey(obs.mint())
+    drops = rt.dropped_trace_count() - dropped_before
+    assert drops == 64, f"{drops} drops for 64 new traces"
+
+
+def test_enable_without_max_traces_restores_default_bound():
+    """Regression: ``enable(max_traces=4)`` used to leave the tiny bound
+    sticky for every later ``enable()`` in the process — a 200-request
+    burst after a re-bounded enable retained 4 traces. Omitting the
+    kwarg restores the default, same as ``buffer_size`` does."""
+    from mmlspark_tpu.obs import device as obs_device
+    from mmlspark_tpu.obs import runtime as rt
+    obs.enable(max_traces=4, device=True)
+    assert rt._max_traces == 4 and obs_device.enabled()
+    obs.enable()
+    assert rt._max_traces == rt.DEFAULT_MAX_TRACES
+    # the device pillar follows the same rule: omitted → back to the
+    # env baseline (off here)
+    assert not obs_device.enabled()
+    for _ in range(32):
+        _journey(obs.mint())
+    assert len(obs.request_traces()) == 32
+    # with MMLSPARK_TPU_OBS_DEVICE=1 the baseline is ON: a library's
+    # plain enable() must not defeat the no-code-changes env path
+    from mmlspark_tpu.core import config
+    config.set("obs_device", True)
+    try:
+        obs.enable()
+        assert obs_device.enabled(), (
+            "plain enable() defeated the env device baseline")
+        obs.enable(device=False)  # explicit off still wins
+        assert not obs_device.enabled()
+    finally:
+        config.set("obs_device", False)
+
+
+def test_request_traces_explicit_records_bypass_retention():
+    """A caller-supplied record list is the caller's retention problem —
+    the filter applies only to the runtime ring's view."""
+    obs.enable(max_traces=4)
+    ids = []
+    for _ in range(16):
+        t = obs.mint()
+        ids.append(t)
+        _journey(t)
+    kept = obs.captured()
+    # grouping the ring honors the bound…
+    assert len(obs.request_traces()) <= 4
+    # …but an explicit list groups everything it holds
+    explicit = obs.request_traces(kept)
+    assert set(explicit) <= set(ids)
+    assert len(explicit) >= len(obs.request_traces())
+
+
 # ---- SLO engine (obs/slo.py) ----
 
 

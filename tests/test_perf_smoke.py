@@ -13,9 +13,9 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
 
 from perf_smoke import (  # noqa: E402
-    check_fused_crossings, check_obs_overhead, check_obs_request_tracing,
-    check_serve_batching, check_serve_sharded, check_spmd_clean,
-    check_train_prefetch,
+    check_fused_crossings, check_flight_recorder, check_obs_overhead,
+    check_obs_request_tracing, check_serve_batching, check_serve_sharded,
+    check_spmd_clean, check_train_prefetch,
 )
 
 
@@ -51,6 +51,21 @@ def test_obs_request_tracing_links_intact_across_replica_lanes():
     assert result["replicas_used"] == [0, 1, 2, 3]
     assert result["max_pack_fan_in"] > 1
     assert result["flow_ids_exported"] == result["requests"]
+
+
+def test_flight_recorder_dumps_on_crash_and_hang():
+    """Forensics contract: an induced NaN-loss crash inside
+    Trainer.fit_arrays and a serve-lane dispatch stalled past the hang
+    threshold each produce a well-formed flight-recorder dump (intact
+    ring, per-thread stacks, registry snapshot) that
+    tools/trace.py postmortem renders; the hang dump names the lane."""
+    result = check_flight_recorder()
+    assert result["crash_exception"] == "NonFiniteLossError"
+    assert result["crash_ring_records"] > 0
+    assert result["crash_threads"] >= 1
+    assert result["hang_heartbeat"].startswith("serve/")
+    assert result["hang_stalled_for_s"] >= 0.3
+    assert result["hang_threads"] >= 2
 
 
 def test_spmd_verifier_and_lint_are_clean():

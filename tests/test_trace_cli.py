@@ -128,3 +128,41 @@ class TestRender:
         err = capsys.readouterr().err
         assert rc == 2
         assert err.startswith("trace:")
+
+
+class TestPostmortem:
+    def test_postmortem_missing_and_malformed_are_typed_exit_2(
+            self, tmp_path, capsys):
+        rc = trace_cli.main(["postmortem", str(tmp_path / "nope.json")])
+        assert rc == 2
+        assert "cannot read" in capsys.readouterr().err
+        f = tmp_path / "doc.json"
+        f.write_text('{"spans": []}', encoding="utf-8")
+        rc = trace_cli.main(["postmortem", str(f)])
+        assert rc == 2
+        assert "flight-recorder dump" in capsys.readouterr().err
+
+    def test_postmortem_tolerates_mangled_dump_content(self, tmp_path,
+                                                       capsys):
+        """A truncated or hand-edited dump that still passes the shape
+        validation must render what it can — never a raw traceback
+        (the render discipline the subcommand documents)."""
+        f = tmp_path / "mangled.json"
+        f.write_text(json.dumps({
+            "flight": 1,
+            "reason": "hang",
+            "ring": [
+                {"name": "train/step", "start_ns": "not-a-number",
+                 "dur_ns": None, "thread_name": "MainThread"},
+                {"name": "plan/h2d", "ts_ns": 5, "dur_ns": "9"},
+            ],
+            "threads": {"123": "not-a-dict"},
+            "metric_deltas": {"train.steps": "NaNish", "obs.x": 3},
+            "heartbeats": {"serve/m#0": 1, "train/fit": {"busy": True}},
+        }), encoding="utf-8")
+        rc = trace_cli.main(["postmortem", str(f)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "train/step" in out
+        assert "train.steps" in out
+        assert "serve/m#0" in out

@@ -41,6 +41,13 @@ flake on a loaded CI box):
   was coalesced into, every flow exports as Perfetto flow events, and
   all four replica lanes participate (the latency-bound model makes the
   fan-out deterministic, as in the sharded gate).
+* **flight recorder** — an induced mid-run crash (a NaN'd batch dying
+  on the typed ``NonFiniteLossError``) and an induced hang (a serve-lane
+  dispatch held inside its compiled program past the recorder's
+  threshold) must each leave a well-formed post-mortem dump — intact
+  span/event ring, per-thread stacks, registry snapshot, heartbeat
+  table — that ``tools/trace.py postmortem`` renders, with the hang
+  dump naming the stalled serve lane.
 * **spmd clean** — the symbolic SPMD verifier
   (mmlspark_tpu/analysis/spmd.py, docs/spmd_analysis.md) over every
   declared parallel entry point (sharding contracts, partial-sum
@@ -539,6 +546,163 @@ def check_obs_request_tracing(n_req: int = 200, dp: int = 4) -> dict:
     }
 
 
+def _well_formed_dump(path: str) -> dict:
+    """Load one flight-recorder dump and assert the post-mortem contract:
+    intact ring, per-thread stacks, registry snapshot, heartbeat table,
+    mesh/config fingerprint — and that ``tools/trace.py postmortem``
+    renders it (exit 0)."""
+    with open(path, "r", encoding="utf-8") as fh:
+        dump = json.load(fh)
+    for key in ("flight", "reason", "ring", "threads", "registry",
+                "heartbeats", "fingerprint"):
+        assert key in dump, f"dump {path} is missing {key!r}"
+    assert isinstance(dump["ring"], list) and dump["ring"], (
+        f"dump {path} captured an empty span/event ring")
+    assert all(isinstance(r, dict) and "name" in r
+               for r in dump["ring"]), "malformed ring records"
+    assert dump["threads"], f"dump {path} captured no thread stacks"
+    assert all(isinstance(t, dict) and t.get("stack")
+               for t in dump["threads"].values()), (
+        "a dumped thread has an empty stack")
+    assert "counters" in dump["registry"], "registry snapshot malformed"
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "mmlspark_tools_trace",  # plain `import trace` would shadow the
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "trace.py"))  # stdlib module of the same name
+    trace_cli = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(trace_cli)
+    code = trace_cli.main(["postmortem", path])
+    assert code == 0, (
+        f"tools/trace.py postmortem exited {code} on {path}")
+    return dump
+
+
+def check_flight_recorder() -> dict:
+    """Induce a mid-run crash AND a hang on the dryrun mesh; raise
+    AssertionError unless each produces a well-formed flight-recorder
+    dump (recent ring + per-thread stacks + registry snapshot) that
+    ``tools/trace.py postmortem`` renders.
+
+    The crash is a NaN'd training batch dying on the typed
+    :class:`NonFiniteLossError` (the anomaly plane's sentinel riding the
+    lagged loss fetch) — the flight recorder dumps at the failure point,
+    inside ``Trainer.fit_arrays``. The hang is a serve-lane dispatch
+    stalled inside its compiled program (the callback-hold model of
+    :func:`check_serve_sharded`, held past the recorder's hang
+    threshold) — the lane heartbeat goes stale while busy and the
+    watchdog dumps, naming the lane, before the dispatch completes."""
+    import glob
+    import tempfile
+    import time
+
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.data.table import DataTable
+    from mmlspark_tpu.models.jax_model import JaxModel
+    from mmlspark_tpu.models.zoo import ConvNetCifar
+    from mmlspark_tpu.obs import flight
+    from mmlspark_tpu.obs.anomaly import NonFiniteLossError
+    from mmlspark_tpu.serve import ModelServer, ServeConfig
+    from mmlspark_tpu.train.loop import TrainConfig, Trainer
+
+    out: dict = {}
+    try:
+        # ---- induced crash: NaN batch → typed raise → dump ----
+        crash_dir = tempfile.mkdtemp(prefix="flight_crash_")
+        flight.enable(crash_dir, poll_s=0.05)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(64, 32, 32, 3)).astype(np.float32)
+        x[5] = np.nan  # lands in step 1's batch
+        y = rng.integers(0, 10, 64).astype(np.int64)
+        tr = Trainer(ConvNetCifar(num_classes=10, widths=(4,),
+                                  dense_width=8),
+                     TrainConfig(batch_size=16, epochs=1, optimizer="sgd",
+                                 learning_rate=0.1, log_every=1,
+                                 prefetch_depth=0, input_scale=1.0))
+        crashed = None
+        try:
+            tr.fit_arrays(x, y)
+        except NonFiniteLossError as e:
+            crashed = e
+        assert crashed is not None, (
+            "the NaN'd batch did not raise NonFiniteLossError — the "
+            "non-finite sentinel regressed")
+        crash_dumps = sorted(glob.glob(
+            os.path.join(crash_dir, "flight_crash_*.json")))
+        assert crash_dumps, (
+            "NonFiniteLossError raised but no flight_crash_*.json dump "
+            "appeared — Trainer.fit_arrays is not calling "
+            "obs.flight.on_crash at the failure point")
+        crash = _well_formed_dump(crash_dumps[-1])
+        assert crash["exception"]["type"] == "NonFiniteLossError", (
+            f"crash dump recorded {crash['exception']['type']}, expected "
+            "the sentinel's NonFiniteLossError")
+        assert any(r.get("name") == "train/step" for r in crash["ring"]), (
+            "crash dump ring holds no train/step spans — the recorder "
+            "is not dumping the live tracer ring")
+        flight.disable()
+
+        # ---- induced hang: dispatch stalled past the threshold ----
+        hang_dir = tempfile.mkdtemp(prefix="flight_hang_")
+        hold_s, threshold_s = 1.2, 0.3
+        bundle, _probe = _latency_bundle(hold_s)
+        jm = JaxModel(model=bundle, input_col="x", output_col="scores")
+        server = ModelServer(ServeConfig(buckets=(1,), max_queue=8,
+                                         deadline_ms=None))
+        try:
+            server.add_model("m", jm, example=DataTable(
+                {"x": [np.zeros(24, np.float32)]}))
+            # enable AFTER the load+warm: only the stalled dispatch is
+            # under watch
+            flight.enable(hang_dir, hang_threshold_s=threshold_s,
+                          poll_s=0.05)
+            h = server.submit("m", DataTable(
+                {"x": [np.zeros(24, np.float32)]}))
+            deadline = time.monotonic() + 30.0
+            hang_dumps: list = []
+            while time.monotonic() < deadline and not hang_dumps:
+                hang_dumps = glob.glob(
+                    os.path.join(hang_dir, "flight_hang_*.json"))
+                time.sleep(0.05)
+            result = h.result(timeout=60)  # the stall completes after
+            assert len(result) == 1 and "scores" in result
+        finally:
+            server.close()
+        assert hang_dumps, (
+            f"no hang dump after a {hold_s}s dispatch stall against a "
+            f"{threshold_s}s threshold — the lane heartbeat or watchdog "
+            "regressed")
+        hang = _well_formed_dump(hang_dumps[0])
+        stalled = hang["extra"]["heartbeat"]
+        assert stalled.startswith("serve/"), (
+            f"hang dump blames heartbeat {stalled!r}, expected the "
+            "serve lane that was holding")
+        assert hang["extra"]["stalled_for_s"] >= threshold_s
+        lane_threads = [t["name"] for t in hang["threads"].values()]
+        assert any("ServeLane" in n or "lane" in n.lower()
+                   or "DynamicBatcher" in n for n in lane_threads) \
+            or len(lane_threads) >= 2, (
+            f"hang dump captured threads {lane_threads} — the stalled "
+            "lane's stack is missing")
+        out = {
+            "crash_dump": crash_dumps[-1],
+            "crash_exception": crash["exception"]["type"],
+            "crash_ring_records": len(crash["ring"]),
+            "crash_threads": len(crash["threads"]),
+            "hang_dump": hang_dumps[0],
+            "hang_heartbeat": stalled,
+            "hang_stalled_for_s": hang["extra"]["stalled_for_s"],
+            "hang_ring_records": len(hang["ring"]),
+            "hang_threads": len(hang["threads"]),
+        }
+    finally:
+        flight.disable()
+        obs.disable()
+        obs.clear()
+        obs.registry().reset()
+    return out
+
+
 def check_obs_overhead(max_fraction: float = 0.02) -> dict:
     """The obs seams' disabled-path cost on the fused-pipeline microbench
     must stay under ``max_fraction`` (2%) of the transform itself.
@@ -714,6 +878,7 @@ def main() -> int:
         serve_sharded = check_serve_sharded()
         obs_overhead = check_obs_overhead()
         obs_tracing = check_obs_request_tracing()
+        flight_rec = check_flight_recorder()
         spmd = check_spmd_clean()
     except AssertionError as e:
         print(json.dumps({"perf_smoke": "FAIL", "reason": str(e)}))
@@ -722,7 +887,8 @@ def main() -> int:
                       "train_prefetch": train, "serve": serve,
                       "serve_sharded": serve_sharded,
                       "obs_overhead": obs_overhead,
-                      "obs_request_tracing": obs_tracing, "spmd": spmd}))
+                      "obs_request_tracing": obs_tracing,
+                      "flight_recorder": flight_rec, "spmd": spmd}))
     return 0
 
 

@@ -24,6 +24,15 @@ Subcommands::
         :class:`TraceInputError` — one diagnostic line on stderr and
         exit code 2, never a traceback.
 
+    python tools/trace.py postmortem <dump.json> [--top N] [--frames N]
+        Render a flight-recorder dump (obs/flight.py,
+        ``MMLSPARK_TPU_FLIGHT=<dir>``): the crash/hang/signal header,
+        the tail of the span/event ring as a timeline, every thread's
+        stack (innermost ``--frames`` frames), the top registry deltas
+        of the final watchdog poll, and the heartbeat table naming the
+        stalled lane. Input errors follow the same
+        :class:`TraceInputError` / exit-2 discipline as ``render``.
+
 Open trace.json in https://ui.perfetto.dev (or chrome://tracing). For a
 device-interleaved view capture ``utils/profiling.trace`` simultaneously
 — spans recorded under ``--device-annotations`` also enter
@@ -71,6 +80,119 @@ def _load_trace(path: str) -> dict:
             'object with a "traceEvents" list (got '
             f"{type(payload).__name__})")
     return payload
+
+
+def _load_postmortem(path: str) -> dict:
+    """Read + validate a flight-recorder dump; raises
+    :class:`TraceInputError` naming what is wrong (no-such-file, bad
+    JSON, or JSON that is not an ``obs/flight.py`` dump)."""
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            payload = json.load(fh)
+    except OSError as e:
+        raise TraceInputError(f"cannot read dump file {path!r}: "
+                              f"{e.strerror or e}") from e
+    except ValueError as e:
+        raise TraceInputError(
+            f"{path!r} is not valid JSON ({e}) — expected a "
+            "flight-recorder dump (obs/flight.py)") from e
+    if not isinstance(payload, dict) or "flight" not in payload \
+            or not isinstance(payload.get("ring"), list) \
+            or not isinstance(payload.get("threads"), dict):
+        raise TraceInputError(
+            f"{path!r} is JSON but not a flight-recorder dump: expected "
+            'an object with "flight", "ring", and "threads" (got '
+            f"{type(payload).__name__})")
+    return payload
+
+
+def cmd_postmortem(args: argparse.Namespace) -> int:
+    dump = _load_postmortem(args.dump)
+    import datetime
+
+    reason = dump.get("reason", "?")
+    when = dump.get("time_unix")
+    stamp = (datetime.datetime.fromtimestamp(when).isoformat(sep=" ",
+                                                            timespec="seconds")
+             if isinstance(when, (int, float)) else "?")
+    print(f"flight-recorder dump: reason={reason} pid={dump.get('pid')} "
+          f"at {stamp}")
+    exc = dump.get("exception")
+    if isinstance(exc, dict):
+        print(f"  exception: {exc.get('type')}: {exc.get('message')}")
+        tb = exc.get("traceback") or []
+        for line in tb[-3:]:
+            print("    " + str(line).rstrip())
+    extra = dump.get("extra")
+    if isinstance(extra, dict):
+        for k, v in extra.items():
+            print(f"  {k}: {v}")
+
+    # -- ring tail: the last N records as a relative-time timeline --
+    ring = [r for r in dump["ring"] if isinstance(r, dict)]
+    print(f"\nring: {len(ring)} record(s) captured")
+    tail = ring[-args.top:]
+    if tail:
+        def _num(v):  # a hand-edited/truncated dump must not traceback
+            return v if isinstance(v, (int, float)) else 0
+
+        def _start(r):  # spans carry start_ns, instant events ts_ns
+            return _num(r.get("start_ns", r.get("ts_ns", 0)))
+
+        t_end = max(_start(r) + _num(r.get("dur_ns", 0)) for r in tail)
+        for r in tail:
+            rel_ms = (_start(r) - t_end) / 1e6
+            dur = r.get("dur_ns")
+            kind = (f"{_num(dur) / 1e6:9.3f}ms" if dur is not None
+                    else "    event")
+            print(f"  {rel_ms:10.3f}ms  {kind}  "
+                  f"[{r.get('thread_name', '?')}] {r.get('name', '?')}")
+
+    # -- thread stacks, innermost frames --
+    threads = dump["threads"]
+    print(f"\nthreads: {len(threads)}")
+    for tid, info in threads.items():
+        name = info.get("name", tid) if isinstance(info, dict) else tid
+        stack = info.get("stack", []) if isinstance(info, dict) else []
+        print(f"  [{name}]")
+        for frame in stack[-args.frames:]:
+            for line in str(frame).splitlines():
+                print("    " + line.rstrip())
+
+    # -- what moved (and stopped moving) in the final poll --
+    deltas = dump.get("metric_deltas")
+    deltas = deltas if isinstance(deltas, dict) else {}
+
+    def _mag(v):  # rank non-numeric deltas last, don't traceback
+        try:
+            return abs(float(v))
+        except (TypeError, ValueError):
+            return -1.0
+
+    if deltas:
+        print(f"\ntop metric deltas (last {len(deltas)} moving):")
+        ranked = sorted(deltas.items(),
+                        key=lambda kv: -_mag(kv[1]))[:args.top]
+        for name, d in ranked:
+            d_txt = f"{d:+12g}" if isinstance(d, (int, float)) \
+                else f"{str(d):>12}"
+            print(f"  {d_txt}  {name}")
+    else:
+        print("\ntop metric deltas: (none moved in the final poll)")
+
+    # -- heartbeat table: who stalled --
+    beats = dump.get("heartbeats")
+    beats = beats if isinstance(beats, dict) else {}
+    if beats:
+        print("\nheartbeats:")
+        width = max(len(str(n)) for n in beats)
+        for name, hb in sorted(beats.items()):
+            hb = hb if isinstance(hb, dict) else {}
+            state = "BUSY" if hb.get("busy") else "idle"
+            print(f"  {name:<{width}}  {state}  beats={hb.get('beats')}"
+                  f"  age={hb.get('age_s')}s"
+                  f"  threshold={hb.get('threshold_s')}s")
+    return 0
 
 
 def _write_artifacts(out_dir: str) -> dict:
@@ -228,6 +350,13 @@ def main(argv: list[str] | None = None) -> int:
     rend = sub.add_parser("render", help="summarize a trace.json")
     rend.add_argument("trace")
     rend.add_argument("--top", type=int, default=20)
+    post = sub.add_parser("postmortem",
+                          help="render a flight-recorder dump")
+    post.add_argument("dump", help="flight_*.json written by obs/flight.py")
+    post.add_argument("--top", type=int, default=15,
+                      help="ring-tail rows and metric-delta rows shown")
+    post.add_argument("--frames", type=int, default=4,
+                      help="innermost stack frames per thread")
 
     args = ap.parse_args(argv if argv is not None else sys.argv[1:])
     try:
@@ -235,6 +364,8 @@ def main(argv: list[str] | None = None) -> int:
             return cmd_demo(args)
         if args.cmd == "pipeline":
             return cmd_pipeline(args)
+        if args.cmd == "postmortem":
+            return cmd_postmortem(args)
         return cmd_render(args)
     except TraceInputError as e:
         print(f"trace: {e}", file=sys.stderr)
