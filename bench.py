@@ -663,6 +663,54 @@ def main() -> None:
     except Exception as e:  # best-effort metric; label failures accurately
         train_ab = {"error": f"{type(e).__name__}: {e}"}
 
+    # on-device preprocessing (round 10): host-preprocessed f32 batches
+    # vs thin uint8 + DevicePreprocess fused into the jitted step, at
+    # full augmentation (pad-crop/flip/brightness/contrast). Both runs
+    # execute the SAME stochastic stages on device (draws fold from the
+    # global step), so the A/B isolates the wire form: f32 final-width
+    # pixels vs uint8 source pixels with geometry replayed in-step. The
+    # crossing byte counts (train_commit seam) make the cut visible
+    # independently of link drift
+    train_pp_ab: dict | None = None
+    try:
+        from mmlspark_tpu.core import plan as plan_lib2
+        from mmlspark_tpu.train.preprocess import (
+            DevicePreprocess, host_preprocess,
+        )
+        spec = DevicePreprocess(crop_pad=4, flip_lr=True, brightness=0.1,
+                                contrast=(0.9, 1.1))
+        n_pp, bs_pp = 2048, 256
+        x_pp = rng.integers(0, 255, size=(n_pp, 32, 32, 3)
+                            ).astype(np.uint8)
+        y_pp = rng.integers(0, 10, size=n_pp).astype(np.int64)
+        train_pp_ab = {}
+        for label, data in (("device_thin", x_pp),
+                            ("host_f32",
+                             host_preprocess(spec, x_pp, 1.0 / 255.0))):
+            cfg_pp = TrainConfig(batch_size=bs_pp, epochs=1,
+                                 optimizer="momentum", learning_rate=0.01,
+                                 log_every=10**9, prefetch_depth=2,
+                                 preprocess=spec, seed=0)
+            tr = Trainer(ConvNetCifar(), cfg_pp)
+            tr.fit_arrays(data[:2 * bs_pp], y_pp[:2 * bs_pp])  # warm
+            with plan_lib2.count_crossings() as cnt:
+                t0 = time.perf_counter()
+                tr.fit_arrays(data, y_pp)
+                dt = time.perf_counter() - t0
+            s = tr.input_stats or {}
+            train_pp_ab[label] = {
+                "images_per_s_per_chip": round(n_pp / dt / n_dev, 1),
+                "h2d_mb": round(cnt.upload_bytes / 2**20, 2),
+                "wire_mb": s.get("wire_mb"),
+                "input_bound_fraction": s.get("input_bound_fraction"),
+            }
+        thin_mb = train_pp_ab["device_thin"]["h2d_mb"]
+        host_mb = train_pp_ab["host_f32"]["h2d_mb"]
+        train_pp_ab["h2d_reduction"] = (round(host_mb / thin_mb, 2)
+                                        if thin_mb else None)
+    except Exception as e:  # best-effort metric; label failures accurately
+        train_pp_ab = {"error": f"{type(e).__name__}: {e}"}
+
     # online serving (round 8): the dynamic-batching model server through
     # the in-process client at 1/8/64 concurrent requesters, A/B dynamic
     # batching (the bucket ladder) vs batch-size-1 (buckets=(1,): every
@@ -743,6 +791,16 @@ def main() -> None:
         "train_input_bound_fraction": (train_ab or {}).get(
             "prefetch", {}).get("input_bound_fraction"),
         "train_input_ab": train_ab,
+        "train_preprocess_images_per_s_per_chip": (train_pp_ab or {}).get(
+            "device_thin", {}).get("images_per_s_per_chip"),
+        "train_preprocess_host_images_per_s_per_chip": (
+            train_pp_ab or {}).get("host_f32", {}).get(
+            "images_per_s_per_chip"),
+        "train_preprocess_h2d_reduction": (train_pp_ab or {}).get(
+            "h2d_reduction"),
+        "train_preprocess_input_bound_fraction": (train_pp_ab or {}).get(
+            "device_thin", {}).get("input_bound_fraction"),
+        "train_preprocess_ab": train_pp_ab,
         "serve_rows_per_s": (serve_ab or {}).get(
             "dynamic_c8", {}).get("rows_per_s"),
         "serve_p99_ms": (serve_ab or {}).get(

@@ -20,7 +20,8 @@ from mmlspark_tpu.analysis.analyzer import (  # noqa: F401
     AnalysisReport, Diagnostic, analyze, check_stage_kinds,
 )
 from mmlspark_tpu.analysis.audit import (  # noqa: F401
-    PlanAudit, PlanSegmentReport,
+    PlanAudit, PlanSegmentReport, TrainPreprocessAudit,
+    audit_train_preprocess,
 )
 from mmlspark_tpu.analysis.collectives import (  # noqa: F401
     CollectiveOp, CollectiveSchedule, SpmdFinding, compare_schedules,
@@ -48,8 +49,10 @@ __all__ = [
     "SpmdFinding",
     "SpmdReport",
     "TableSchema",
+    "TrainPreprocessAudit",
     "analyze",
     "audit_plan_spmd",
+    "audit_train_preprocess",
     "check_stage_kinds",
     "compare_schedules",
     "extract_schedule",

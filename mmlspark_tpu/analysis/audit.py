@@ -87,6 +87,70 @@ class PlanAudit:
         return "\n".join(lines)
 
 
+@dataclasses.dataclass
+class TrainPreprocessAudit:
+    """Pre-flight replay of a train-input ``DevicePreprocess`` spec —
+    the train segment's face of the plan audit.
+
+    ``infer_schema`` for the preprocess spec: the symbolic geometry walk
+    (``DevicePreprocess.out_shape``) validates the spec against the
+    source image geometry (out-of-bounds source crop, reflect padding
+    wider than the image, channel-count mismatches on mean/std) BEFORE
+    any batch is assembled, and the byte predictions price both wire
+    forms of the thin-wire A/B per batch:
+
+    * ``thin_bytes`` — source-resolution uint8 on the wire (geometry +
+      normalize replayed in the jitted step);
+    * ``host_bytes`` — the host-preprocess baseline: float32 at the
+      POST-geometry width.
+
+    The predictions are exact — ``tests/test_train_preprocess.py`` holds
+    ``thin_bytes`` equal to the bytes the obs registry observes at the
+    ``core/plan.train_commit`` seam per committed batch.
+    """
+
+    in_shape: tuple               # (h, w, c) source geometry
+    out_shape: tuple              # (h, w, c) after geometry replay
+    batch_size: int
+    thin_bytes: int               # per-batch uint8 wire (x payload only)
+    host_bytes: int               # per-batch f32 host-preprocess wire
+    reduction: float              # host_bytes / thin_bytes
+
+    def describe(self) -> str:
+        return (f"train preprocess: {self.in_shape} uint8 → "
+                f"{self.out_shape} f32 on device; wire "
+                f"{self.thin_bytes} B/batch thin vs {self.host_bytes} B "
+                f"host-preprocessed ({self.reduction:.2f}x reduction)")
+
+
+def audit_train_preprocess(spec: Any, input_shape: tuple,
+                           batch_size: int) -> TrainPreprocessAudit:
+    """Statically validate a ``DevicePreprocess`` spec over a source
+    image geometry and predict the per-batch H2D byte cost of both wire
+    forms. Raises :class:`~mmlspark_tpu.analysis.info.SchemaError` on a
+    geometry the device chain would reject at trace time."""
+    import numpy as np
+
+    from mmlspark_tpu.analysis.info import SchemaError
+    from mmlspark_tpu.train.preprocess import DevicePreprocess
+
+    spec = DevicePreprocess.parse(spec)
+    if spec is None:
+        raise SchemaError("preprocess-missing",
+                          "audit_train_preprocess needs a spec; got None")
+    try:
+        out = spec.out_shape(tuple(input_shape))
+    except ValueError as e:
+        raise SchemaError("preprocess-geometry", str(e)) from e
+    bs = int(batch_size)
+    thin = bs * int(np.prod(input_shape))
+    host = bs * int(np.prod(out)) * 4
+    return TrainPreprocessAudit(
+        in_shape=tuple(int(d) for d in input_shape),
+        out_shape=tuple(out), batch_size=bs, thin_bytes=thin,
+        host_bytes=host, reduction=round(host / thin, 4))
+
+
 def spmd_audit(stages: list, meta_of: Any, n_rows: int | None = None):
     """The plan audit's multi-chip mode: delegate to
     :func:`mmlspark_tpu.analysis.spmd.audit_plan_spmd` (lazy import —

@@ -101,6 +101,19 @@ def _issue_fetch(outs: tuple) -> None:
         o.copy_to_host_async()
 
 
+def train_commit(chunk: np.ndarray, target: Any) -> Any:
+    """ONE train-batch H2D commit, through the planner's upload seam.
+
+    The train input pipeline (``train/loop.py`` commit closures, running
+    on the ``DeviceLoader`` worker) routes its transfers here so the
+    train path's crossings and bytes land in the SAME observable —
+    ``count_crossings`` patches and the obs registry counters — as the
+    pipeline executor's. The thin-wire preprocessing gate
+    (``tools/perf_smoke.py check_train_device_preprocess``) reads its
+    ≥4× byte reduction off exactly this seam."""
+    return _upload(chunk, target)
+
+
 class CrossingCounter:
     """Tally of device crossings observed by :func:`count_crossings`."""
 

@@ -174,6 +174,9 @@ def stream_binary_files(
         yield DataTable({"path": paths, "bytes": blobs})
 
 
+DECODE_THREAD_PREFIX = "stream-images-decode"
+
+
 def stream_images(
     path: str,
     recursive: bool = False,
@@ -186,6 +189,7 @@ def stream_images(
     image_col: str = "image",
     num_threads: int = 8,
     chunk_rows: int = 256,
+    resize: tuple | None = None,
 ) -> Iterator[DataTable]:
     """Stream decoded images as chunked image-struct DataTables.
 
@@ -193,8 +197,24 @@ def stream_images(
     ``chunk_rows`` decoded images (ImageNet-shard-scale ingest without
     materializing the dataset). ONE pool serves the whole stream — a
     fresh pool per 256-row chunk cost ``num_threads`` thread spawns per
-    chunk, pure overhead on shard-scale streams."""
-    pool = (ThreadPoolExecutor(max_workers=num_threads)
+    chunk, pure overhead on shard-scale streams.
+
+    ``resize`` is the EXPLICIT host-geometry opt-in: ``(h, w)`` resizes
+    every decoded image on the decode pool (the legacy host-preprocess
+    wire form, and the baseline side of the thin-wire A/B); the default
+    ``None`` passes images through at SOURCE resolution — the thin-wire
+    form, where a ``DevicePreprocess`` spec replays the geometry inside
+    the jitted train step and only uint8 source pixels cross the link
+    (docs/training_input.md §on-device preprocessing). No downstream
+    stage silently depends on the reader's geometry either way.
+
+    Pool lifetime: a consumer that abandons the generator early —
+    ``close()``, a ``break``, or GC — shuts the pool down *synchronously*
+    (in-flight decodes finish, every worker thread exits before close
+    returns), so shard-scale training jobs that stop mid-stream never
+    leak decode threads; tests/test_streaming.py pins it."""
+    pool = (ThreadPoolExecutor(max_workers=num_threads,
+                               thread_name_prefix=DECODE_THREAD_PREFIX)
             if num_threads > 1 else None)
     try:
         for raw in stream_binary_files(path, recursive, sample_ratio,
@@ -203,20 +223,31 @@ def stream_images(
                                        extensions=IMAGE_EXTENSIONS,
                                        chunk_rows=chunk_rows):
             yield _decode_chunk(raw, drop_invalid, image_col, num_threads,
-                                pool=pool)
+                                pool=pool, resize=resize)
     finally:
-        # runs on generator close/GC too (an abandoned stream must not
-        # leak its decode threads)
+        # runs on generator close/GC too: an abandoned stream must not
+        # leak its decode threads. wait=True makes the shutdown
+        # deterministic — the (bounded, ≤ one chunk) in-flight decodes
+        # drain and the workers exit before close() returns, instead of
+        # lingering detached behind a fire-and-forget signal
         if pool is not None:
-            pool.shutdown(wait=False)
+            pool.shutdown(wait=True)
 
 
 def _decode_chunk(raw: DataTable, drop_invalid: bool, image_col: str,
                   num_threads: int,
-                  pool: ThreadPoolExecutor | None = None) -> DataTable:
+                  pool: ThreadPoolExecutor | None = None,
+                  resize: tuple | None = None) -> DataTable:
+    if resize is not None:
+        rh, rw = int(resize[0]), int(resize[1])
+
     def decode_one(args):
         p, b = args
-        return (p, decode_image(b))
+        arr = decode_image(b)
+        if arr is not None and resize is not None:
+            from mmlspark_tpu.native import imgops
+            arr = imgops.resize(arr, rh, rw)
+        return (p, arr)
 
     records = list(zip(raw["path"], raw["bytes"]))
     # decode-pool span: one interval per chunk on the pulling thread (the
@@ -229,7 +260,9 @@ def _decode_chunk(raw: DataTable, drop_invalid: bool, image_col: str,
         elif len(records) > 1 and num_threads > 1:
             # one-shot callers (read_images) still get a pool for this
             # chunk; num_threads <= 1 stays strictly sequential
-            with ThreadPoolExecutor(max_workers=num_threads) as one_shot:
+            with ThreadPoolExecutor(
+                    max_workers=num_threads,
+                    thread_name_prefix=DECODE_THREAD_PREFIX) as one_shot:
                 decoded = list(one_shot.map(decode_one, records))
         else:
             decoded = [decode_one(r) for r in records]
@@ -283,13 +316,18 @@ def read_images(
     drop_invalid: bool = True,
     image_col: str = "image",
     num_threads: int = 8,
+    resize: tuple | None = None,
 ) -> DataTable:
     """Read and decode images into an image-struct column.
 
     Returns a DataTable with column ``image`` of
     {path, height, width, channels, data} dicts (ImageSchema analog).
+    ``resize``: optional host ``(h, w)`` resize on the decode pool —
+    same explicit opt-in as :func:`stream_images`; default keeps source
+    resolution.
     """
     raw = read_binary_files(path, recursive, sample_ratio, inspect_zip, seed,
                             shard_index, num_shards,
                             extensions=IMAGE_EXTENSIONS)
-    return _decode_chunk(raw, drop_invalid, image_col, num_threads)
+    return _decode_chunk(raw, drop_invalid, image_col, num_threads,
+                         resize=resize)

@@ -119,8 +119,12 @@ def _train_jax(loss_fn: Callable, params0: Any, x: np.ndarray, y: np.ndarray,
     dev0 = jax.devices()[0]
 
     def commit(batch):
-        return (jax.device_put(batch[0], dev0),
-                jax.device_put(batch[1], dev0))
+        # through the planner's upload seam (core/plan.train_commit):
+        # classical-learner transfers share the crossing/byte counters
+        # with the Trainer and the pipeline executor
+        from mmlspark_tpu.core import plan as plan_lib
+        return (plan_lib.train_commit(batch[0], dev0),
+                plan_lib.train_commit(batch[1], dev0))
 
     params = params0
     loader = DeviceLoader(host_batches(), commit,

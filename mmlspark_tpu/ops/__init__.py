@@ -11,9 +11,13 @@ from mmlspark_tpu.ops.augment import (
     random_flip_lr, random_flip_ud,
 )
 from mmlspark_tpu.ops.group_norm import group_norm, group_norm_reference
+from mmlspark_tpu.ops.pallas import (
+    fused_resize_norm, fused_resize_norm_host, fused_resize_norm_reference,
+)
 
 __all__ = [
-    "augment_batch", "group_norm", "group_norm_reference",
+    "augment_batch", "fused_resize_norm", "fused_resize_norm_host",
+    "fused_resize_norm_reference", "group_norm", "group_norm_reference",
     "random_brightness", "random_contrast", "random_crop",
     "random_flip_lr", "random_flip_ud",
 ]

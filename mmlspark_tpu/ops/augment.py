@@ -19,6 +19,8 @@ All functions take a PRNG key and an NHWC batch and are safe under
 
 from __future__ import annotations
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
@@ -39,13 +41,71 @@ def _photometric(batch: jnp.ndarray, fn) -> jnp.ndarray:
     """Run a photometric op in float and cast back. Integer batches
     (uint8 pixels) round + clip to the dtype's range — computing in the
     integer dtype would wrap negative shifts modularly and truncate
-    fractional contrast factors to 0/1."""
+    fractional contrast factors to 0/1.
+
+    The integer contract is EXACT and pinned against the numpy oracles
+    below (tests/test_ops.py): compute in f32, round half-to-even
+    (``jnp.round`` == ``np.round``), then clip to ``iinfo`` bounds — in
+    that order, so a 255-pixel under a positive shift stays 255 and a
+    0-pixel under a negative shift stays 0, with no modular wrap and no
+    off-by-one at the boundaries from clipping before the round."""
     if jnp.issubdtype(batch.dtype, jnp.integer):
         info = jnp.iinfo(batch.dtype)
         out = fn(batch.astype(jnp.float32))
         return jnp.clip(jnp.round(out), info.min, info.max
                         ).astype(batch.dtype)
     return fn(batch).astype(batch.dtype)
+
+
+# ---- numpy oracles: the host-reference semantics of each op given its
+#      effective draw (shift / factor / offsets). Property tests feed
+#      them the SAME values the jax op drew (replaying the documented
+#      key schedule) and hold the device output EXACTLY equal for every
+#      integer dtype (the round/clip edges and the pad+crop geometry
+#      cannot drift silently); float batches match to reduction-order
+#      ULPs (XLA and numpy sum the contrast mean in different orders) ----
+
+def host_photometric(batch: np.ndarray, fn) -> np.ndarray:
+    """Numpy twin of :func:`_photometric`: f32 compute → round
+    half-to-even → clip to the integer dtype's range."""
+    batch = np.asarray(batch)
+    if np.issubdtype(batch.dtype, np.integer):
+        info = np.iinfo(batch.dtype)
+        out = fn(batch.astype(np.float32))
+        return np.clip(np.round(out), info.min, info.max
+                       ).astype(batch.dtype)
+    return fn(batch).astype(batch.dtype)
+
+
+def host_brightness(batch: np.ndarray, shift: np.ndarray) -> np.ndarray:
+    """:func:`random_brightness` given its drawn per-sample ``shift``
+    (shape ``[N]`` or ``[N,1,1,1]``, the op's own scale)."""
+    shift = np.asarray(shift, np.float32).reshape(-1, 1, 1, 1)
+    return host_photometric(batch, lambda b: b + shift)
+
+
+def host_contrast(batch: np.ndarray, factor: np.ndarray) -> np.ndarray:
+    """:func:`random_contrast` given its drawn per-sample ``factor``."""
+    factor = np.asarray(factor, np.float32).reshape(-1, 1, 1, 1)
+
+    def op(b):
+        mean = b.mean(axis=(1, 2, 3), keepdims=True, dtype=np.float32)
+        return mean + (b - mean) * factor
+
+    return host_photometric(batch, op)
+
+
+def host_crop(batch: np.ndarray, pad: int, oy: np.ndarray,
+              ox: np.ndarray) -> np.ndarray:
+    """:func:`random_crop` given its drawn per-sample offsets: reflect-pad
+    ``pad`` on each spatial side, slice the original H×W window at
+    ``(oy[i], ox[i])``."""
+    batch = np.asarray(batch)
+    n, h, w, _c = batch.shape
+    padded = np.pad(batch, ((0, 0), (pad, pad), (pad, pad), (0, 0)),
+                    mode="reflect")
+    return np.stack([padded[i, oy[i]:oy[i] + h, ox[i]:ox[i] + w]
+                     for i in range(n)])
 
 
 def random_brightness(key: jax.Array, batch: jnp.ndarray,

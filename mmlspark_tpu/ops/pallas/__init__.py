@@ -1,0 +1,22 @@
+"""Hand-written Pallas kernels for the train-input hot path.
+
+XLA schedules most of the device preprocessing chain well (elementwise
+augment ops fuse into the surrounding step program for free), but the
+fused gather path — crop + bilinear resize + normalize — lowers as four
+separate batched gathers plus three blend passes over f32 intermediates,
+each a round-trip through HBM. The kernels here do that chain in one
+VMEM-resident pass per sample. Every kernel ships with a pure-XLA
+reference implementation pinned ≤ 1 ULP equal (tests/test_train_preprocess
+and the tier-1 ``check_train_device_preprocess`` gate), and runs in
+interpreter mode on non-TPU backends so CPU tests execute the kernel
+itself, not a shadow path.
+"""
+
+from mmlspark_tpu.ops.pallas.resize import (
+    fused_resize_norm, fused_resize_norm_host, fused_resize_norm_reference,
+)
+
+__all__ = [
+    "fused_resize_norm", "fused_resize_norm_host",
+    "fused_resize_norm_reference",
+]

@@ -1,0 +1,222 @@
+"""Fused per-sample crop → bilinear resize → normalize, as one Pallas pass.
+
+The hot gather path of on-device train preprocessing
+(:mod:`mmlspark_tpu.train.preprocess`): each sample takes a (possibly
+random) fixed-size crop window out of the source-resolution uint8 image,
+bilinearly resizes the window to the training resolution, and scales the
+result into normalized float32 — the geometry the thin-wire ingest mode
+replays on device instead of paying for it on a host thread pool.
+
+Under plain XLA the chain lowers as four batched gathers (the corner
+taps) with three f32 blend passes between them, each materializing an
+``[N, OH, OW, C]`` intermediate in HBM. The kernel reads each sample's
+source block into VMEM once (grid over samples — one output tile per
+program) and does the window slice, the four static-index taps, the
+blend, and the normalize scale there: one HBM read of uint8 source + one
+HBM write of f32 output per element.
+
+Three implementations share ONE coordinate/weight grid
+(:func:`_grids`, precomputed in numpy float32 at trace time), so they can
+be pinned against each other exactly:
+
+* :func:`fused_resize_norm_reference` — pure XLA (``vmap`` over samples),
+  the semantics anchor;
+* the Pallas kernel — ≤ 1 ULP equal to the reference
+  (``np.testing.assert_array_max_ulp``), asserted on the CPU backend in
+  interpreter mode so the kernel body itself executes in tier-1;
+* :func:`fused_resize_norm_host` — the numpy oracle host baselines and
+  property tests compare against: ≤ 2 ULP from the device paths (XLA
+  contracts the four-tap blend into FMAs, numpy cannot — one extra
+  rounding per tap), far inside the 1e-5 end-to-end loss tolerance.
+
+Coordinate math matches the repo's bilinear convention
+(``stages/image._device_resize_step`` / native ``img_resize_bilinear``):
+align-corners f32 source coordinates, left-associated blend — except the
+output stays float32 (training consumes normalized floats; the inference
+path's final uint8 quantization step does not apply).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _grids(ch: int, cw: int, oh: int, ow: int) -> tuple:
+    """Static gather indices + blend weights for a (ch, cw) → (oh, ow)
+    align-corners bilinear resize. All float math in numpy float32 so the
+    XLA reference, the Pallas kernel, and the numpy oracle consume
+    bit-identical constants."""
+    sy = (np.float32(ch - 1) / np.float32(oh - 1)) if oh > 1 else np.float32(0)
+    sx = (np.float32(cw - 1) / np.float32(ow - 1)) if ow > 1 else np.float32(0)
+    fy = np.arange(oh, dtype=np.float32) * sy
+    fx = np.arange(ow, dtype=np.float32) * sx
+    y0 = fy.astype(np.int32)
+    x0 = fx.astype(np.int32)
+    y1 = np.minimum(y0 + 1, ch - 1)
+    x1 = np.minimum(x0 + 1, cw - 1)
+    # subtract in f32 (int32 operands would promote the whole weight
+    # chain to f64, and the numpy oracle would then blend in f64 while
+    # the device paths blend in canonicalized f32)
+    wy = (fy - y0.astype(np.float32)).reshape(oh, 1, 1)
+    wx = (fx - x0.astype(np.float32)).reshape(1, ow, 1)
+    one = np.float32(1)
+    # the four corner weights, precomputed: v = Σ v_ij * w_ij is then a
+    # single multiply-add sequence identical across implementations
+    w00 = (one - wy) * (one - wx)
+    w01 = (one - wy) * wx
+    w10 = wy * (one - wx)
+    w11 = wy * wx
+    return y0, y1, x0, x1, w00, w01, w10, w11
+
+
+def _blend(win, g, scale: np.float32):
+    """The shared tap/blend/normalize body over one (ch, cw, C) window.
+    jnp and numpy expose identical take/astype/arithmetic surface, so the
+    SAME code is the kernel body, the XLA reference, and the numpy oracle
+    — implementations cannot drift apart op by op."""
+    xp = jnp if isinstance(win, jnp.ndarray) else np
+    y0, y1, x0, x1, w00, w01, w10, w11 = g
+    rows0 = xp.take(win, y0, axis=0)
+    rows1 = xp.take(win, y1, axis=0)
+    v00 = xp.take(rows0, x0, axis=1).astype(np.float32)
+    v01 = xp.take(rows0, x1, axis=1).astype(np.float32)
+    v10 = xp.take(rows1, x0, axis=1).astype(np.float32)
+    v11 = xp.take(rows1, x1, axis=1).astype(np.float32)
+    v = v00 * w00 + v01 * w01 + v10 * w10 + v11 * w11
+    return v * scale
+
+
+def fused_resize_norm_reference(x, oy, ox, crop: tuple, out_hw: tuple,
+                                scale: float) -> jnp.ndarray:
+    """Pure-XLA fused path: per-sample window slice + bilinear taps +
+    normalize, vmapped over the batch. The semantics anchor the Pallas
+    kernel is pinned against."""
+    ch, cw = int(crop[0]), int(crop[1])
+    c = x.shape[-1]
+    g = _grids(ch, cw, int(out_hw[0]), int(out_hw[1]))
+    s = np.float32(scale)
+
+    def one(img, y, xo):
+        win = jax.lax.dynamic_slice(img, (y, xo, 0), (ch, cw, c))
+        return _blend(win, g, s)
+
+    return jax.vmap(one)(x, oy.astype(jnp.int32), ox.astype(jnp.int32))
+
+
+def fused_resize_norm_host(x, oy, ox, crop: tuple, out_hw: tuple,
+                           scale: float) -> np.ndarray:
+    """Numpy oracle: the identical tap/blend/normalize sequence on host.
+    Also the "host-preprocess" baseline wire format of the thin-wire A/B
+    (``train/preprocess.host_preprocess``)."""
+    x = np.asarray(x)
+    ch, cw = int(crop[0]), int(crop[1])
+    oh, ow = int(out_hw[0]), int(out_hw[1])
+    g = _grids(ch, cw, oh, ow)
+    s = np.float32(scale)
+    oy = np.asarray(oy, np.int64)
+    ox = np.asarray(ox, np.int64)
+    out = np.empty((len(x), oh, ow, x.shape[-1]), np.float32)
+    for i in range(len(x)):
+        win = x[i, oy[i]:oy[i] + ch, ox[i]:ox[i] + cw]
+        out[i] = _blend(win, g, s)
+    return out
+
+
+def _kernel(x_ref, oy_ref, ox_ref, yidx_ref, xidx_ref, w_ref, o_ref, *,
+            crop: tuple, scale: np.float32):
+    # the grid arrays arrive as kernel INPUTS (this jax's pallas rejects
+    # closure-captured array constants), packed [2, OH] / [2, OW] /
+    # [4, OH, OW] — same numpy values every implementation consumes
+    ch, cw = crop
+    c = x_ref.shape[-1]
+    win = jax.lax.dynamic_slice(
+        x_ref[0], (oy_ref[0, 0], ox_ref[0, 0], 0), (ch, cw, c))
+    g = (yidx_ref[0], yidx_ref[1], xidx_ref[0], xidx_ref[1],
+         w_ref[0][..., None], w_ref[1][..., None],
+         w_ref[2][..., None], w_ref[3][..., None])
+    o_ref[0] = _blend(win, g, scale)
+
+
+def _fits_vmem(h: int, w: int, oh: int, ow: int, c: int) -> bool:
+    """Conservative per-sample VMEM estimate: the uint8 source block, the
+    sliced window, four f32 corner taps + the f32 blend/output, lane dim
+    padded to 128. Blocks past the ~16 MB budget fall back to the XLA
+    reference (same math, more HBM traffic)."""
+    c_pad = -(-c // 128) * 128
+    est = h * w * c_pad * 2 + 6 * oh * ow * c_pad * 4
+    return est < 14 * 2 ** 20
+
+
+def _pallas_call(x, oy, ox, crop: tuple, out_hw: tuple, scale: float):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, h, w, c = x.shape
+    ch, cw = int(crop[0]), int(crop[1])
+    oh, ow = int(out_hw[0]), int(out_hw[1])
+    y0, y1, x0, x1, w00, w01, w10, w11 = _grids(ch, cw, oh, ow)
+    yidx = np.stack([y0, y1])                      # [2, OH] int32
+    xidx = np.stack([x0, x1])                      # [2, OW] int32
+    wts = np.stack([w00, w01, w10, w11])[..., 0]   # [4, OH, OW] f32
+    kern = functools.partial(_kernel, crop=(ch, cw),
+                             scale=np.float32(scale))
+    return pl.pallas_call(
+        kern,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((1, h, w, c), lambda i: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (i, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda i: (i, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((2, oh), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((2, ow), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((4, oh, ow), lambda i: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, oh, ow, c), lambda i: (i, 0, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n, oh, ow, c), jnp.float32),
+        interpret=jax.default_backend() != "tpu",
+    )(x, oy.astype(jnp.int32).reshape(n, 1),
+      ox.astype(jnp.int32).reshape(n, 1), yidx, xidx, wts)
+
+
+IMPLS = ("auto", "xla", "pallas")
+
+
+def fused_resize_norm(x, oy, ox, crop: tuple, out_hw: tuple, scale: float,
+                      impl: str = "auto") -> jnp.ndarray:
+    """Fused crop → bilinear resize → normalize over an ``[N, H, W, C]``
+    batch: sample ``i`` takes the ``crop``-sized window at ``(oy[i],
+    ox[i])``, resizes it to ``out_hw``, and returns float32 ``* scale``.
+
+    ``impl`` selects the backend ("the TrainConfig flag" — threaded from
+    ``DevicePreprocess.impl``): ``"xla"`` forces the reference,
+    ``"pallas"`` forces the kernel (interpreter mode off-TPU — the CPU
+    fallback executes the kernel body, not a shadow path), and ``"auto"``
+    uses the kernel on the TPU backend and the reference elsewhere.
+    Windows too large for the per-sample VMEM budget always take the
+    reference — identical math, different schedule.
+    """
+    if impl not in IMPLS:
+        raise ValueError(f"unknown fused_resize_norm impl {impl!r}; "
+                         f"one of {IMPLS}")
+    n, h, w, c = x.shape
+    ch, cw = int(crop[0]), int(crop[1])
+    if ch > h or cw > w:
+        raise ValueError(f"crop window ({ch}, {cw}) larger than the "
+                         f"source image ({h}, {w})")
+    use_pallas = impl == "pallas" or (
+        impl == "auto" and jax.default_backend() == "tpu")
+    if use_pallas and _fits_vmem(h, w, int(out_hw[0]), int(out_hw[1]), c):
+        return _pallas_call(x, oy, ox, crop, out_hw, scale)
+    return fused_resize_norm_reference(x, oy, ox, crop, out_hw, scale)

@@ -10,7 +10,11 @@ from mmlspark_tpu.train.checkpoint import TrainCheckpointer
 from mmlspark_tpu.train.input import DeviceLoader
 from mmlspark_tpu.train.learner import JaxLearner, JaxLearnerModel
 from mmlspark_tpu.train.loop import TrainConfig, Trainer, make_train_step
+from mmlspark_tpu.train.preprocess import (
+    DevicePreprocess, envelope_batch, host_preprocess,
+)
 
-__all__ = ["DeviceLoader", "JaxLearner", "JaxLearnerModel",
-           "TrainCheckpointer", "TrainConfig", "Trainer",
+__all__ = ["DeviceLoader", "DevicePreprocess", "JaxLearner",
+           "JaxLearnerModel", "TrainCheckpointer", "TrainConfig",
+           "Trainer", "envelope_batch", "host_preprocess",
            "make_train_step"]

@@ -54,6 +54,18 @@ THREAD_PREFIX = "DeviceLoader"
 
 _ITEM, _ERROR, _DONE = "item", "error", "done"
 
+
+def _item_nbytes(item: Any) -> int:
+    """Host bytes of the numpy payload inside one (possibly tagged)
+    batch item — the WIRE size the commit is about to ship. Non-array
+    leaves (step tags, chunk indices) count zero."""
+    nbytes = getattr(item, "nbytes", None)
+    if nbytes is not None and hasattr(item, "dtype"):
+        return int(nbytes)
+    if isinstance(item, (tuple, list)):
+        return sum(_item_nbytes(v) for v in item)
+    return 0
+
 # loader spans go through the obs tracer (obs.span): disabled they are a
 # flag check; enabled they land in the ring buffer, and with
 # obs.enable(device_annotations=True) they ALSO enter
@@ -105,6 +117,10 @@ class DeviceLoader:
         self.wait_s = 0.0
         self.assemble_s = 0.0
         self.commit_s = 0.0
+        # total host bytes the commits shipped — the honest wire-format
+        # observable of the thin-wire A/B (uint8 source pixels vs
+        # host-preprocessed f32), independent of the device-side seam
+        self.wire_bytes = 0
         self._done = False
         if self.depth > 0:
             self._q: queue.Queue = queue.Queue(maxsize=self.depth)
@@ -135,6 +151,7 @@ class DeviceLoader:
                 except StopIteration:
                     break
                 self.assemble_s += time.perf_counter() - t0
+                self.wire_bytes += _item_nbytes(item)
                 t0 = time.perf_counter()
                 with _annotate(f"{self.name}/commit", "train"):
                     out = self._commit(item)
@@ -180,6 +197,7 @@ class DeviceLoader:
             with _annotate(f"{self.name}/input", "train"):
                 item = next(self._source)  # StopIteration ends iteration
                 self.assemble_s += time.perf_counter() - t0
+                self.wire_bytes += _item_nbytes(item)
                 t1 = time.perf_counter()
                 out = self._commit(item)
                 self.commit_s += time.perf_counter() - t1
@@ -296,6 +314,7 @@ def input_stats(loader: DeviceLoader, loop_s: float) -> dict:
                                  if loop_s > 0 else 0.0),
         "assemble_s": round(loader.assemble_s, 4),
         "commit_s": round(loader.commit_s, 4),
+        "wire_mb": round(loader.wire_bytes / 2 ** 20, 3),
     }
     if _obs_rt._enabled:
         # publish the same numbers into the process-wide registry (one

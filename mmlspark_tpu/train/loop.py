@@ -23,6 +23,7 @@ from typing import Any, Callable, Iterator
 
 import numpy as np
 
+from mmlspark_tpu.core import plan as plan_lib
 from mmlspark_tpu.core.logging_utils import get_logger, timed
 from mmlspark_tpu.obs import flight as _obs_flight
 from mmlspark_tpu.obs import runtime as _obs_rt
@@ -30,6 +31,7 @@ from mmlspark_tpu.obs.anomaly import NonFiniteSentinel, StragglerDetector
 from mmlspark_tpu.obs.metrics import registry as _obs_registry
 from mmlspark_tpu.obs.spans import span as _obs_span
 from mmlspark_tpu.parallel import mesh as mesh_lib
+from mmlspark_tpu.train import preprocess as preprocess_lib
 
 _log = get_logger(__name__)
 
@@ -92,6 +94,15 @@ class TrainConfig:
     # the jitted step. The default maps raw bytes to [0, 1]; float inputs
     # are never touched
     input_scale: float = 1.0 / 255.0
+    # on-device train preprocessing (train/preprocess.py): a
+    # DevicePreprocess spec (or its plain-dict form) whose geometry
+    # (source crop + bilinear resize), normalization, and stochastic
+    # augmentation (pad-crop/flips/brightness/contrast) fuse INTO the
+    # jitted step — one program, zero extra dispatches, thin uint8 on
+    # the wire. Stochastic draws fold from the CHECKPOINTED global step,
+    # so prefetch depth, host count, and resume all replay the identical
+    # augmentation stream. None = the plain uint8 cast convention above
+    preprocess: Any = None
     # multi-host fit_stream: local batches buffered per cross-process
     # liveness exchange. 1 = a host-side barrier every step (the
     # conservative round-3 behavior); larger values amortize it over up to
@@ -277,6 +288,7 @@ def make_train_step(module: Any, cfg: TrainConfig, mesh: Any):
 
     tx = make_optimizer(cfg)
     loss_fn = make_loss(cfg.loss)
+    pp = preprocess_lib.resolve(cfg.preprocess)
     hooks = resolve_mesh_hooks(module, mesh)
     check_mesh_axes_used(module, mesh, hooks["handled"])
     apply_kwargs = hooks["apply_kwargs"]
@@ -292,7 +304,12 @@ def make_train_step(module: Any, cfg: TrainConfig, mesh: Any):
         from jax.sharding import NamedSharding
 
         rng = jax.random.PRNGKey(cfg.seed)
-        dummy = jnp.zeros((1,) + tuple(input_spec), jnp.float32)
+        shape = tuple(input_spec)
+        if pp is not None and len(shape) == 3:
+            # the module sees POST-preprocess geometry: a thin-wire
+            # 40x40 source trains a 32x32 model when the spec resizes
+            shape = pp.out_shape(shape)
+        dummy = jnp.zeros((1,) + shape, jnp.float32)
         params = module.init(rng, dummy)["params"]
         if cfg.param_dtype:
             dt = jnp.dtype(cfg.param_dtype)
@@ -371,17 +388,23 @@ def make_train_step(module: Any, cfg: TrainConfig, mesh: Any):
             return (x.astype(jnp.int32) != pad_id).astype(jnp.float32)
         return None
 
-    def _prep_x(x):
+    def _prep_x(x, step):
         # uint8 ships thin (¼ the H2D bytes) and casts/normalizes on
         # device — the round-2 inference convention, applied to training.
-        # Token matrices are int32/int64 and pass through untouched
+        # Token matrices are int32/int64 and pass through untouched.
+        # With a DevicePreprocess spec, NHWC image batches additionally
+        # replay geometry + stochastic augmentation in-step, keyed off
+        # the (checkpointed) global step so every replay is bit-exact
+        if pp is not None and getattr(x, "ndim", 0) == 4:
+            key = jax.random.fold_in(jax.random.PRNGKey(cfg.seed), step)
+            return preprocess_lib.apply(pp, key, x, cfg.input_scale)
         if x.dtype == jnp.uint8:
             return x.astype(jnp.float32) * cfg.input_scale
         return x
 
     def _step(state, x, y):
         def compute_loss(params):
-            logits, aux = _forward(params, _prep_x(x))
+            logits, aux = _forward(params, _prep_x(x, state["step"]))
             per = loss_fn(logits, y, token_mask=_token_mask(x))
             return per.mean() + cfg.moe_aux_weight * aux
 
@@ -394,7 +417,7 @@ def make_train_step(module: Any, cfg: TrainConfig, mesh: Any):
         # clamped denominator makes an all-zero-weight batch (multi-host
         # filler between liveness syncs) an exact no-op instead of 0/0 NaN
         def compute_loss(params):
-            logits, aux = _forward(params, _prep_x(x))
+            logits, aux = _forward(params, _prep_x(x, state["step"]))
             per = loss_fn(logits, y, token_mask=_token_mask(x))
             # gate the aux term on the row weights too: an all-filler batch
             # must be an EXACT no-op, but routing statistics are computed
@@ -670,6 +693,11 @@ class Trainer:
                              "epochs": int(cfg.epochs),
                              "param_dtype": cfg.param_dtype or "float32",
                              "sched": 2}
+        if cfg.preprocess is not None:
+            # resuming under a CHANGED preprocess spec would silently
+            # replay different pixels into the remaining steps
+            self._fingerprint["preprocess"] = preprocess_lib.resolve(
+                cfg.preprocess).fingerprint()
         resumed = 0
         if self.state is None:
             self.state = self.init_state(x.shape[1:])
@@ -686,10 +714,15 @@ class Trainer:
         if nproc > 1:
             def commit(arr):
                 # local slice → its block of the globally-sharded array
+                # (multi-host assembly has no single-transfer seam to
+                # route through — bytes are accounted by the loader)
                 return jax.make_array_from_process_local_data(data, arr)
         else:
             def commit(arr):
-                return jax.device_put(arr, data)
+                # through the planner's upload seam: train-path H2D
+                # transfers share the crossing/byte counters (and
+                # count_crossings patches) with the pipeline executor
+                return plan_lib.train_commit(arr, data)
 
         total_steps = cfg.epochs * (-(-len(x) // bs_local))
 
@@ -808,7 +841,7 @@ class Trainer:
                 return jax.make_array_from_process_local_data(data, arr)
         else:
             def commit(arr):
-                return jax.device_put(arr, data)
+                return plan_lib.train_commit(arr, data)  # counted seam
 
         # streams have no stable row count; fingerprint only the schedule
         # shape that must match for a resume to replay correctly
@@ -817,6 +850,9 @@ class Trainer:
                              "epochs": int(cfg.epochs),
                              "param_dtype": cfg.param_dtype or "float32",
                              "sched": 2}
+        if cfg.preprocess is not None:
+            self._fingerprint["preprocess"] = preprocess_lib.resolve(
+                cfg.preprocess).fingerprint()
         ckpt = self._checkpointer()
         # producer-side progress, read by the consumer once the loader is
         # drained (the worker has exited by then): walked steps include the

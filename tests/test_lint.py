@@ -214,6 +214,68 @@ def test_jx106_pragma_suppresses_and_ignores_plain_calls():
     assert lint_source(src_ok, "x.py") == []
 
 
+JX107_FLAGGED = '''
+import cv2
+from mmlspark_tpu.native import imgops
+from mmlspark_tpu.train import DeviceLoader, DevicePreprocess
+
+
+def fit(batches, state, step_masked):
+    for b in batches:
+        img = imgops.resize(b, 32, 32)                # JX107
+        raw = cv2.imdecode(b, 1)                      # JX107
+        state, m = step_masked(state, img, raw)
+    return state
+
+
+def producer(chunks):
+    for c in chunks:
+        yield imgops.resize(c, 32, 32)                # JX107
+
+
+def run(chunks, commit):
+    return DeviceLoader(producer(chunks), commit, depth=2)
+'''
+
+
+def test_jx107_flags_host_image_work_when_spec_active():
+    findings = lint_source(JX107_FLAGGED, "fixture107.py")
+    got = sorted((f.rule, f.line) for f in findings)
+    lines = JX107_FLAGGED.splitlines()
+    want = sorted(("JX107", i + 1) for i, text in enumerate(lines)
+                  if "# JX107" in text)
+    assert got == want, (got, want)
+
+
+def test_jx107_clean_counterparts():
+    # 1) the same host image work with NO DevicePreprocess in the module:
+    #    the legacy host-preprocess path is legitimate, not a finding
+    clean = JX107_FLAGGED.replace(
+        "from mmlspark_tpu.train import DeviceLoader, DevicePreprocess",
+        "from mmlspark_tpu.train import DeviceLoader")
+    assert lint_source(clean, "x.py") == []
+    # 2) spec active, but the resize happens OUTSIDE the step loop /
+    #    producer (one-off warmup, eval-time thumbnailing): clean
+    src = ("from mmlspark_tpu.train import DevicePreprocess\n"
+           "from mmlspark_tpu.native import imgops\n"
+           "def thumbnail(img):\n"
+           "    return imgops.resize(img, 8, 8)\n"
+           "def fit(batches, state, step):\n"
+           "    for b in batches:\n"
+           "        state, m = step(state, b)\n"
+           "    return state\n")
+    assert lint_source(src, "x.py") == []
+    # 3) pragma suppresses
+    src_pragma = JX107_FLAGGED.replace(
+        "imgops.resize(b, 32, 32)                # JX107",
+        "imgops.resize(b, 32, 32)  # lint-jax: allow(JX107)").replace(
+        "cv2.imdecode(b, 1)                      # JX107",
+        "cv2.imdecode(b, 1)  # lint-jax: allow(JX107)").replace(
+        "imgops.resize(c, 32, 32)                # JX107",
+        "imgops.resize(c, 32, 32)  # lint-jax: allow(JX107)")
+    assert lint_source(src_pragma, "x.py") == []
+
+
 def test_pragma_suppresses():
     src = ("import jax\n"
            "@jax.jit\n"

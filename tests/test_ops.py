@@ -183,6 +183,77 @@ class TestDeviceAugment:
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))  # keyed
         assert not np.array_equal(np.asarray(a), np.asarray(c))
 
+    @pytest.mark.parametrize("dtype", [np.uint8, np.int16, np.float32])
+    def test_ops_equal_numpy_oracle_given_the_drawn_params(self, dtype):
+        """Property pin (round 10): each augmentation, fed the SAME
+        per-sample draws (replayed through the documented key schedule),
+        equals its numpy oracle — EXACTLY for integer dtypes (the
+        round-half-even + clip edge semantics at the dtype bounds), and
+        to reduction-order ULPs for float (XLA sums the contrast mean in
+        a different order than numpy)."""
+        from mmlspark_tpu.ops import (
+            random_brightness, random_contrast, random_crop,
+        )
+        from mmlspark_tpu.ops.augment import (
+            host_brightness, host_contrast, host_crop,
+        )
+
+        r = np.random.default_rng(11)
+        if dtype == np.float32:
+            x = r.normal(size=(24, 9, 7, 3)).astype(np.float32)
+            delta = 0.3
+        else:
+            info = np.iinfo(dtype)
+            # include exact boundary pixels so the clip edges are hit
+            x = r.integers(info.min, int(info.max) + 1,
+                           (24, 9, 7, 3)).astype(dtype)
+            x[0] = info.max
+            x[1] = info.min
+            delta = 25.0
+        key = jax.random.PRNGKey(7)
+
+        def check(dev, host):
+            dev = np.asarray(dev)
+            if dtype == np.float32:
+                np.testing.assert_allclose(dev, host, rtol=1e-6,
+                                           atol=1e-6)
+            else:
+                np.testing.assert_array_equal(dev, host)
+
+        shift = np.asarray(jax.random.uniform(
+            key, (24, 1, 1, 1), minval=-delta, maxval=delta))
+        check(random_brightness(key, jnp.asarray(x), delta),
+              host_brightness(x, shift))
+
+        factor = np.asarray(jax.random.uniform(
+            key, (24, 1, 1, 1), minval=0.7, maxval=1.4))
+        check(random_contrast(key, jnp.asarray(x), 0.7, 1.4),
+              host_contrast(x, factor))
+
+        ky, kx = jax.random.split(key)
+        oy = np.asarray(jax.random.randint(ky, (24,), 0, 5))
+        ox = np.asarray(jax.random.randint(kx, (24,), 0, 5))
+        # pad+crop is pure indexing: exact for EVERY dtype
+        np.testing.assert_array_equal(
+            np.asarray(random_crop(key, jnp.asarray(x), 2)),
+            host_crop(x, 2, oy, ox))
+
+    def test_uint8_brightness_saturates_exactly_at_bounds(self):
+        # an all-255 batch under any positive shift stays exactly 255;
+        # an all-0 batch under any negative shift stays exactly 0 — the
+        # boundary half of the round-and-clip contract
+        from mmlspark_tpu.ops import random_brightness
+        top = jnp.full((8, 4, 4, 3), 255, jnp.uint8)
+        bot = jnp.zeros((8, 4, 4, 3), jnp.uint8)
+        for seed in range(3):
+            key = jax.random.PRNGKey(seed)
+            shift = np.asarray(jax.random.uniform(
+                key, (8, 1, 1, 1), minval=-30.0, maxval=30.0))
+            up = np.asarray(random_brightness(key, top, 30.0))
+            dn = np.asarray(random_brightness(key, bot, 30.0))
+            assert (up[shift[:, 0, 0, 0] >= 0.5] == 255).all()
+            assert (dn[shift[:, 0, 0, 0] <= -0.5] == 0).all()
+
     def test_uint8_batches_clip_instead_of_wrapping(self):
         # review finding r3: integer pixels must not wrap modularly on a
         # negative brightness draw nor truncate contrast factors to 0/1

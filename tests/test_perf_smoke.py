@@ -15,7 +15,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
 from perf_smoke import (  # noqa: E402
     check_fused_crossings, check_flight_recorder, check_obs_overhead,
     check_obs_request_tracing, check_serve_batching, check_serve_sharded,
-    check_spmd_clean, check_train_prefetch,
+    check_spmd_clean, check_train_device_preprocess, check_train_prefetch,
 )
 
 
@@ -31,6 +31,22 @@ def test_train_loader_commits_ahead_of_consumption():
     assert result["committed_ahead_max"] >= result["prefetch_depth"]
     assert result["batches"] == result["steps"]
     assert 0.0 <= result["input_bound_fraction"] <= 1.0
+
+
+def test_train_device_preprocess_ships_thin_and_replays_exactly():
+    """On-device preprocessing (round 10): full-augment thin-wire
+    training ships >= 4x fewer H2D image bytes than the host-preprocess
+    baseline (obs registry counters at the train_commit seam), loss
+    histories agree to <= 1e-5 across the wire forms, exactly one step
+    program compiles per input shape, a mid-epoch resume replays the
+    augmentation stream bit-identically, and the Pallas fused-geometry
+    kernel stays <= 1 ULP from its XLA reference in interpret mode."""
+    result = check_train_device_preprocess()
+    assert result["h2d_reduction"] >= result["min_reduction"]
+    assert result["loss_history_max_diff"] <= 1e-5
+    assert result["programs_thin"] in (None, 1)
+    assert result["resume_history_len"] == result["steps"] - 7
+    assert result["wire_mb_thin"] < result["wire_mb_host"]
 
 
 def test_obs_disabled_path_overhead_bounded():

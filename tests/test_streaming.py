@@ -67,6 +67,48 @@ class TestStreamReaders:
         total = sum(len(b) for c in chunks for b in c["bytes"])
         assert total > 0
 
+    def test_abandoned_stream_shuts_decode_pool(self, image_dir):
+        """Pool-lifetime contract: a consumer that abandons the stream
+        mid-iteration (close / break / GC) must not leak decode threads
+        — shutdown is synchronous, so the workers are GONE when close()
+        returns."""
+        import threading
+        import time
+
+        from mmlspark_tpu.data.readers import DECODE_THREAD_PREFIX
+
+        def decode_threads():
+            return [t for t in threading.enumerate()
+                    if t.name.startswith(DECODE_THREAD_PREFIX)]
+
+        stream = stream_images(image_dir, chunk_rows=16, num_threads=4)
+        first = next(stream)
+        assert len(first) == 16
+        assert decode_threads()  # the pool actually spun up
+        stream.close()  # consumer abandons the stream mid-iteration
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and decode_threads():
+            time.sleep(0.02)
+        assert not decode_threads(), (
+            f"leaked decode threads after close: {decode_threads()}")
+
+    def test_resize_opt_in_and_source_resolution_passthrough(
+            self, image_dir):
+        # default: source resolution passes through untouched (the
+        # thin-wire form — device preprocessing replays geometry)
+        chunk = next(stream_images(image_dir, chunk_rows=8))
+        assert all(np.asarray(v["data"]).shape == (32, 32, 3)
+                   for v in chunk["image"])
+        # explicit host resize: the legacy host-preprocess wire form
+        resized = next(stream_images(image_dir, chunk_rows=8,
+                                     resize=(16, 12)))
+        assert all(np.asarray(v["data"]).shape == (16, 12, 3)
+                   for v in resized["image"])
+        # one-shot reader grows the same explicit opt-in
+        full = read_images(image_dir, resize=(8, 8))
+        assert all(np.asarray(v["data"]).shape == (8, 8, 3)
+                   for v in full["image"])
+
     def test_sharded_streams_are_disjoint(self, image_dir):
         a = [p for c in stream_binary_files(image_dir, num_shards=2,
                                             shard_index=0, chunk_rows=8)

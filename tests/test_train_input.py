@@ -223,7 +223,18 @@ class TestLoaderLifecycle:
         assert 0.0 <= s["input_bound_fraction"] <= 1.0
         assert set(s) == {"prefetch_depth", "batches", "committed_ahead_max",
                           "input_wait_s", "step_s", "input_bound_fraction",
-                          "assemble_s", "commit_s"}
+                          "assemble_s", "commit_s", "wire_mb"}
+
+    def test_wire_bytes_track_the_committed_payload(self):
+        # the wire-format observable of the thin-wire A/B: uint8 items
+        # ship ¼ the bytes of the same-shaped f32 items
+        items_u8 = [np.zeros((4, 8), np.uint8) for _ in range(3)]
+        items_f32 = [np.zeros((4, 8), np.float32) for _ in range(3)]
+        for items, expect in ((items_u8, 3 * 32), (items_f32, 3 * 128)):
+            ld = DeviceLoader(iter(items), lambda v: v, depth=0,
+                              name="t-wire")
+            list(ld)
+            assert ld.wire_bytes == expect
 
 
 class TestTrainerShutdown:

@@ -32,6 +32,16 @@ jax initialization) catching the mistakes that cost the most on TPU:
   through the bounded in-flight window and drain the *oldest* entry (or
   fetch after the loop) — the discipline of
   ``mmlspark_tpu/serve/batcher.py``.
+* **JX107 host-side image work under a device-preprocess spec** —
+  ``imgops.resize``/any ``cv2.*`` call/PIL decode (``Image.open``,
+  ``decode_image``) inside a train step loop or inside a function fed to
+  a ``DeviceLoader`` as its source, in a module that uses
+  ``DevicePreprocess`` (the static stand-in for "a device-preprocess
+  spec is active"): the spec already replays geometry inside the jitted
+  step, so host image work in the input path burns producer-thread time
+  AND fattens the wire (f32/resized pixels instead of thin uint8).
+  Ship source-resolution uint8 and let ``train/preprocess.py`` do the
+  geometry on device.
 
 The JX2xx family is the AST face of the SPMD verifier
 (``mmlspark_tpu/analysis/spmd.py`` — which checks the same hazards
@@ -102,6 +112,10 @@ RULES = {
     "JX106": "blocking device fetch on a dispatched batch inside a serve "
              "dispatch loop; drain through the bounded in-flight window "
              "(or after the loop)",
+    "JX107": "host-side image work in a train step loop or DeviceLoader "
+             "producer while a device-preprocess spec is active; ship "
+             "thin uint8 and replay the geometry on device "
+             "(train/preprocess.py)",
     "JX201": "collective under data-dependent control flow (lax.cond/"
              "switch/while_loop); hoist it out — hosts that disagree on "
              "the predicate deadlock",
@@ -129,9 +143,34 @@ _COND_CALLS = {"cond", "switch", "while_loop"}
 # the callee-name hint marking a train-step call whose outputs JX105 tracks
 _STEP_HINT = "step"
 
+# PIL-style decode roots for JX107 (cv2 is matched as a whole namespace)
+_PIL_ROOTS = {"Image", "PIL"}
+
 
 def _is_step_call(name: str) -> bool:
     return _STEP_HINT in name.lower()
+
+
+def _host_image_call(node: ast.Call) -> str | None:
+    """JX107's needle: a host-side image decode/geometry call —
+    ``imgops.resize``, any ``cv2.*``, PIL ``Image.open``, or the
+    readers' ``decode_image`` helper. Returns the spelled call or
+    None."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        root = func.value
+        while isinstance(root, ast.Attribute):
+            root = root.value
+        root_name = root.id if isinstance(root, ast.Name) else None
+        if root_name == "cv2":
+            return f"cv2.{func.attr}"
+        if func.attr == "resize" and root_name == "imgops":
+            return "imgops.resize"
+        if func.attr in ("open", "imdecode") and root_name in _PIL_ROOTS:
+            return f"{root_name}.{func.attr}"
+    if isinstance(func, ast.Name) and func.id == "decode_image":
+        return "decode_image"
+    return None
 
 
 def _is_dispatch_call(name: str) -> bool:
@@ -228,6 +267,7 @@ class _Linter(ast.NodeVisitor):
         self.jitted_names: set[str] = set()
         self.jitted_lambdas: list[ast.Lambda] = []
         self.func_defs: dict[str, ast.AST] = {}
+        self.uses_device_preprocess = False
 
     # -- pass 1 collects jit targets + local defs; pass 2 walks bodies --
 
@@ -244,6 +284,17 @@ class _Linter(ast.NodeVisitor):
                 # JX201/JX203/JX204 resolve branch/body callables by name;
                 # later definitions shadow earlier ones, as at runtime
                 self.func_defs[node.name] = node
+            # JX107 fires only when the module actually engages the
+            # device-preprocess layer — the static stand-in for "a spec
+            # is active" (an import or any mention of DevicePreprocess)
+            if (isinstance(node, ast.Name)
+                    and node.id == "DevicePreprocess") or (
+                    isinstance(node, ast.Attribute)
+                    and node.attr == "DevicePreprocess") or (
+                    isinstance(node, ast.ImportFrom)
+                    and any(a.name == "DevicePreprocess"
+                            for a in node.names)):
+                self.uses_device_preprocess = True
 
     def _emit(self, node: ast.AST, rule: str, message: str) -> None:
         line = getattr(node, "lineno", 0)
@@ -275,9 +326,31 @@ class _Linter(ast.NodeVisitor):
                               "a dispatched batch",
                               "inside the serve dispatch loop",
                               flag_np=True)
+        # JX107: host image work in a loop that dispatches train steps,
+        # in a module where a device-preprocess spec is active
+        if self.uses_device_preprocess:
+            has_step = any(
+                isinstance(sub, ast.Call)
+                and (name := _callee_name(sub.func)) is not None
+                and _is_step_call(name)
+                for sub in ast.walk(node))
+            if has_step:
+                self._lint_host_image_calls(node, "the train step loop")
         self.loop_depth += 1
         self.generic_visit(node)
         self.loop_depth -= 1
+
+    def _lint_host_image_calls(self, scope: ast.AST, where: str) -> None:
+        for sub in ast.walk(scope):
+            if isinstance(sub, ast.Call):
+                spelled = _host_image_call(sub)
+                if spelled is not None:
+                    self._emit(sub, "JX107",
+                               f"{spelled}() runs host-side image work "
+                               f"in {where} while a device-preprocess "
+                               "spec is active; ship thin uint8 and "
+                               "replay the geometry on device "
+                               "(train/preprocess.py)")
 
     # -- JX105 / JX106: blocking fetches on pipelined outputs in a loop --
 
@@ -410,6 +483,18 @@ class _Linter(ast.NodeVisitor):
         # JX203/JX204: shard_map contract checks at the shim call site
         if callee == "shard_map":
             self._lint_shard_map_site(node)
+        # JX107 (producer face): host image work inside the function fed
+        # to a DeviceLoader as its batch source — that function IS the
+        # train input path, loop or not
+        if callee == "DeviceLoader" and self.uses_device_preprocess \
+                and node.args:
+            src = node.args[0]
+            if isinstance(src, ast.Call):  # DeviceLoader(batches(), ...)
+                src = src.func
+            body = self._resolve_callable(src)
+            if body is not None:
+                self._lint_host_image_calls(
+                    body, "a DeviceLoader producer")
         self.generic_visit(node)
 
     # -- JX201/JX203/JX204 helpers --

@@ -14,6 +14,19 @@ flake on a loaded CI box):
   consumption: ``committed_ahead_max >= prefetch_depth``, every batch
   flows through exactly once, and the input-wait/step-time decomposition
   is reported.
+* **train device preprocessing** — the thin-wire on-device preprocessing
+  layer (``train/preprocess.py``) at FULL augmentation
+  (pad-crop/flip/brightness/contrast fused into the jitted step) must
+  ship ≥ 4× fewer H2D image-payload bytes than the host-preprocess
+  baseline — measured at the obs registry byte counters behind the same
+  ``core/plan`` seam ``count_crossings`` patches, so the numbers are
+  deterministic counts, not wall clock — with loss histories equal to
+  ≤ 1e-5 across the two wire forms (the stochastic draws fold from the
+  global step, so both runs augment identically), exactly ONE compiled
+  step program per input shape, a bit-reproducible resume from a
+  mid-epoch checkpoint (the PRNG-fold correctness observable), and the
+  Pallas fused-geometry kernel pinned ≤ 1 ULP equal to its pure-XLA
+  reference in CPU interpret mode.
 * **serve dynamic batching** — a burst of concurrent single-row requests
   through the model server compiles at most ``len(buckets)`` programs
   (bucket quantization holds: no per-shape recompile, counted at the
@@ -169,6 +182,181 @@ def check_train_prefetch() -> dict:
         "input_bound_fraction": stats["input_bound_fraction"],
         "input_wait_s": stats["input_wait_s"],
         "step_s": stats["step_s"],
+    }
+
+
+def check_train_device_preprocess(min_reduction: float = 4.0) -> dict:
+    """Full-augment thin-wire training vs the host-preprocess baseline;
+    raise AssertionError unless the device path ships ≥ ``min_reduction``×
+    fewer H2D image bytes with loss parity, one program per input shape,
+    and a bit-reproducible mid-epoch resume.
+
+    Both runs carry the SAME DevicePreprocess spec: the device run ships
+    raw uint8 and does geometry+normalize+augment in-step; the host run
+    feeds ``host_preprocess`` f32 (the float-input convention skips the
+    in-step geometry/normalize) so the stochastic stages still execute
+    identically on device — the A/B differs ONLY in the wire form, which
+    is exactly what the byte gate prices. Bytes are read from the obs
+    registry counter at the ``core/plan.train_commit`` seam; the known
+    label/weight payload (identical across the A/B) is subtracted so the
+    ratio prices the image payload the preprocessing layer owns."""
+    import glob
+    import tempfile
+
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.models.zoo import ConvNetCifar
+    from mmlspark_tpu.obs import runtime as obs_rt
+    from mmlspark_tpu.ops.pallas.resize import fused_resize_norm
+    from mmlspark_tpu.train.loop import TrainConfig, Trainer
+    from mmlspark_tpu.train.preprocess import (
+        DevicePreprocess, host_preprocess,
+    )
+
+    n, bs, side = 640, 32, 32
+    steps = n // bs
+    rng = np.random.default_rng(0)
+    x_u8 = rng.integers(0, 256, (n, side, side, 3)).astype(np.uint8)
+    y = rng.integers(0, 10, n).astype(np.int64)
+    spec = DevicePreprocess(crop_pad=4, flip_lr=True, brightness=0.1,
+                            contrast=(0.9, 1.1))
+
+    def module():
+        return ConvNetCifar(num_classes=10, widths=(4, 8), dense_width=16)
+
+    def cfg(**kw):
+        return TrainConfig(batch_size=bs, epochs=1, optimizer="momentum",
+                           learning_rate=0.01, log_every=1,
+                           prefetch_depth=2, preprocess=spec, seed=0, **kw)
+
+    # the label/weight payload both wire forms ship identically per step:
+    # y int64 + the 0/1 f32 mask vector
+    aux_bytes = steps * bs * (y.dtype.itemsize + 4)
+
+    obs.disable()
+    obs.clear()
+    obs.registry().reset()
+    obs.enable()
+    runs: dict = {}
+    try:
+        for label, data in (("device_thin", x_u8),
+                            ("host_f32",
+                             host_preprocess(spec, x_u8, 1.0 / 255.0))):
+            obs.registry().reset()
+            tr = Trainer(module(), cfg())
+            tr.fit_arrays(data, y)
+            total = int(obs.registry().value("plan.h2d_bytes") or 0)
+            runs[label] = {
+                "h2d_bytes": total,
+                "x_bytes": total - aux_bytes,
+                "x_bytes_expected": steps * bs * int(
+                    np.prod(data.shape[1:])) * data.dtype.itemsize,
+                "programs": obs_rt.jit_cache_size(tr.step_masked),
+                "input_bound_fraction":
+                    tr.input_stats["input_bound_fraction"],
+                "wire_mb": tr.input_stats["wire_mb"],
+                "history": tr.history,
+                "params": tr.params,
+            }
+        for label, run in runs.items():
+            assert run["x_bytes"] == run["x_bytes_expected"], (
+                f"{label}: observed {run['x_bytes']} image-payload bytes "
+                f"at the train_commit seam, expected "
+                f"{run['x_bytes_expected']} — the registry byte counter "
+                "and the commit path disagree")
+            assert run["programs"] is None or run["programs"] == 1, (
+                f"{label}: {run['programs']} step programs compiled for "
+                "ONE input shape — the fused preprocess is recompiling")
+        reduction = runs["host_f32"]["x_bytes"] / runs[
+            "device_thin"]["x_bytes"]
+        assert reduction >= min_reduction, (
+            f"thin-wire H2D image bytes only {reduction:.2f}x below the "
+            f"host-preprocess baseline ({runs['device_thin']['x_bytes']} "
+            f"vs {runs['host_f32']['x_bytes']}) — the uint8 wire "
+            "convention regressed")
+        hist_dev = np.asarray(runs["device_thin"]["history"])
+        hist_host = np.asarray(runs["host_f32"]["history"])
+        max_diff = float(np.abs(hist_dev - hist_host).max())
+        assert hist_dev.shape == hist_host.shape and max_diff <= 1e-5, (
+            f"device-thin vs host-preprocessed loss histories diverge by "
+            f"{max_diff} (> 1e-5) — the two wire forms are not replaying "
+            "the same preprocessing")
+
+        # ---- bit-reproducible resume: crash past a mid-epoch
+        #      checkpoint, resume fresh, and the remaining steps replay
+        #      the EXACT augmentation stream (keys fold from the
+        #      checkpointed global step) ----
+        ck_dir = tempfile.mkdtemp(prefix="pp_resume_")
+        cfg_ck = cfg(checkpoint_dir=ck_dir, checkpoint_every=7)
+        tr1 = Trainer(module(), cfg_ck)
+        real_step, calls = tr1.step_masked, {"n": 0}
+
+        def preempted(state, bx, by, bw):
+            calls["n"] += 1
+            if calls["n"] > 10:
+                raise RuntimeError("induced preemption")
+            return real_step(state, bx, by, bw)
+
+        tr1.step_masked = preempted
+        try:
+            tr1.fit_arrays(x_u8, y)
+            raise AssertionError("induced preemption never fired")
+        except RuntimeError:
+            pass
+        assert glob.glob(os.path.join(ck_dir, "*")), (
+            "no checkpoint written before the induced preemption")
+        tr2 = Trainer(module(), cfg_ck)
+        tr2.fit_arrays(x_u8, y)
+        # died at step 11 → latest checkpoint step 7 → resume replays
+        # batches 1-7 as no-ops and trains 8..20; history and final
+        # params must be BIT-identical to the uninterrupted run
+        resumed_tail = runs["device_thin"]["history"][7:]
+        assert tr2.history == resumed_tail, (
+            "resumed loss history differs from the uninterrupted run — "
+            f"the per-step PRNG fold is not replaying: {tr2.history[:3]} "
+            f"vs {resumed_tail[:3]}")
+        import jax
+        for a, b in zip(jax.tree_util.tree_leaves(tr2.params),
+                        jax.tree_util.tree_leaves(
+                            runs["device_thin"]["params"])):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), (
+                "resumed params are not bit-identical to the "
+                "uninterrupted run")
+
+        # ---- Pallas fused-geometry kernel ≤ 1 ULP from the pure-XLA
+        #      reference, in CPU interpret mode, inside jit (the context
+        #      the step uses) ----
+        import jax as _jax
+        src = rng.integers(0, 256, (6, 24, 20, 3)).astype(np.uint8)
+        oy = rng.integers(0, 5, 6).astype(np.int32)
+        ox = rng.integers(0, 5, 6).astype(np.int32)
+
+        def run_impl(impl):
+            fn = _jax.jit(lambda a, b, c: fused_resize_norm(
+                a, b, c, (20, 16), (8, 8), 1.0 / 255.0, impl=impl))
+            return np.asarray(fn(src, oy, ox))
+
+        np.testing.assert_array_max_ulp(run_impl("xla"),
+                                        run_impl("pallas"), maxulp=1)
+    finally:
+        obs.disable()
+        obs.clear()
+        obs.registry().reset()
+
+    return {
+        "steps": steps,
+        "batch_size": bs,
+        "min_reduction": min_reduction,
+        "h2d_x_bytes_thin": runs["device_thin"]["x_bytes"],
+        "h2d_x_bytes_host": runs["host_f32"]["x_bytes"],
+        "h2d_reduction": round(reduction, 3),
+        "wire_mb_thin": runs["device_thin"]["wire_mb"],
+        "wire_mb_host": runs["host_f32"]["wire_mb"],
+        "programs_thin": runs["device_thin"]["programs"],
+        "loss_history_max_diff": max_diff,
+        "input_bound_fraction":
+            runs["device_thin"]["input_bound_fraction"],
+        "resume_history_len": len(tr2.history),
+        "kernel_max_ulp": 1,
     }
 
 
@@ -874,6 +1062,7 @@ def main() -> int:
     try:
         result = check_fused_crossings()
         train = check_train_prefetch()
+        train_pp = check_train_device_preprocess()
         serve = check_serve_batching()
         serve_sharded = check_serve_sharded()
         obs_overhead = check_obs_overhead()
@@ -884,7 +1073,9 @@ def main() -> int:
         print(json.dumps({"perf_smoke": "FAIL", "reason": str(e)}))
         return 1
     print(json.dumps({"perf_smoke": "OK", **result,
-                      "train_prefetch": train, "serve": serve,
+                      "train_prefetch": train,
+                      "train_device_preprocess": train_pp,
+                      "serve": serve,
                       "serve_sharded": serve_sharded,
                       "obs_overhead": obs_overhead,
                       "obs_request_tracing": obs_tracing,
