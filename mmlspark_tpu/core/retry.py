@@ -1,0 +1,101 @@
+"""Typed retry policy — bounded attempts with jittered exponential backoff.
+
+Transient faults (a flaky network fetch during a model-zoo pull, a worker
+process dying under a preemption storm) should cost a bounded, observable
+retry loop, not an aborted run. :class:`RetryPolicy` is the ONE place the
+backoff arithmetic lives: the model downloader retries fetches through it
+(``data/downloader.py``) and the training service supervisor paces worker
+restarts with the same schedule (``train/service.py``) — one policy type,
+two very different fault domains.
+
+Jitter is full-range (each delay is drawn uniformly from
+``[delay * (1 - jitter), delay]``), the standard decorrelation against
+thundering-herd retries (many workers hitting the same recovered endpoint
+in lockstep). The draw comes from a caller-suppliable ``random.Random`` so
+tests pin the schedule without patching the module.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Any, Callable, Iterator
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """How many times to retry, how long to wait, and what is retryable.
+
+    ``max_attempts`` counts TOTAL tries (1 = no retry). Delays grow
+    ``base_delay_s * multiplier**k`` capped at ``max_delay_s``; ``jitter``
+    is the fraction of each delay randomized away (0 = deterministic,
+    0.5 = drawn from ``[0.5d, d]``). ``retry_on`` is the exception tuple
+    a failure must match to be retried — anything else propagates
+    immediately (a typed validation error is not a transient fault).
+    ``retry_if`` (optional) refines the type match with a predicate:
+    the exception retries only when ``retry_if(exc)`` is true — how a
+    caller distinguishes a transient HTTP 503 from a permanent 404 when
+    both are ``OSError`` subclasses.
+    """
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.2
+    max_delay_s: float = 10.0
+    multiplier: float = 2.0
+    jitter: float = 0.5
+    retry_on: tuple[type[BaseException], ...] = (OSError,)
+    retry_if: Callable[[BaseException], bool] | None = None
+
+    def __post_init__(self) -> None:
+        if self.max_attempts < 1:
+            raise ValueError(
+                f"max_attempts must be >= 1, got {self.max_attempts}")
+        if self.base_delay_s < 0 or self.max_delay_s < 0:
+            raise ValueError("delays must be >= 0")
+        if self.multiplier < 1.0:
+            raise ValueError(
+                f"multiplier must be >= 1, got {self.multiplier} (a "
+                "shrinking backoff retries faster under sustained failure)")
+        if not 0.0 <= self.jitter <= 1.0:
+            raise ValueError(f"jitter must be in [0, 1], got {self.jitter}")
+
+    def delays(self, rng: random.Random | None = None) -> Iterator[float]:
+        """The backoff schedule: one delay per RETRY (``max_attempts - 1``
+        values), jittered."""
+        rng = rng or random
+        for k in range(self.max_attempts - 1):
+            d = min(self.base_delay_s * self.multiplier ** k,
+                    self.max_delay_s)
+            if self.jitter:
+                d *= 1.0 - self.jitter * rng.random()
+            yield d
+
+
+def call_with_retry(fn: Callable[[], Any], policy: RetryPolicy,
+                    on_retry: Callable[[int, BaseException, float], None]
+                    | None = None,
+                    sleep: Callable[[float], None] = time.sleep,
+                    rng: random.Random | None = None) -> Any:
+    """Call ``fn`` under ``policy``; returns its value or raises the LAST
+    failure once attempts are exhausted.
+
+    ``on_retry(attempt, exc, delay_s)`` fires before each backoff sleep —
+    the hook call sites use to log and bump their retry counters (e.g.
+    the downloader's ``data.fetch_retries``). A failure not matching
+    ``policy.retry_on`` propagates without consuming attempts.
+    """
+    delays = policy.delays(rng)
+    for attempt in range(1, policy.max_attempts + 1):
+        try:
+            return fn()
+        except policy.retry_on as e:
+            if policy.retry_if is not None and not policy.retry_if(e):
+                raise  # type matched but the predicate says permanent
+            delay = next(delays, None)
+            if delay is None:  # attempts exhausted — the caller sees the
+                raise          # real failure, not a retry wrapper
+            if on_retry is not None:
+                on_retry(attempt, e, delay)
+            sleep(delay)
+    raise AssertionError("unreachable")  # pragma: no cover

@@ -23,8 +23,31 @@ from typing import Any, Iterable
 from mmlspark_tpu.core import config
 from mmlspark_tpu.core import fs as _fs
 from mmlspark_tpu.core.logging_utils import get_logger
+from mmlspark_tpu.core.retry import RetryPolicy, call_with_retry
+from mmlspark_tpu.obs import runtime as _obs_rt
+from mmlspark_tpu.obs.metrics import registry as _obs_registry
 
 _log = get_logger(__name__)
+
+# transient-fault tolerance for model pulls: a dropped connection or a
+# flaky shared filesystem during a supervised run's model-zoo fetch
+# retries with jittered exponential backoff instead of aborting the run.
+# urllib's URLError/HTTPError are OSError subclasses, so one tuple covers
+# both the HTTP and filesystem repository paths — but a 4xx HTTP status
+# is a PERMANENT answer (missing model, bad auth), not a transient
+# fault: retrying it only delays the real error
+
+
+def _transient_fetch_fault(exc: BaseException) -> bool:
+    import urllib.error
+    if isinstance(exc, urllib.error.HTTPError):
+        return exc.code >= 500  # 5xx/served errors may recover; 4xx won't
+    return True
+
+
+DEFAULT_FETCH_RETRY = RetryPolicy(max_attempts=3, base_delay_s=0.2,
+                                  max_delay_s=5.0, retry_on=(OSError,),
+                                  retry_if=_transient_fetch_fault)
 
 MANIFEST_NAME = "MANIFEST.json"
 
@@ -149,12 +172,14 @@ class ModelDownloader:
     """
 
     def __init__(self, repo: str | Repository | None = None,
-                 cache_dir: str | None = None):
+                 cache_dir: str | None = None,
+                 retry: RetryPolicy | None = DEFAULT_FETCH_RETRY):
         if repo is None:
             repo = config.get("model_repo_url") or ""
         self.repo = repo if isinstance(repo, Repository) else Repository(repo)
         self.cache_dir = cache_dir or os.path.join(
             config.get("cache_dir"), "models")
+        self.retry = retry
 
     def list_models(self) -> list[ModelSchema]:
         return self.repo.read_manifest()
@@ -205,12 +230,7 @@ class ModelDownloader:
             os.remove(dest)
         tmp = f"{dest}.tmp-{os.getpid()}-{threading.get_ident()}"
         try:
-            self.repo.fetch(schema, tmp)
-            actual = _sha256_file(tmp)
-            if schema.hash and actual != schema.hash:
-                raise IOError(
-                    f"model {schema.name!r}: sha256 mismatch "
-                    f"(manifest {schema.hash[:12]}…, got {actual[:12]}…)")
+            actual = self._fetch_with_retry(schema, tmp)
             os.replace(tmp, dest)  # atomic publication of the verified file
         finally:
             if os.path.exists(tmp):
@@ -218,6 +238,43 @@ class ModelDownloader:
         with open(sidecar, "w") as f:
             f.write(actual)
         return dest
+
+    def _fetch_with_retry(self, schema: ModelSchema, tmp: str) -> str:
+        """One fetch-and-verify under the retry policy; returns the
+        verified sha256. Transient faults (OSError family — dropped
+        connections, flaky mounts) back off with jitter and refetch into
+        the same private temp file (opened ``"wb"``, so a partial
+        previous attempt is truncated, never appended to). The hash
+        check is INSIDE the retried callable: a fault that corrupts
+        bytes without raising (a short/garbled read that still
+        completes) surfaces as the mismatch ``IOError`` and spends the
+        same retry budget as a dropped connection. Each retry logs and
+        bumps ``data.fetch_retries`` so a lossy link is visible in the
+        registry, not just slower."""
+
+        def fetch_and_verify() -> str:
+            self.repo.fetch(schema, tmp)
+            actual = _sha256_file(tmp)
+            if schema.hash and actual != schema.hash:
+                raise IOError(
+                    f"model {schema.name!r}: sha256 mismatch "
+                    f"(manifest {schema.hash[:12]}…, got {actual[:12]}…)")
+            return actual
+
+        if self.retry is None:
+            return fetch_and_verify()
+
+        def on_retry(attempt: int, exc: BaseException, delay: float) -> None:
+            _log.warning(
+                "fetch of model %s failed (attempt %d/%d: %s); retrying "
+                "in %.2fs", schema.name, attempt, self.retry.max_attempts,
+                exc, delay)
+            if _obs_rt._enabled:
+                _obs_registry().counter("data.fetch_retries",
+                                        model=schema.name).add()
+
+        return call_with_retry(fetch_and_verify, self.retry,
+                               on_retry=on_retry)
 
     def download_models(self, names: Iterable[str] | None = None) -> list[str]:
         schemas = self.list_models()

@@ -122,6 +122,18 @@ def default_mesh_spec(n_devices: int | None = None) -> MeshSpec:
     return MeshSpec(dp=-1)
 
 
+def single_device(mesh) -> Any | None:
+    """The 1-device fast-path criterion: the bare device when the mesh has
+    exactly one, else None. THE single source of truth — the train step's
+    plain-jit path, the Trainer's commit target, and the elastic reshard
+    targets (:func:`state_shardings`) must always agree, or batches
+    committed with a NamedSharding would feed a plain-jit program (or
+    vice versa)."""
+    if int(mesh.devices.size) == 1:
+        return mesh.devices.reshape(-1)[0]
+    return None
+
+
 def batch_sharding(mesh) -> Any:
     """Sharding for a [batch, ...] array: batch split over dp (and fsdp)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -184,3 +196,61 @@ def param_shardings(mesh, params, rules: Any = None) -> Any:
         return NamedSharding(mesh, P(*spec))
 
     return jax.tree_util.tree_map_with_path(one, params)
+
+
+def state_shardings(mesh, state: Mapping[str, Any],
+                    rules: Any = None) -> Any:
+    """Placement targets for a FULL train-state pytree
+    (``{params, opt_state, step}``) on ``mesh`` — the elastic-rescale
+    counterpart of the placement ``Trainer.init_state`` performs:
+
+    * ``params`` leaves place via :func:`param_shardings` (module
+      ``rules`` first, then the generic tp/fsdp rules),
+    * optimizer moments mirror the params: any subtree of a non-param
+      entry whose tree STRUCTURE equals the params tree (optax moments
+      — adam's ``mu``/``nu``, momentum's ``trace`` — are built by
+      ``tree_map`` over the params) takes the params shardings leaf for
+      leaf, exactly where eager ``zeros_like`` propagation put them at
+      init — including ``rules``-placed leaves (MoE expert stacks over
+      ``ep``),
+    * remaining leaves place by the generic per-leaf rule on their own
+      shape; scalar leaves (optax step counts) replicate,
+    * a 1-device mesh returns the bare device for every leaf (the
+      plain-placement fast path ``single_device`` defines).
+
+    This is what makes ``reshard_state`` (train/checkpoint.py) exact: a
+    state restored or re-placed through these targets is
+    indistinguishable from one built by ``init_state`` on the same mesh.
+    """
+    import jax
+
+    dev0 = single_device(mesh)
+    if dev0 is not None:
+        return jax.tree_util.tree_map(lambda leaf: dev0, state)
+    placed = dict(state)
+    params_sh = param_shardings(mesh, state["params"], rules=rules)
+    placed["params"] = params_sh
+    p_treedef = jax.tree_util.tree_structure(state["params"])
+    repl = replicated(mesh)
+
+    # bare-leaf params would make every leaf "mirror" them (a scalar
+    # optax count included) — mirroring only means anything for a real
+    # params CONTAINER
+    leaf_def = jax.tree_util.tree_structure(0)
+
+    def mirrors_params(node) -> bool:
+        return (p_treedef != leaf_def
+                and jax.tree_util.tree_structure(node) == p_treedef)
+
+    def one(node):
+        if mirrors_params(node):  # a params-shaped moment subtree
+            return params_sh
+        if getattr(node, "shape", ()):
+            return param_shardings(mesh, {"leaf": node})["leaf"]
+        return repl
+
+    for key in state:
+        if key != "params":
+            placed[key] = jax.tree_util.tree_map(
+                one, state[key], is_leaf=mirrors_params)
+    return placed

@@ -1,20 +1,32 @@
 """Training layer: in-process distributed train loops, one-call trainers,
-checkpoint/resume, and the JaxLearner estimator.
+checkpoint/resume, the JaxLearner estimator, and the elastic
+fault-tolerant training service (supervisor + recovery policies).
 
 Replaces the reference's out-of-process ``mpiexec cntk`` training
 (reference: cntk-train/src/main/scala/CNTKLearner.scala:52-162) with
-jit-compiled steps sharded over a device mesh.
+jit-compiled steps sharded over a device mesh — and its single
+exit-code check with supervised recovery: restart from checkpoint,
+straggler eviction, and elastic re-scale onto surviving topology
+(``train/service.py``, docs/training_service.md).
 """
 
-from mmlspark_tpu.train.checkpoint import TrainCheckpointer
+from mmlspark_tpu.train.checkpoint import (
+    CheckpointCorruptError, TrainCheckpointer, reshard_state,
+)
 from mmlspark_tpu.train.input import DeviceLoader
 from mmlspark_tpu.train.learner import JaxLearner, JaxLearnerModel
 from mmlspark_tpu.train.loop import TrainConfig, Trainer, make_train_step
 from mmlspark_tpu.train.preprocess import (
     DevicePreprocess, envelope_batch, host_preprocess,
 )
+from mmlspark_tpu.train.service import (
+    RecoveryPolicy, ServiceConfig, Topology, TrainSupervisor,
+    elastic_stream, service_context,
+)
 
-__all__ = ["DeviceLoader", "DevicePreprocess", "JaxLearner",
-           "JaxLearnerModel", "TrainCheckpointer", "TrainConfig",
-           "Trainer", "envelope_batch", "host_preprocess",
-           "make_train_step"]
+__all__ = ["CheckpointCorruptError", "DeviceLoader", "DevicePreprocess",
+           "JaxLearner", "JaxLearnerModel", "RecoveryPolicy",
+           "ServiceConfig", "Topology", "TrainCheckpointer",
+           "TrainConfig", "Trainer", "TrainSupervisor", "elastic_stream",
+           "envelope_batch", "host_preprocess", "make_train_step",
+           "reshard_state", "service_context"]

@@ -11,16 +11,79 @@ restore replaces elastic MPI rings).
 State = a pure pytree {params, opt_state, step}; storage = Orbax
 (tensorstore-backed, async-capable, multi-host-aware). A manifest tracks
 steps so ``latest_step``/``max_to_keep`` work without globbing internals.
+
+Integrity: every saved step records a content digest in the manifest; a
+restore validates it, and a torn/corrupt step directory (a worker killed
+mid-write, a truncated leaf file) falls back to the previous manifest
+step with a typed :class:`CheckpointCorruptError` event instead of
+crashing the recovery that needed the checkpoint most. Pruning rewrites
+the manifest BEFORE deleting directories, so a crash mid-GC leaves a
+restorable manifest (orphan directories are swept on the next save).
+
+Elastic topology change: :func:`reshard_state` re-places a live state
+pytree onto a different mesh (the in-process path), and a checkpoint
+restored with a target built on the NEW mesh reshards on read (the
+cross-process path the training service supervisor uses — every leaf
+restores straight to the new topology's shardings).
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import shutil
 from typing import Any
 
 import numpy as np
+
+from mmlspark_tpu.core.logging_utils import get_logger
+from mmlspark_tpu.obs import runtime as _obs_rt
+from mmlspark_tpu.obs.metrics import registry as _obs_registry
+from mmlspark_tpu.obs.spans import event as _obs_event
+
+_log = get_logger(__name__)
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint step directory failed integrity validation (missing
+    dir, truncated/altered leaf file, digest mismatch). Carries the step
+    and reason so recovery tooling can report WHICH checkpoint was torn
+    without re-probing the tree."""
+
+    def __init__(self, directory: str, step: int | None, reason: str):
+        self.directory = directory
+        self.step = step
+        self.reason = reason
+        super().__init__(
+            f"checkpoint step {step} under {directory} is corrupt: "
+            f"{reason}")
+
+
+def _dir_digest(path: str) -> str:
+    """sha256 over the step directory's file tree: sorted relative paths,
+    sizes, and contents. Any torn write — a truncated leaf, a missing
+    shard file, a renamed dir entry — changes the digest.
+
+    Cost note: this re-reads the step tree once at save (primary only)
+    and once per validated restore (primary only in multi-host — the
+    consensus path broadcasts the verdict). For checkpoints where a full
+    re-read per save is too expensive, the right evolution is hashing
+    shards as they stream out; the manifest format (``digests[step]``)
+    already accommodates any digest definition."""
+    h = hashlib.sha256()
+    # sorted() exhausts the walk before hashing, so ordering comes from
+    # sorting the (root, dirs, files) tuples by root path
+    for root, _dirs, files in sorted(os.walk(path)):
+        for name in sorted(files):
+            fp = os.path.join(root, name)
+            rel = os.path.relpath(fp, path)
+            h.update(rel.encode())
+            h.update(str(os.path.getsize(fp)).encode())
+            with open(fp, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+    return h.hexdigest()
 
 
 class TrainCheckpointer:
@@ -62,6 +125,35 @@ class TrainCheckpointer:
 
     def _step_dir(self, step: int) -> str:
         return os.path.join(self.directory, f"step_{step}")
+
+    # -- integrity --
+
+    def verify_step(self, step: int) -> str | None:
+        """Validate one step against its recorded digest; returns None
+        when intact, else the human-readable corruption reason. Steps
+        saved before digests were recorded (no manifest entry) validate
+        as intact-if-present — the pre-digest behavior."""
+        path = self._step_dir(step)
+        if not os.path.isdir(path):
+            return "step directory is missing"
+        recorded = self._read_manifest().get("digests", {}).get(str(step))
+        if recorded is None:
+            return None
+        actual = _dir_digest(path)
+        if actual != recorded:
+            return (f"content digest mismatch (recorded "
+                    f"{recorded[:12]}…, got {actual[:12]}…)")
+        return None
+
+    def _record_corrupt(self, step: int, reason: str) -> None:
+        _log.warning("checkpoint step %d under %s is corrupt (%s); "
+                     "falling back to the previous manifest step",
+                     step, self.directory, reason)
+        if _obs_rt._enabled:
+            _obs_registry().counter("train.checkpoint_corrupt").add()
+            _obs_event("train/checkpoint_corrupt", "train",
+                       {"directory": self.directory, "step": int(step),
+                        "reason": reason})
 
     # -- save/restore --
 
@@ -107,25 +199,116 @@ class TrainCheckpointer:
             if step not in m["steps"]:
                 m["steps"].append(step)
             m["steps"].sort()
+            # the torn-save detector: a digest over the committed tree,
+            # recorded in the manifest the restore path validates against
+            m.setdefault("digests", {})[str(step)] = _dir_digest(path)
+            # crash-safe pruning: commit the manifest WITHOUT the dropped
+            # steps FIRST, then delete — dying between the two leaves
+            # orphan directories (swept below on the next save), never a
+            # manifest pointing at deleted checkpoints
+            drop = []
             while len(m["steps"]) > self.max_to_keep:
                 old = m["steps"].pop(0)
-                shutil.rmtree(self._step_dir(old), ignore_errors=True)
+                m["digests"].pop(str(old), None)
+                drop.append(old)
             self._write_manifest(m)
+            for old in drop:
+                shutil.rmtree(self._step_dir(old), ignore_errors=True)
+            self._sweep_orphans(m)
         return step
+
+    def _sweep_orphans(self, m: dict[str, Any]) -> None:
+        """Delete ``step_*`` dirs the manifest no longer references —
+        the leftovers of a crash between manifest rewrite and delete."""
+        keep = {f"step_{s}" for s in m["steps"]}
+        try:
+            entries = os.listdir(self.directory)
+        except OSError:  # pragma: no cover - directory vanished
+            return
+        for name in entries:
+            if name.startswith("step_") and name not in keep:
+                shutil.rmtree(os.path.join(self.directory, name),
+                              ignore_errors=True)
 
     def restore(self, step: int | None = None,
                 target: Any = None) -> Any:
         """Restore a state pytree. ``target`` (a matching pytree) guides
         structure/dtypes AND shardings: each leaf restores directly to the
-        target leaf's sharding (sharded restore, no host round-trip).
-        Without a target the raw tree is returned as host arrays."""
-        import orbax.checkpoint as ocp
+        target leaf's sharding (sharded restore, no host round-trip) —
+        including shardings on a DIFFERENT mesh than the save ran on,
+        which is how elastic recovery reshards onto a new topology.
 
-        if step is None:
-            step = self.latest_step()
-        if step is None:
+        With ``step=None`` (the recovery path), integrity validates the
+        latest manifest step first and falls back to the previous one on
+        corruption (typed ``train/checkpoint_corrupt`` event + counter);
+        only when EVERY manifest step is torn does the typed
+        :class:`CheckpointCorruptError` propagate. An explicitly
+        requested ``step`` never falls back — a caller naming a step
+        wants that step or a loud error."""
+        explicit = step is not None
+        if not explicit:
+            step = self._choose_step_consensus()
+        else:
+            why = self.verify_step(step)
+            if why is not None:
+                raise CheckpointCorruptError(self.directory, step, why)
+        return self._restore_step(step, target)
+
+    def _choose_step(self) -> int:
+        """The newest manifest step that passes digest validation;
+        raises on none at all / all torn."""
+        steps = self.steps()
+        if not steps:
             raise FileNotFoundError(
                 f"no checkpoints under {self.directory}")
+        reasons: list[str] = []
+        for cand in reversed(steps):
+            why = self.verify_step(cand)
+            if why is None:
+                return cand
+            self._record_corrupt(cand, why)
+            reasons.append(f"step {cand}: {why}")
+        raise CheckpointCorruptError(
+            self.directory, None,
+            "every manifest step failed validation ("
+            + "; ".join(reasons) + ")")
+
+    def _choose_step_consensus(self) -> int:
+        """Multi-host: the fallback step is chosen on the PRIMARY and
+        broadcast, mirroring ``save``'s primary-only manifest
+        discipline — per-process validation over a shared filesystem
+        with attribute-caching skew (NFS) could pick DIFFERENT surviving
+        steps on different hosts, and ranks entering the collective
+        program with states from different steps is silent training
+        corruption in exactly the recovery path this exists for. Also
+        keeps the full-tree digest read O(bytes), not O(world×bytes).
+        Single-process: just the local choice."""
+        import jax
+
+        if jax.process_count() <= 1:
+            return self._choose_step()
+        from jax.experimental import multihost_utils
+        chosen, primary_exc = -1, None
+        if jax.process_index() == 0:
+            try:
+                chosen = self._choose_step()
+            except (FileNotFoundError, CheckpointCorruptError) as e:
+                primary_exc = e  # cached: re-walking would double-fire
+                #                  the corrupt events/counters and the
+                #                  O(bytes) digest sweep
+        agreed = int(np.asarray(multihost_utils.broadcast_one_to_all(
+            np.asarray(chosen, np.int32))))
+        if agreed < 0:
+            if primary_exc is not None:
+                raise primary_exc
+            raise CheckpointCorruptError(
+                self.directory, None,
+                "primary found no restorable manifest step")
+        return agreed
+
+    def _restore_step(self, step: int, target: Any) -> Any:
+        import orbax.checkpoint as ocp
+
         path = self._step_dir(step)
         ckptr = ocp.StandardCheckpointer()
         if target is not None:
@@ -141,3 +324,68 @@ class TrainCheckpointer:
             return ckptr.restore(path,
                                  jax.tree_util.tree_map(abstract, target))
         return ckptr.restore(path)
+
+
+def reshard_state(state: Any, old_mesh: Any, new_mesh: Any,
+                  rules: Any = None, like: Any = None) -> Any:
+    """Re-place a live train-state pytree from ``old_mesh`` onto
+    ``new_mesh`` — the in-process half of elastic re-scale (a surviving
+    process re-forming its mesh after losing devices; the cross-process
+    half goes through a checkpoint restored with new-mesh targets).
+
+    Placement targets come from ``like`` (a reference state already on
+    ``new_mesh`` — e.g. a fresh ``Trainer.init_state``, byte-exact with
+    init placement) when given, else from
+    :func:`mmlspark_tpu.parallel.mesh.state_shardings` (``rules`` =
+    the module's ``param_rules`` for structurally special params).
+    Values are bit-preserved: each leaf round-trips through host memory
+    and lands under the new topology's shardings.
+
+    Requires every leaf to be fully addressable from this process (true
+    in-process; a multi-host global array is not — there, save +
+    restore-on-the-new-topology is the supported reshard path).
+    ``old_mesh`` is the provenance check: a state whose leaves live on
+    devices outside the mesh the caller believes it came from is flagged
+    loudly (the caller is probably resharding the WRONG trainer's
+    state), and the old→new transition is logged.
+    """
+    import jax
+    from jax.sharding import NamedSharding
+
+    from mmlspark_tpu.parallel import mesh as mesh_lib
+
+    if old_mesh is not None:
+        old_ids = {d.id for d in old_mesh.devices.reshape(-1)}
+        for leaf in jax.tree_util.tree_leaves(state):
+            sh = getattr(leaf, "sharding", None)
+            if isinstance(sh, NamedSharding):
+                leaf_ids = {d.id for d in sh.mesh.devices.reshape(-1)}
+                if not leaf_ids <= old_ids:
+                    _log.warning(
+                        "reshard_state: state leaves live on devices %s "
+                        "outside the declared old mesh %s — resharding "
+                        "a different trainer's state?",
+                        sorted(leaf_ids - old_ids), sorted(old_ids))
+                break  # one committed leaf answers for the tree
+        _log.info(
+            "reshard_state: %s -> %s",
+            dict(zip(old_mesh.axis_names, old_mesh.devices.shape)),
+            dict(zip(new_mesh.axis_names, new_mesh.devices.shape)))
+
+    targets = (jax.tree_util.tree_map(
+        lambda leaf: getattr(leaf, "sharding", leaf), like)
+        if like is not None
+        else mesh_lib.state_shardings(new_mesh, state, rules=rules))
+
+    def move(leaf, target):
+        if not hasattr(leaf, "shape"):
+            return leaf
+        if (hasattr(leaf, "is_fully_addressable")
+                and not leaf.is_fully_addressable):
+            raise ValueError(
+                "reshard_state needs fully-addressable leaves; a "
+                "multi-host global array reshards through "
+                "TrainCheckpointer.save + restore on the new topology")
+        return jax.device_put(np.asarray(leaf), target)
+
+    return jax.tree_util.tree_map(move, state, targets)

@@ -211,15 +211,12 @@ def make_loss(kind: str) -> Callable:
     return loss
 
 
-def single_device(mesh) -> Any | None:
-    """The 1-device fast-path criterion: the bare device when the mesh has
-    exactly one, else None. THE single source of truth — make_train_step's
-    plain-jit path and Trainer.data_target's commit target must always
-    agree, or batches committed with a NamedSharding would feed a
-    plain-jit program (or vice versa)."""
-    if int(mesh.devices.size) == 1:
-        return mesh.devices.reshape(-1)[0]
-    return None
+# THE 1-device fast-path criterion, shared with the elastic reshard
+# targets (parallel/mesh.state_shardings): make_train_step's plain-jit
+# path, Trainer.data_target's commit target, and reshard placement must
+# always agree, or batches committed with a NamedSharding would feed a
+# plain-jit program (or vice versa)
+single_device = mesh_lib.single_device
 
 
 def resolve_mesh_hooks(module: Any, mesh: Any) -> dict:
@@ -606,17 +603,56 @@ class Trainer:
                 "silently skip the wrong batches. Start a fresh "
                 "checkpoint_dir (or set resume=False) to train with a "
                 "changed dataset/batch_size/seed/epochs")
-        # restores directly to each target leaf's sharding
-        self.state = ckpt.restore(latest, target=self.state)
-        _log.info(f"resumed from checkpoint step {latest} "
+        # restores directly to each target leaf's sharding — the target
+        # was built by init_state on THIS trainer's mesh, so a checkpoint
+        # written on a different topology reshards on read (elastic
+        # recovery). step=None takes the integrity-validated path: a torn
+        # latest step falls back to the previous manifest step instead of
+        # crashing the recovery (train/checkpoint_corrupt event)
+        self.state = ckpt.restore(target=self.state)
+        restored = int(np.asarray(self.state["step"]))
+        _log.info(f"resumed from checkpoint step {restored} "
                   f"({self.cfg.checkpoint_dir})")
-        return latest
+        return restored
 
     def save_checkpoint(self) -> int | None:
         ckpt = self._checkpointer()
         if ckpt is None:
             return None
         return ckpt.save(self.state, fingerprint=self._fingerprint)
+
+    def rescale(self, mesh: Any = None, mesh_spec: Any = None) -> "Trainer":
+        """Re-form the training step on a new mesh and reshard live state
+        onto it — the in-process elastic path (surviving devices
+        re-forming after a topology change; the cross-process path
+        restores a checkpoint on the new topology instead).
+
+        The step/step_masked programs are rebuilt for the new mesh and
+        every state leaf is bit-preserved through
+        :func:`mmlspark_tpu.train.checkpoint.reshard_state`, so the next
+        ``fit_*`` call continues the schedule exactly where the old
+        topology left it. The schedule fingerprint is unchanged — which
+        also means the new data-parallel extent must keep the effective
+        batch size identical (it must still divide the configured batch),
+        or the resume-replay validation refuses loudly.
+        """
+        old_mesh = self.mesh
+        new_mesh = mesh if mesh is not None else mesh_lib.make_mesh(
+            mesh_spec if mesh_spec is not None else self.cfg.mesh_spec)
+        self.init_state, self.step, self.step_masked = make_train_step(
+            self.module, self.cfg, new_mesh)
+        self.mesh = new_mesh
+        if self.state is not None:
+            from mmlspark_tpu.train.checkpoint import reshard_state
+            hooks = resolve_mesh_hooks(self.module, new_mesh)
+            self.state = reshard_state(self.state, old_mesh, new_mesh,
+                                       rules=hooks["param_rules"])
+        if _obs_rt._enabled:
+            _obs_registry().counter("train.rescales").add()
+        _log.info("rescaled trainer mesh %s -> %s",
+                  dict(zip(old_mesh.axis_names, old_mesh.devices.shape)),
+                  dict(zip(new_mesh.axis_names, new_mesh.devices.shape)))
+        return self
 
     def fit_arrays(self, x: np.ndarray, y: np.ndarray) -> "Trainer":
         """Train on host arrays.

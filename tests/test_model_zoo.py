@@ -9,7 +9,7 @@ import numpy as np
 import pytest
 
 from mmlspark_tpu.data.downloader import (
-    ModelDownloader, load_bundle_file,
+    ModelDownloader, Repository, load_bundle_file,
 )
 from mmlspark_tpu.data.table import DataTable
 from mmlspark_tpu.models.image_featurizer import ImageFeaturizer
@@ -267,6 +267,146 @@ class TestConcurrentDownload:
         assert all(n == len(payload) for n in seen), (
             f"observed partial cache entries of sizes "
             f"{sorted(set(n for n in seen if n != len(payload)))}")
+
+
+class TestFetchRetry:
+    """Round-11 satellite: transient fetch faults during a model pull
+    retry with jittered exponential backoff (typed RetryPolicy) and bump
+    the ``data.fetch_retries`` counter, instead of aborting a supervised
+    run; non-transient failures and exhausted budgets still propagate."""
+
+    class _FlakyRepo(Repository):
+        """Repository whose fetch drops the connection (``fail_times``)
+        or silently delivers corrupted bytes (``corrupt_times``)."""
+
+        def __init__(self, root, fail_times=0, exc=ConnectionResetError,
+                     corrupt_times=0):
+            super().__init__(root)
+            self.fail_times = fail_times
+            self.exc = exc
+            self.corrupt_times = corrupt_times
+            self.attempts = 0
+
+        def fetch(self, schema, dest):
+            self.attempts += 1
+            if self.attempts <= self.corrupt_times:
+                # the fault that does NOT raise: a short/garbled read
+                # that still completes — only the hash check can see it
+                with open(dest, "wb") as f:
+                    f.write(b"garbled")
+                return dest
+            if self.attempts - self.corrupt_times <= self.fail_times:
+                # half-written partial before the fault: the retry must
+                # truncate it, never serve or append to it
+                with open(dest, "wb") as f:
+                    f.write(b"partial")
+                raise self.exc("link dropped")
+            return super().fetch(schema, dest)
+
+    def _flaky_downloader(self, tmp_path, fail_times, retry="fast",
+                          exc=ConnectionResetError):
+        from mmlspark_tpu.core.retry import RetryPolicy
+        repo, entry, _ = TestConcurrentDownload._tiny_repo(tmp_path)
+        flaky = self._FlakyRepo(repo, fail_times, exc=exc)
+        if retry == "fast":
+            retry = RetryPolicy(max_attempts=3, base_delay_s=0.0,
+                                jitter=0.0)
+        dl = ModelDownloader(flaky, cache_dir=str(tmp_path / "cache"),
+                             retry=retry)
+        return dl, flaky, entry
+
+    def test_transient_faults_retried_to_success(self, tmp_path):
+        import hashlib
+        dl, flaky, entry = self._flaky_downloader(tmp_path, fail_times=2)
+        path = dl.download(entry)
+        assert flaky.attempts == 3
+        with open(path, "rb") as f:
+            assert hashlib.sha256(f.read()).hexdigest() == entry.hash
+
+    def test_retry_counter_recorded_when_obs_enabled(self, tmp_path):
+        from mmlspark_tpu import obs
+        dl, flaky, entry = self._flaky_downloader(tmp_path, fail_times=2)
+        obs.disable()
+        obs.clear()
+        obs.registry().reset()
+        obs.enable()
+        try:
+            dl.download(entry)
+            assert obs.registry().value("data.fetch_retries",
+                                        model="tiny") == 2
+        finally:
+            obs.disable()
+            obs.clear()
+            obs.registry().reset()
+
+    def test_budget_exhausted_raises_real_error(self, tmp_path):
+        dl, flaky, entry = self._flaky_downloader(tmp_path, fail_times=5)
+        with pytest.raises(ConnectionResetError, match="link dropped"):
+            dl.download(entry)
+        assert flaky.attempts == 3  # max_attempts, not unbounded
+        # the failed pull never publishes a cache entry
+        assert not os.path.exists(dl._cache_path(entry))
+
+    def test_non_transient_error_not_retried(self, tmp_path):
+        dl, flaky, entry = self._flaky_downloader(
+            tmp_path, fail_times=5, exc=ValueError)
+        with pytest.raises(ValueError):
+            dl.download(entry)
+        assert flaky.attempts == 1
+
+    def test_corrupted_bytes_spend_the_same_retry_budget(self, tmp_path):
+        """A fault that corrupts bytes WITHOUT raising (garbled read
+        that completes) surfaces as the sha256-mismatch IOError inside
+        the retried callable — it must refetch like a dropped
+        connection, not abort the run with the budget unspent."""
+        import hashlib
+
+        from mmlspark_tpu.core.retry import RetryPolicy
+        repo, entry, _ = TestConcurrentDownload._tiny_repo(tmp_path)
+        flaky = self._FlakyRepo(repo, corrupt_times=1)
+        dl = ModelDownloader(flaky, cache_dir=str(tmp_path / "cache"),
+                             retry=RetryPolicy(max_attempts=3,
+                                               base_delay_s=0.0,
+                                               jitter=0.0))
+        path = dl.download(entry)
+        assert flaky.attempts == 2
+        with open(path, "rb") as f:
+            assert hashlib.sha256(f.read()).hexdigest() == entry.hash
+
+    def test_retry_none_disables(self, tmp_path):
+        dl, flaky, entry = self._flaky_downloader(tmp_path, fail_times=1,
+                                                  retry=None)
+        with pytest.raises(ConnectionResetError):
+            dl.download(entry)
+        assert flaky.attempts == 1
+
+    def test_http_permanent_4xx_not_retried_5xx_is(self, tmp_path):
+        """A 404/403 is a permanent answer — retrying only delays the
+        real error; a 5xx may recover and retries under the default
+        policy's predicate."""
+        import urllib.error
+
+        from mmlspark_tpu.data.downloader import DEFAULT_FETCH_RETRY
+
+        def http_err(code):
+            # a factory so _FlakyRepo can raise fresh instances
+            return lambda msg: urllib.error.HTTPError(
+                "http://repo/tiny.model", code, msg, None, None)
+
+        fast = DEFAULT_FETCH_RETRY.__class__(
+            max_attempts=3, base_delay_s=0.0, jitter=0.0,
+            retry_on=DEFAULT_FETCH_RETRY.retry_on,
+            retry_if=DEFAULT_FETCH_RETRY.retry_if)
+        for code, expected_attempts in ((404, 1), (503, 3)):
+            sub = tmp_path / f"http_{code}"
+            sub.mkdir()
+            dl, flaky, entry = self._flaky_downloader(
+                sub, fail_times=9, retry=fast, exc=http_err(code))
+            with pytest.raises(urllib.error.HTTPError):
+                dl.download(entry)
+            # 404 is permanent (no retries burned); 503 spends the budget
+            assert flaky.attempts == expected_attempts, (code,
+                                                         flaky.attempts)
 
 
 @pytest.mark.slow  # 224-scale full-size bundles

@@ -190,6 +190,80 @@ def test_worker_death_detected_and_resume_matches_uninterrupted(tmp_path):
                                                    rel=1e-6)
 
 
+def test_elastic_supervisor_rescales_world_and_resumes_bit_compatibly(
+        tmp_path):
+    """The elastic training service over a REAL multi-process world
+    (SURVEY §5 extended to topology change): generation 0 trains on
+    world=2 jax.distributed workers (2 virtual devices each, global mesh
+    dp=2×fsdp=2); rank 1 hard-dies with the preemption exit code
+    mid-stream. The supervisor must detect the loss (terminating the
+    survivor, no hang), archive the recovery snapshot, and re-scale to
+    world=1 (2 devices, dp=1×fsdp=2 — the survivors' mesh), where the
+    restore targets re-shard the checkpoint onto the new topology and
+    the deterministic elastic walk (train/service.elastic_stream) keeps
+    the global batch composition identical. Bit-compat pin: an
+    UNINTERRUPTED run at the surviving topology from the same snapshot
+    reproduces the elastic run's loss tail and final params exactly."""
+    import numpy as _np
+
+    from mmlspark_tpu.train.service import (
+        PREEMPT_EXIT_CODE, RecoveryPolicy, ServiceConfig, Topology,
+        TrainSupervisor,
+    )
+
+    worker_cmd = (sys.executable,
+                  os.path.join(REPO, "tools", "train_service.py"),
+                  "worker")
+    svc = str(tmp_path / "svc")
+    sup = TrainSupervisor(ServiceConfig(
+        cmd=worker_cmd, service_dir=svc,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        topologies=(Topology(world=2, devices=2),
+                    Topology(world=1, devices=2)),
+        policy=RecoveryPolicy(max_restarts=0),
+        grace_seconds=30.0,
+        # die AFTER the first liveness block: the multi-process producer
+        # eagerly pulls 1 (signature sync) + liveness_sync_every=8 chunks
+        # before the first step dispatches, so a smaller die point would
+        # preempt before any step ran or checkpoint landed
+        extra_env={"MMLSPARK_TPU_SERVICE_DIE_AT_STEP": "12",
+                   "MMLSPARK_TPU_SERVICE_DIE_GEN": "0",
+                   "MMLSPARK_TPU_SERVICE_DIE_RANK": "1"}))
+    report = sup.run()
+    assert report.ok, report.reason
+    assert report.rescales == 1 and report.evictions == 1
+    g0, g1 = report.generations
+    assert g0.signal.rank == 1 and g0.signal.code == PREEMPT_EXIT_CODE
+    assert (g1.topology.world, g1.topology.devices) == (1, 2)
+    with open(os.path.join(svc, "result_gen1_rank0.json")) as f:
+        elastic = json.load(f)
+    assert elastic["world"] == 1 and elastic["devices"] == 2
+    assert elastic["resumed"] >= 1
+
+    # uninterrupted continuation at the surviving topology from the
+    # recovery snapshot (no kill): same supervisor machinery, one rung
+    svc2 = str(tmp_path / "svc_control")
+    control_sup = TrainSupervisor(ServiceConfig(
+        cmd=worker_cmd, service_dir=svc2,
+        checkpoint_dir=report.snapshots[0],
+        topologies=(Topology(world=1, devices=2),),
+        grace_seconds=30.0))
+    assert control_sup.run().ok
+    with open(os.path.join(svc2, "result_gen0_rank0.json")) as f:
+        control = json.load(f)
+
+    assert elastic["steps"] == control["steps"]
+    assert elastic["history"] == control["history"], (
+        "elastic loss tail diverged from the uninterrupted continuation "
+        "at the surviving topology")
+    ep = _np.load(elastic["params_npz"])
+    cp = _np.load(control["params_npz"])
+    assert sorted(ep.files) == sorted(cp.files)
+    for key in ep.files:
+        assert _np.array_equal(ep[key], cp[key]), (
+            f"final params differ at {key}")
+
+
 SCORE_WORKER = os.path.join(REPO, "tests", "multihost_scoring_worker.py")
 
 

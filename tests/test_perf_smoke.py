@@ -15,7 +15,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
 from perf_smoke import (  # noqa: E402
     check_fused_crossings, check_flight_recorder, check_obs_overhead,
     check_obs_request_tracing, check_serve_batching, check_serve_sharded,
-    check_spmd_clean, check_train_device_preprocess, check_train_prefetch,
+    check_spmd_clean, check_train_device_preprocess, check_train_elastic,
+    check_train_prefetch,
 )
 
 
@@ -47,6 +48,25 @@ def test_train_device_preprocess_ships_thin_and_replays_exactly():
     assert result["programs_thin"] in (None, 1)
     assert result["resume_history_len"] == result["steps"] - 7
     assert result["wire_mb_thin"] < result["wire_mb_host"]
+
+
+def test_train_elastic_recovery_is_bit_compatible():
+    """Elastic fault tolerance (round 11): an induced worker kill on the
+    dryrun mesh is detected by the supervisor, policy re-scales onto the
+    surviving topology (8 -> 4 devices, fsdp state re-sharded from the
+    checkpoint), ingest stays on the deterministic elastic walk, and the
+    completed run's loss tail + final params are bit-identical to an
+    uninterrupted continuation at the surviving topology; dead workers'
+    heartbeat rows are forgotten and no service/loader threads leak."""
+    result = check_train_elastic()
+    assert result["generations"] == 2
+    assert result["rescales"] == 1 and result["evictions"] == 1
+    assert result["topology_survivors"] == {"world": 1, "devices": 4}
+    assert result["mesh_survivors"] == {"dp": 2, "fsdp": 2}
+    assert result["resumed_step"] >= 1
+    assert result["tail_max_diff"] == 0.0
+    assert result["params_bit_identical"] is True
+    assert "rescale" in result["decision_kinds"]
 
 
 def test_obs_disabled_path_overhead_bounded():

@@ -4,6 +4,8 @@ JaxLearner estimator (CNTKLearner analog — the ValidateCntkTrain mirror,
 run on the virtual 8-device CPU mesh like all 'distributed' reference tests
 run on local[*])."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -155,6 +157,138 @@ class TestCheckpointResume:
         tr = Trainer(MLP(features=(8,), num_outputs=2), cfg2)
         tr.state = tr.init_state(x.shape[1:])
         assert tr.maybe_restore() is None
+
+
+class TestCheckpointIntegrity:
+    """Round-11 hardening: torn/corrupt step dirs are detected by the
+    per-step digest and fall back to the previous manifest step; GC is
+    crash-safe (manifest rewritten BEFORE deletes)."""
+
+    @staticmethod
+    def _truncate_largest_leaf(step_dir):
+        import glob as _glob
+        files = [p for p in _glob.glob(os.path.join(step_dir, "**"),
+                                       recursive=True) if os.path.isfile(p)]
+        victim = max(files, key=os.path.getsize)
+        with open(victim, "r+b") as f:
+            f.truncate(max(os.path.getsize(victim) // 2, 1))
+        return victim
+
+    def _two_step_ckpt(self, tmp_path):
+        from mmlspark_tpu.train.checkpoint import TrainCheckpointer
+        ck = TrainCheckpointer(str(tmp_path / "ck"), max_to_keep=3)
+        for s in (1, 2):
+            ck.save({"w": np.full((64,), float(s), np.float32),
+                     "step": np.asarray(s, np.int32)}, step=s)
+        return ck
+
+    def test_truncated_leaf_falls_back_to_previous_step(self, tmp_path):
+        ck = self._two_step_ckpt(tmp_path)
+        self._truncate_largest_leaf(os.path.join(ck.directory, "step_2"))
+        assert ck.verify_step(2) is not None
+        assert ck.verify_step(1) is None
+        restored = ck.restore()  # recovery path: digest-validated
+        assert int(np.asarray(restored["step"])) == 1
+
+    def test_explicit_corrupt_step_raises_typed(self, tmp_path):
+        from mmlspark_tpu.train.checkpoint import CheckpointCorruptError
+        ck = self._two_step_ckpt(tmp_path)
+        self._truncate_largest_leaf(os.path.join(ck.directory, "step_2"))
+        with pytest.raises(CheckpointCorruptError, match="digest"):
+            ck.restore(step=2)
+
+    def test_all_steps_corrupt_raises_typed(self, tmp_path):
+        from mmlspark_tpu.train.checkpoint import CheckpointCorruptError
+        ck = self._two_step_ckpt(tmp_path)
+        for s in (1, 2):
+            self._truncate_largest_leaf(
+                os.path.join(ck.directory, f"step_{s}"))
+        with pytest.raises(CheckpointCorruptError, match="every manifest"):
+            ck.restore()
+
+    def test_missing_step_dir_falls_back(self, tmp_path):
+        import shutil as _shutil
+        ck = self._two_step_ckpt(tmp_path)
+        _shutil.rmtree(os.path.join(ck.directory, "step_2"))
+        restored = ck.restore()
+        assert int(np.asarray(restored["step"])) == 1
+
+    def test_corruption_records_event_and_counter(self, tmp_path):
+        from mmlspark_tpu import obs
+        ck = self._two_step_ckpt(tmp_path)
+        self._truncate_largest_leaf(os.path.join(ck.directory, "step_2"))
+        obs.disable()
+        obs.clear()
+        obs.registry().reset()
+        obs.enable()
+        try:
+            ck.restore()
+            assert obs.registry().value("train.checkpoint_corrupt") == 1
+            names = {getattr(r, "name", "") for r in obs.captured()}
+            assert "train/checkpoint_corrupt" in names
+        finally:
+            obs.disable()
+            obs.clear()
+            obs.registry().reset()
+
+    def test_gc_crash_between_manifest_and_delete_is_restorable(
+            self, tmp_path, monkeypatch):
+        """max_to_keep pruning interrupted between manifest rewrite and
+        directory delete must leave a restorable manifest (the manifest
+        commits FIRST; orphan dirs are swept by the next save)."""
+        import shutil as _shutil
+
+        from mmlspark_tpu.train import checkpoint as ckpt_mod
+        ck = ckpt_mod.TrainCheckpointer(str(tmp_path / "ck"),
+                                        max_to_keep=2)
+        for s in (1, 2):
+            ck.save({"w": np.full((8,), float(s), np.float32),
+                     "step": np.asarray(s, np.int32)}, step=s)
+
+        real_rmtree = _shutil.rmtree
+
+        def crash_on_prune(path, *a, **kw):
+            if os.path.basename(path) == "step_1":
+                raise RuntimeError("induced crash mid-GC")
+            return real_rmtree(path, *a, **kw)
+
+        monkeypatch.setattr(ckpt_mod.shutil, "rmtree", crash_on_prune)
+        with pytest.raises(RuntimeError, match="mid-GC"):
+            ck.save({"w": np.full((8,), 3.0, np.float32),
+                     "step": np.asarray(3, np.int32)}, step=3)
+        monkeypatch.undo()
+
+        # the manifest never points at the dropped step, and the latest
+        # checkpoint restores
+        assert ck.steps() == [2, 3]
+        restored = ck.restore()
+        assert int(np.asarray(restored["step"])) == 3
+        # the orphan dir from the interrupted delete is swept next save
+        assert os.path.isdir(os.path.join(ck.directory, "step_1"))
+        ck.save({"w": np.full((8,), 4.0, np.float32),
+                 "step": np.asarray(4, np.int32)}, step=4)
+        assert not os.path.exists(os.path.join(ck.directory, "step_1"))
+        assert ck.steps() == [3, 4]
+
+    def test_trainer_resumes_past_torn_latest(self, tmp_path):
+        """End-to-end: a fit whose LATEST checkpoint was torn by a crash
+        resumes from the previous one instead of dying mid-recovery."""
+        x, y = xor_data(128)
+        ckdir = str(tmp_path / "run")
+        cfg = TrainConfig(batch_size=32, epochs=2, checkpoint_dir=ckdir,
+                          checkpoint_every=2, seed=3, max_to_keep=4)
+        tr1 = Trainer(MLP(features=(16,), num_outputs=2), cfg)
+        tr1.fit_arrays(x, y)
+        from mmlspark_tpu.train.checkpoint import TrainCheckpointer
+        ck = TrainCheckpointer(ckdir)
+        latest = ck.latest_step()
+        self._truncate_largest_leaf(
+            os.path.join(ck.directory, f"step_{latest}"))
+        tr2 = Trainer(MLP(features=(16,), num_outputs=2), cfg)
+        tr2.state = tr2.init_state(x.shape[1:])
+        resumed = tr2.maybe_restore()
+        assert resumed is not None and resumed < latest
+        assert resumed in ck.steps()
 
 
 class TestJaxLearner:

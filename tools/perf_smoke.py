@@ -27,6 +27,14 @@ flake on a loaded CI box):
   mid-epoch checkpoint (the PRNG-fold correctness observable), and the
   Pallas fused-geometry kernel pinned ≤ 1 ULP equal to its pure-XLA
   reference in CPU interpret mode.
+* **train elastic recovery** — a supervised worker hard-killed mid-run
+  (preemption exit code) must be detected by the training service
+  supervisor, re-scaled onto the surviving topology (8 → 4 virtual
+  devices, a real dp×fsdp re-shard), and complete with a loss-history
+  tail + final params BIT-identical to an uninterrupted continuation at
+  the surviving topology from the recovery snapshot — plus shutdown
+  hygiene (dead workers' flight heartbeat rows forgotten, no stray
+  threads).
 * **serve dynamic batching** — a burst of concurrent single-row requests
   through the model server compiles at most ``len(buckets)`` programs
   (bucket quantization holds: no per-shape recompile, counted at the
@@ -357,6 +365,180 @@ def check_train_device_preprocess(min_reduction: float = 4.0) -> dict:
             runs["device_thin"]["input_bound_fraction"],
         "resume_history_len": len(tr2.history),
         "kernel_max_ulp": 1,
+    }
+
+
+def check_train_elastic() -> dict:
+    """Kill a worker mid-run; raise AssertionError unless the training
+    service supervisor detects the loss, elastically re-scales onto the
+    surviving topology, re-shards state from checkpoint, and the
+    completed run's loss-history tail + final params are BIT-identical
+    to an uninterrupted continuation at the surviving topology from the
+    supervisor's recovery snapshot (the PR 10 preemption-replay
+    discipline extended to topology change).
+
+    Shape of the run (the hardware-free analog of losing half a pod):
+    generation 0 trains the self-test workload in a worker process
+    owning 8 virtual devices (mesh dp=4×fsdp=2) and hard-exits with the
+    preemption code mid-epoch; policy re-scales to the 4-device rung
+    (dp=2×fsdp=2 — a REAL topology change: fsdp-sharded params re-shard
+    on restore) and generation 1 completes the schedule. Ingest is the
+    deterministic elastic walk (``train/service.elastic_stream``), so
+    the global batch composition is identical at every rung and the
+    resumed prefix replays exactly the consumed examples — no example
+    dropped or double-consumed across the boundary. Shutdown hygiene is
+    part of the contract: the supervisor must ``FlightRecorder.forget``
+    dead workers' heartbeat rows and leave no stray loader/beacon/pump
+    threads (the satellite fix this gate pins)."""
+    import tempfile
+    import threading
+
+    import jax
+
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.data.readers import DECODE_THREAD_PREFIX
+    from mmlspark_tpu.models.zoo import MLP
+    from mmlspark_tpu.obs import flight
+    from mmlspark_tpu.parallel.mesh import MeshSpec, make_mesh
+    from mmlspark_tpu.train.input import THREAD_PREFIX
+    from mmlspark_tpu.train.loop import Trainer
+    from mmlspark_tpu.train.service import (
+        BEACON_THREAD, PREEMPT_EXIT_CODE, RecoveryPolicy,
+        SELFTEST_EPOCH_PASSES, ServiceConfig, Topology, TrainSupervisor,
+        WATCH_THREAD, elastic_stream, selftest_config, selftest_data,
+    )
+
+    if len(jax.devices()) < 4:
+        raise AssertionError(
+            "check_train_elastic needs >= 4 devices for the surviving-"
+            f"topology control run; got {len(jax.devices())}")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    service_dir = tempfile.mkdtemp(prefix="train_elastic_svc_")
+    ckpt_dir = tempfile.mkdtemp(prefix="train_elastic_ckpt_")
+    flight_dir = tempfile.mkdtemp(prefix="train_elastic_flight_")
+    try:
+        # the supervisor itself under the flight recorder: dead workers'
+        # service/ heartbeat rows must be forgotten by shutdown
+        flight.enable(flight_dir, poll_s=0.1)
+        sup = TrainSupervisor(ServiceConfig(
+            cmd=(sys.executable,
+                 os.path.join(repo, "tools", "train_service.py"),
+                 "worker"),
+            service_dir=service_dir, checkpoint_dir=ckpt_dir,
+            topologies=(Topology(world=1, devices=8),
+                        Topology(world=1, devices=4)),
+            policy=RecoveryPolicy(max_restarts=0),
+            extra_env={"MMLSPARK_TPU_SERVICE_DIE_AT_STEP": "12",
+                       "MMLSPARK_TPU_SERVICE_DIE_GEN": "0"}))
+        report = sup.run()
+
+        assert report.ok, f"supervised run failed: {report.reason}"
+        assert len(report.generations) == 2, (
+            f"{len(report.generations)} generations for one preemption "
+            "— expected exactly kill + re-scaled completion")
+        g0, g1 = report.generations
+        assert g0.signal is not None and \
+            g0.signal.code == PREEMPT_EXIT_CODE, (
+                f"generation 0 signal {g0.signal!r} — the induced "
+                f"preemption (exit {PREEMPT_EXIT_CODE}) was not the "
+                "detected loss")
+        assert report.rescales == 1 and report.evictions == 1
+        assert (g1.topology.world, g1.topology.devices) == (1, 4), (
+            f"re-scaled topology {g1.topology} — expected the 4-device "
+            "survivors rung")
+        assert report.snapshots, "no recovery snapshot archived"
+        snapshot = report.snapshots[0]
+
+        # supervisor decisions are on disk (observable recovery)
+        with open(os.path.join(service_dir, "decisions.jsonl")) as f:
+            kinds = [json.loads(ln)["kind"] for ln in f]
+        for kind in ("launch", "worker_exit", "evict", "rescale", "done"):
+            assert kind in kinds, (
+                f"decision log is missing {kind!r}: {kinds}")
+
+        # the re-scaled worker really re-formed the mesh on survivors
+        with open(os.path.join(service_dir,
+                               "result_gen1_rank0.json")) as f:
+            result = json.load(f)
+        assert result["devices"] == 4 and result["mesh"]["dp"] == 2 \
+            and result["mesh"]["fsdp"] == 2, (
+                f"generation 1 mesh {result}")
+        assert result["resumed"] >= 1, "generation 1 did not resume "\
+            "from the checkpoint — it retrained from scratch"
+
+        # ---- the bit-compat pin: an UNINTERRUPTED continuation at the
+        #      surviving topology from the recovery snapshot must match
+        #      the elastic run's tail and final params EXACTLY ----
+        cfg = selftest_config(snapshot)
+        x, y = selftest_data()
+        mesh4 = make_mesh(MeshSpec(dp=2, fsdp=2), jax.devices()[:4])
+        tr = Trainer(MLP(features=(16,), num_outputs=2), cfg, mesh=mesh4)
+        tr.fit_stream(
+            elastic_stream(x, y, batch_size=cfg.batch_size,
+                           seed=cfg.seed, epochs=SELFTEST_EPOCH_PASSES),
+            input_spec=(x.shape[1],))
+        assert len(tr.history) == len(result["history"]), (
+            f"tail lengths differ: control {len(tr.history)} vs elastic "
+            f"{len(result['history'])}")
+        tail_max_diff = max(
+            (abs(a - b) for a, b in zip(tr.history, result["history"])),
+            default=0.0)
+        assert tail_max_diff == 0.0, (
+            "elastic run's loss tail is not bit-identical to the "
+            "uninterrupted continuation at the surviving topology "
+            f"(max diff {tail_max_diff}): {result['history'][:3]} vs "
+            f"{tr.history[:3]}")
+        worker_params = np.load(result["params_npz"])
+        flat = jax.tree_util.tree_flatten_with_path(tr.params)[0]
+        assert len(flat) == len(worker_params.files)
+        diverged = []
+        for path, leaf in flat:
+            key = "/".join(str(getattr(k, "key", k)) for k in path)
+            if not np.array_equal(np.asarray(leaf), worker_params[key]):
+                diverged.append(key)
+        params_bit_identical = not diverged
+        assert params_bit_identical, (
+            f"final params differ at {diverged} — the elastic re-shard "
+            "drifted from the plain continuation")
+
+        # ---- shutdown hygiene (the PR 11 satellite fix): no dead
+        #      heartbeat rows, no stray threads ----
+        rec = flight.recorder()
+        stray_hb = [n for n in rec.heartbeats()
+                    if n.startswith("service/")]
+        assert not stray_hb, (
+            f"supervisor left dead workers' heartbeat rows {stray_hb} — "
+            "FlightRecorder.forget regressed")
+        stray = [t.name for t in threading.enumerate()
+                 if t.name.startswith((WATCH_THREAD, BEACON_THREAD,
+                                       THREAD_PREFIX,
+                                       DECODE_THREAD_PREFIX))]
+        assert not stray, (
+            f"stray service/loader threads after the supervised run: "
+            f"{stray}")
+    finally:
+        flight.disable()
+        obs.disable()
+        obs.clear()
+        obs.registry().reset()
+
+    return {
+        "generations": len(report.generations),
+        "preempt_exit_code": g0.signal.code,
+        "rescales": report.rescales,
+        "evictions": report.evictions,
+        "topology_full": {"world": 1, "devices": 8},
+        "topology_survivors": {"world": g1.topology.world,
+                               "devices": g1.topology.devices},
+        "mesh_full": {"dp": 4, "fsdp": 2},
+        "mesh_survivors": {k: v for k, v in result["mesh"].items()
+                           if v > 1},
+        "resumed_step": result["resumed"],
+        "total_steps": result["steps"],
+        "tail_len": len(result["history"]),
+        "tail_max_diff": tail_max_diff,
+        "params_bit_identical": params_bit_identical,
+        "decision_kinds": kinds,
     }
 
 
@@ -1063,6 +1245,7 @@ def main() -> int:
         result = check_fused_crossings()
         train = check_train_prefetch()
         train_pp = check_train_device_preprocess()
+        train_elastic = check_train_elastic()
         serve = check_serve_batching()
         serve_sharded = check_serve_sharded()
         obs_overhead = check_obs_overhead()
@@ -1075,6 +1258,7 @@ def main() -> int:
     print(json.dumps({"perf_smoke": "OK", **result,
                       "train_prefetch": train,
                       "train_device_preprocess": train_pp,
+                      "train_elastic": train_elastic,
                       "serve": serve,
                       "serve_sharded": serve_sharded,
                       "obs_overhead": obs_overhead,

@@ -1,0 +1,142 @@
+"""train_service — supervise an elastic fault-tolerant training job.
+
+The actuator over the PR 9 anomaly plane (docs/training_service.md): a
+supervisor launches the worker command at the first rung of a topology
+ladder, watches exit codes, heartbeat beacons, and straggler verdicts,
+and recovers by POLICY — restart from the latest checkpoint, evict a
+persistent straggler, or elastically re-scale onto the surviving
+topology (the new generation restores the checkpoint with restore
+targets built on the new mesh, so optimizer/model state re-shards on
+read).
+
+Usage::
+
+    # supervise a worker command: 4 workers, shrink to 3 then 2 on
+    # permanent loss, restart transient crashes twice
+    python tools/train_service.py --service-dir ./svc \\
+        --checkpoint-dir ./ckpt --topology 4 --topology 3 --topology 2 \\
+        --max-restarts 2 -- python my_train_job.py
+
+    # the hardware-free dryrun rig: world 1 with 8 virtual CPU devices,
+    # re-scaling to 4 (the device-level survivors analog), built-in
+    # self-test worker
+    python tools/train_service.py --service-dir ./svc \\
+        --checkpoint-dir ./ckpt --topology 1x8 --topology 1x4 --selftest
+
+    # run AS the built-in self-test worker (what --selftest launches)
+    python tools/train_service.py worker
+
+Topology rungs are ``WORLD`` or ``WORLDxDEVICES`` (virtual CPU devices
+per worker — the dryrun rig). Every supervisor decision lands in
+``<service-dir>/decisions.jsonl``; with ``MMLSPARK_TPU_OBS=1`` the same
+decisions are ``service/*`` events + ``train.service.*`` gauges.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Sequence
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _parse_topology(raw: str):
+    from mmlspark_tpu.train.service import Topology
+    if "x" in raw:
+        world, devices = raw.split("x", 1)
+        return Topology(world=int(world), devices=int(devices))
+    return Topology(world=int(raw))
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "worker":
+        from mmlspark_tpu.train.service import run_selftest_worker
+        return run_selftest_worker()
+
+    ap = argparse.ArgumentParser(
+        prog="train_service",
+        description="Supervise an elastic fault-tolerant training job "
+                    "(see module docstring)")
+    ap.add_argument("--service-dir", required=True,
+                    help="run directory: beacons, decisions.jsonl, "
+                         "recovery snapshots, worker flight dumps")
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="the job's TrainCheckpointer directory (restart "
+                         "and re-scale resume from its latest step)")
+    ap.add_argument("--topology", action="append", default=[],
+                    help="ladder rung, WORLD or WORLDxDEVICES; repeat "
+                         "from full topology down to the elastic floor")
+    ap.add_argument("--max-restarts", type=int, default=2,
+                    help="same-topology restarts before re-scaling")
+    ap.add_argument("--hang-timeout", type=float, default=None,
+                    help="seconds a busy worker may stall (no beacon "
+                         "progress) before it is treated as lost")
+    ap.add_argument("--evict-straggler-after", type=int, default=None,
+                    help="consecutive straggler verdicts before the "
+                         "named worker is evicted (re-scale without it)")
+    ap.add_argument("--preempt-exit-code", type=int, action="append",
+                    default=None,
+                    help="exit code(s) meaning permanent capacity loss "
+                         "(immediate re-scale); default: the service's "
+                         "PREEMPT_EXIT_CODE (75)")
+    ap.add_argument("--grace-seconds", type=float, default=10.0)
+    ap.add_argument("--no-snapshot", action="store_true",
+                    help="skip archiving the checkpoint dir at each "
+                         "re-scale recovery point")
+    ap.add_argument("--selftest", action="store_true",
+                    help="use the built-in self-test worker as cmd")
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="worker command (prefix with --)")
+    args = ap.parse_args(argv)
+
+    from mmlspark_tpu.train.service import (
+        PREEMPT_EXIT_CODE, RecoveryPolicy, ServiceConfig, Topology,
+        TrainSupervisor,
+    )
+
+    cmd = list(args.cmd)
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if args.selftest:
+        if cmd:
+            ap.error("--selftest and an explicit worker command are "
+                     "mutually exclusive")
+        cmd = [sys.executable, os.path.abspath(__file__), "worker"]
+    if not cmd:
+        ap.error("no worker command (append: -- python job.py, or use "
+                 "--selftest)")
+    topologies = tuple(_parse_topology(t) for t in args.topology) \
+        or (Topology(),)
+    # backoff schedule and preempt code come from the policy's own
+    # defaults — the CLI must not fork a stale copy of either
+    policy = RecoveryPolicy(
+        max_restarts=args.max_restarts,
+        preempt_exit_codes=tuple(args.preempt_exit_code
+                                 or (PREEMPT_EXIT_CODE,)),
+        hang_timeout_s=args.hang_timeout,
+        evict_straggler_after=args.evict_straggler_after)
+    sup = TrainSupervisor(ServiceConfig(
+        cmd=cmd, service_dir=args.service_dir,
+        checkpoint_dir=args.checkpoint_dir, topologies=topologies,
+        policy=policy, grace_seconds=args.grace_seconds,
+        snapshot_recovery=not args.no_snapshot))
+    report = sup.run()
+    print(json.dumps({
+        "ok": report.ok, "reason": report.reason,
+        "generations": len(report.generations),
+        "restarts": report.restarts, "rescales": report.rescales,
+        "evictions": report.evictions,
+        "final_topology": (
+            {"world": report.final_topology.world,
+             "devices": report.final_topology.devices}
+            if report.final_topology else None),
+    }))
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
