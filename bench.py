@@ -275,6 +275,118 @@ def bench_serve(jm, rng, n_total: int = 192) -> dict:
     return out
 
 
+def bench_serve_precision(jm, rng, n_total: int = 128,
+                          conc: int = 8) -> dict:
+    """Serve precision A/B (round 12): the same ConvNet served f32 vs
+    bf16 vs int8w through the plan-level precision pass
+    (core/precision.py, docs/quantization.md) — rows/s and p99 from the
+    server stats, max-abs parity vs the f32 OFFLINE transform, and the
+    compute/transfer/idle split of a small traced pass per precision
+    (obs device pillar), which main() archives into BENCH_OBS.json.
+
+    On a CPU box the bf16/int8w kernels emulate (no MXU bf16 pass, no
+    int8 HBM), so rows/s deltas here are labeled-regime numbers like
+    Rounds 6-9 — the honest cross-regime observables are the parity and
+    the weight-byte ratio; real-chip rounds read the throughput."""
+    import threading
+
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.core import plan as plan_lib
+    from mmlspark_tpu.core.precision import (
+        PrecisionPolicy, quantized_bytes,
+    )
+    from mmlspark_tpu.data.table import DataTable
+    from mmlspark_tpu.models.jax_model import JaxModel
+    from mmlspark_tpu.serve import Client, ModelServer, ServeConfig
+
+    imgs = rng.integers(0, 255, size=(n_total, 32 * 32 * 3)
+                        ).astype(np.uint8)
+    tables = [DataTable({"image": [imgs[i]]}) for i in range(n_total)]
+    # the f32 offline anchor (the parity-contract side of every policy)
+    full = DataTable({"image": list(imgs)})
+    ref = np.stack(list(jm.transform(full)["scores"]))
+    out: dict = {}
+    # per-model pinned tolerances (docs/quantization.md): the ConvNet's
+    # logits span ~±75, so int8w's ~1.4% relative error needs an
+    # absolute pin of 2.0; bf16 is BIT-identical here — the module
+    # already computes in bf16, so pre-narrowed params round identically
+    # and the policy is a pure wire/HBM win
+    policies = {"f32": None, "bf16": "bf16",
+                "int8w": {"mode": "int8w", "tolerance": 2.0}}
+    for label, precision in policies.items():
+        served = JaxModel(model=jm.model, input_col="image",
+                          output_col="scores", minibatch_size=1024)
+        server = ModelServer(ServeConfig(
+            buckets=(1, 8, 32, 128), max_queue=n_total + conc,
+            deadline_ms=None, precision=precision))
+        try:
+            server.add_model("m", served, example=tables[0])
+            client = Client(server)
+            errors: list[str] = []
+            got: dict[int, np.ndarray] = {}
+
+            def worker(k: int) -> None:
+                try:
+                    for i in range(k, n_total, conc):
+                        res = client.predict("m", tables[i], timeout=600)
+                        got[i] = np.asarray(res["scores"][0])
+                except BaseException as e:  # noqa: BLE001 — reported
+                    errors.append(f"{type(e).__name__}: {e}")
+
+            threads = [threading.Thread(target=worker, args=(k,))
+                       for k in range(conc)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            snap = server.stats("m").snapshot()
+            load_snap = server.snapshot()["m"]
+            if errors:
+                out[label] = {"error": errors[0]}
+                continue
+            parity = max(float(np.abs(got[i] - ref[i]).max())
+                         for i in got)
+            # traced pass: the compute/transfer/idle attribution per
+            # precision (obs device pillar), archived in BENCH_OBS.json
+            obs.registry().reset()
+            obs.enable(device=True)
+            try:
+                for i in range(8):
+                    client.predict("m", tables[i], timeout=600)
+                split = obs.device_time_split()
+            finally:
+                obs.disable()
+                obs.clear()
+                obs.registry().reset()
+            e2e = snap.get("e2e_ms") or {}
+            rec = {
+                "serve_rows_per_s": round(n_total / wall, 1),
+                "serve_p99_ms": e2e.get("p99"),
+                "parity_max_abs": parity,
+                "occupancy_mean": snap.get("batch_occupancy_mean"),
+                "device_split": split,
+            }
+            if precision is not None:
+                rec["calibration_parity"] = load_snap.get(
+                    "precision_parity")
+                pol = PrecisionPolicy.parse(precision)
+                rec["pinned_tolerance"] = pol.resolve_tolerance()
+                seg = plan_lib.collect_segment(
+                    [served], 0,
+                    lambda c: plan_lib._entry_meta(full, c),
+                    min_stages=1, precision=pol)
+                _fn, stored = plan_lib.segment_composite(
+                    seg, plan_lib._segment_mesh(seg))
+                nb, fb = quantized_bytes(stored)
+                rec["weight_bytes_ratio"] = round(nb / fb, 4)
+            out[label] = rec
+        finally:
+            server.close()
+    return out
+
+
 def bench_serve_sharded(jm, rng, n_total: int = 192,
                         conc: int = 8) -> dict:
     """Sharded-serving scaling A/B: one chip (``dp=1``) vs DP-replica
@@ -737,6 +849,18 @@ def main() -> None:
     except Exception as e:  # best-effort metric; label failures accurately
         serve_sharded = {"error": f"{type(e).__name__}: {e}"}
 
+    # serve precision A/B (round 12): f32 vs bf16 vs int8w through the
+    # plan-level precision pass — parity vs the f32 offline transform,
+    # rows/s + p99 per policy, and the traced compute/transfer/idle
+    # split per precision (archived in BENCH_OBS.json)
+    serve_precision: dict | None = None
+    try:
+        if jm is None:
+            raise RuntimeError("inference setup failed, serve skipped")
+        serve_precision = bench_serve_precision(jm, rng)
+    except Exception as e:  # best-effort metric; label failures accurately
+        serve_precision = {"error": f"{type(e).__name__}: {e}"}
+
     # BASELINE configs 3-5 (flagship models); skip with BENCH_FAST=1
     import os
     extra: dict = {}
@@ -765,6 +889,9 @@ def main() -> None:
                         k: v for k, v in (serve_ab or {}).items()
                         if isinstance(v, dict)},
                     "serve_sharded": serve_sharded,
+                    # compute/transfer/idle split per serving precision
+                    # (the obs device pillar's traced pass per policy)
+                    "serve_precision": serve_precision,
                 }, fh, indent=2, default=str)
         except OSError:
             obs_archive = None
@@ -808,6 +935,15 @@ def main() -> None:
         "serve_ab": serve_ab,
         "serve_sharded": serve_sharded,
         "serve_sharded_speedup": (serve_sharded or {}).get("speedup"),
+        "serve_precision_ab": serve_precision,
+        **{f"serve_rows_per_s_{p}": (serve_precision or {}).get(
+            p, {}).get("serve_rows_per_s") for p in ("f32", "bf16",
+                                                     "int8w")},
+        **{f"serve_p99_ms_{p}": (serve_precision or {}).get(
+            p, {}).get("serve_p99_ms") for p in ("f32", "bf16",
+                                                 "int8w")},
+        **{f"serve_parity_max_abs_{p}": (serve_precision or {}).get(
+            p, {}).get("parity_max_abs") for p in ("bf16", "int8w")},
         "tunnel_upload_mb_s": tunnel_mb_s,
         "mxu_matmul_tf_s": mxu_tf_s,
         "fetch_rtt_ms": rtt_ms,

@@ -187,17 +187,25 @@ def _purpose_collisions(schema: TableSchema) -> list:
 
 
 def analyze(pipeline: Any, schema: TableSchema, n_rows: int | None = None,
-            device_audit: bool = True) -> AnalysisReport:
+            device_audit: bool = True,
+            precision: Any = None) -> AnalysisReport:
     """Statically validate a pipeline over an abstract input schema.
 
     ``n_rows``, when given, turns the device-plan audit's crossing
     prediction concrete (minibatch counts); without it the audit still
     reports segmentation and hazards. Set ``device_audit=False`` to skip
-    the plan replay (pure schema checking).
+    the plan replay (pure schema checking). ``precision`` resolves each
+    device segment's serving :class:`~mmlspark_tpu.core.precision.
+    PrecisionPolicy` in the report (mode + expected parity tolerance —
+    what ``tools/analyze.py pipeline --precision`` prints); the emitted
+    column dtypes are policy-independent (the composite restores the
+    declared ``ArrayMeta`` dtypes), so schema predictions don't change.
     """
     from mmlspark_tpu.core import plan
+    from mmlspark_tpu.core.precision import PrecisionPolicy
     from mmlspark_tpu.core.stage import DeviceStage
 
+    policy = PrecisionPolicy.parse(precision)
     stages = _stages_of(pipeline)
     diags = list(check_stage_kinds(stages))
     bad = {d.stage_index for d in diags}
@@ -217,8 +225,15 @@ def analyze(pipeline: Any, schema: TableSchema, n_rows: int | None = None,
         explain: list = []
         if device_audit and rows != 0:
             try:
-                seg = plan.collect_segment(stages, i, schema.entry_meta,
-                                           explain=explain)
+                # a precision query is about the SERVING plan, which
+                # dispatches even a lone device stage through the fused
+                # path (transform_async min_stages=1) — the offline view
+                # keeps the planner's >= 2 rule
+                seg = plan.collect_segment(
+                    stages, i, schema.entry_meta, explain=explain,
+                    min_stages=(1 if policy is not None
+                                and policy.active else 2),
+                    precision=policy)
             except Exception as e:
                 diags.append(Diagnostic(
                     "warning", "plan-audit-failed",
@@ -238,7 +253,14 @@ def analyze(pipeline: Any, schema: TableSchema, n_rows: int | None = None,
             audit.segments.append(PlanSegmentReport(
                 "device", seg.start, seg.end,
                 [type(s).__name__ for s in seg.stages],
-                entry_col=seg.entry_col, minibatches=m))
+                entry_col=seg.entry_col, minibatches=m,
+                out_dtypes={c: meta.dtype
+                            for c, meta in seg.out_metas.items()},
+                precision=(policy.mode if policy is not None
+                           and policy.active else "f32"),
+                tolerance=(policy.resolve_tolerance()
+                           if policy is not None and policy.active
+                           else 0.0)))
             for j in range(seg.start, seg.end):
                 schema, rows = _advance(stages[j], j, schema, rows, diags)
             i = seg.end
@@ -273,6 +295,16 @@ def analyze(pipeline: Any, schema: TableSchema, n_rows: int | None = None,
                 "host", i, i + 1, [type(stage).__name__],
                 minibatches=m, notes=list(explain)))
         schema, rows = _advance(stage, i, schema, rows, diags)
+        if audit is not None and audit.segments \
+                and audit.segments[-1].kind == "host":
+            # per-stage output dtypes, from the advanced schema: the
+            # declared outputs' predicted dtype (None stays absent)
+            declared = getattr(stage, "_declared_output_columns",
+                               list)() or []
+            audit.segments[-1].out_dtypes = {
+                c: schema.columns[c].dtype for c in declared
+                if c in schema.columns
+                and schema.columns[c].dtype is not None}
         i += 1
 
     diags.extend(_purpose_collisions(schema))

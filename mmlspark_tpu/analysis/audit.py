@@ -29,7 +29,16 @@ from typing import Any
 
 @dataclasses.dataclass
 class PlanSegmentReport:
-    """One executor step: a fused device run or a single host stage."""
+    """One executor step: a fused device run or a single host stage.
+
+    ``out_dtypes`` carries the step's predicted per-column output dtypes
+    — for device segments the eval_shape-traced truth (``ArrayMeta``
+    dtypes the composite restores on emit, whatever the precision
+    policy computes in); for host steps the schema-predicted dtype of
+    each declared output. ``precision`` names the segment's resolved
+    serving precision (``"f32"`` when no policy applies) and
+    ``tolerance`` its expected max-abs parity bound vs the f32 offline
+    transform (docs/quantization.md)."""
 
     kind: str                      # "device" | "host"
     start: int                     # first stage index (inclusive)
@@ -38,6 +47,9 @@ class PlanSegmentReport:
     entry_col: str | None = None   # fused runs: the one uploaded column
     minibatches: int | None = None  # crossing rounds (None = not predictable)
     notes: list = dataclasses.field(default_factory=list)
+    out_dtypes: dict = dataclasses.field(default_factory=dict)
+    precision: str | None = None   # device segments: resolved policy mode
+    tolerance: float | None = None  # expected parity bound for it
 
     def describe(self) -> str:
         names = "→".join(self.stages)
@@ -46,9 +58,16 @@ class PlanSegmentReport:
             head += f" (entry {self.entry_col!r}"
             if self.minibatches is not None:
                 head += f", {self.minibatches} minibatch round(s)"
+            if self.precision is not None:
+                head += f", precision {self.precision}"
+                if self.tolerance is not None:
+                    head += f" (expected parity ≤ {self.tolerance:g})"
             head += ")"
         elif self.minibatches:
             head += f" ({self.minibatches} minibatch round(s) on its own path)"
+        if self.out_dtypes:
+            cols = ", ".join(f"{c}:{d}" for c, d in self.out_dtypes.items())
+            head += f" → {cols}"
         return head
 
 

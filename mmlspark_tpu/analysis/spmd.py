@@ -679,6 +679,43 @@ def _build_serve_pp(mesh):
     return fn, (stacked, x)
 
 
+def _build_serve_lowprec(mesh):
+    """The low-precision serve segment (docs/quantization.md): the same
+    lone-JaxModel composite, int8w-quantized by the plan-level precision
+    pass (``core/precision`` — bf16 activations, int8 per-channel
+    weights dequantized inside the trace). Built through the SAME
+    ``segment_composite`` builder the executor jits, with REAL init
+    params (weight quantization needs concrete values for its max-abs
+    scales). The contract is unchanged by the pass: ZERO manual
+    collectives — dequant is pure elementwise math, and any tp
+    resharding of the int8 weights stays GSPMD-inserted."""
+    import jax
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.core import plan
+    from mmlspark_tpu.core.precision import PrecisionPolicy
+    from mmlspark_tpu.core.stage import ArrayMeta
+    from mmlspark_tpu.models.bundle import ModelBundle
+    from mmlspark_tpu.models.jax_model import JaxModel
+    from mmlspark_tpu.models.zoo import MLP
+
+    d_in, width, n_out = 16, 32, 8
+    module = MLP(features=(width,), num_outputs=n_out)
+    params = module.init(jax.random.PRNGKey(0),
+                         jnp.zeros((1, d_in), jnp.float32))["params"]
+    bundle = ModelBundle(module=module, params=params, input_spec=(d_in,),
+                         output_names=("features", "logits"))
+    jm = JaxModel(model=bundle, input_col="x", output_col="scores")
+    seg = plan.collect_segment([jm], 0,
+                               lambda c: ArrayMeta((d_in,), "float32"),
+                               min_stages=1, mesh=mesh,
+                               precision=PrecisionPolicy(mode="int8w"))
+    composite, params_tuple = plan_segment_composite(seg)
+    rows = plan.dp_rounded_minibatch(8, plan.mesh_dp(mesh), 8)
+    entry = jax.ShapeDtypeStruct((rows, d_in), jnp.float32)
+    return composite, (params_tuple, entry)
+
+
 ENTRY_POINTS: tuple[EntryPoint, ...] = (
     EntryPoint("moe_apply", {"dp": 2, "ep": 4},
                ("dp", "fsdp", "ep"), _build_moe, capacity_dispatch=True),
@@ -698,6 +735,13 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
                _build_serve_segment, expect_no_collectives=True),
     EntryPoint("serve_pp_segment", {"dp": 2, "pp": 4}, ("pp",),
                _build_serve_pp),
+    # the int8w+bf16 quantized serve segments (docs/quantization.md):
+    # the precision pass must not introduce collectives on a dp replica
+    # nor communicate off-contract when the int8 weights tp-shard
+    EntryPoint("serve_int8w_replica", {"dp": 1}, (),
+               _build_serve_lowprec, expect_no_collectives=True),
+    EntryPoint("serve_int8w_tp", {"dp": 2, "tp": 4}, (),
+               _build_serve_lowprec, expect_no_collectives=True),
 )
 
 
@@ -779,8 +823,8 @@ def plan_segment_composite(seg: Any) -> tuple[Callable, tuple]:
 
 def audit_plan_spmd(stages: list, meta_of: Callable,
                     n_rows: int | None = None, mesh: Any = None,
-                    expect_axes: Iterable[str] | None = None
-                    ) -> PlanSpmdAudit:
+                    expect_axes: Iterable[str] | None = None,
+                    precision: Any = None) -> PlanSpmdAudit:
     """Replay the planner's segmentation (``core/plan.collect_segment``
     with the abstract ``meta_of`` probe — same contract as the PR 2 plan
     audit) and verify each fused segment's SPMD behavior on its
@@ -794,11 +838,19 @@ def audit_plan_spmd(stages: list, meta_of: Callable,
     requires ZERO manual collectives in the composite; a tp/pp
     model-parallel serve segment instead passes its declared
     model-parallel axes, and any collective outside them (in particular
-    over ``dp``) is a finding."""
+    over ``dp``) is a finding.
+
+    ``precision`` pins the segments' low-precision policy
+    (:mod:`mmlspark_tpu.core.precision`): the audit then traces the
+    QUANTIZED composite — the same ``segment_composite`` builder the
+    executor jits applies the pass, so a quantized serve load is
+    verified against exactly the program it will dispatch."""
     import jax
 
     from mmlspark_tpu.core import plan
+    from mmlspark_tpu.core.precision import PrecisionPolicy
 
+    precision = PrecisionPolicy.parse(precision)
     audit = PlanSpmdAudit()
     i = 0
     while i < len(stages):
@@ -807,7 +859,7 @@ def audit_plan_spmd(stages: list, meta_of: Callable,
         # audit must cover single-stage plans too — a lone JaxModel
         # with a manual collective must not audit as "no segments"
         seg = plan.collect_segment(stages, i, meta_of, min_stages=1,
-                                   mesh=mesh)
+                                   mesh=mesh, precision=precision)
         if seg is None:
             i += 1
             continue

@@ -374,7 +374,8 @@ class _Segment:
                  entry_meta: ArrayMeta, metas_in: list[ArrayMeta],
                  out_cols: list[str], emitters: dict[str, int],
                  out_metas: dict[str, ArrayMeta], mesh: Any = None,
-                 shard_params: Callable | None = None):
+                 shard_params: Callable | None = None,
+                 precision: Any = None):
         self.start = start
         self.stages = stages
         self.entry_col = entry_col
@@ -386,6 +387,8 @@ class _Segment:
         self.mesh = mesh                  # explicit mesh override (sharded
         #                                   serving: a replica's sub-mesh)
         self.shard_params = shard_params  # (mesh, params_tuple) → shardings
+        self.precision = precision        # PrecisionPolicy | None (serve
+        #                                   low-precision pass; None = f32)
 
     @property
     def end(self) -> int:
@@ -396,8 +399,8 @@ def collect_segment(stages: list, i: int,
                     meta_of: Callable[[str], ArrayMeta | None],
                     explain: list | None = None,
                     min_stages: int = 2, mesh: Any = None,
-                    shard_params: Callable | None = None
-                    ) -> _Segment | None:
+                    shard_params: Callable | None = None,
+                    precision: Any = None) -> _Segment | None:
     """Root a maximal device segment at ``stages[i]``, resolving the entry
     column's layout through ``meta_of`` (a concrete-table probe at execution
     time; an abstract :class:`~mmlspark_tpu.analysis.info.TableSchema`
@@ -417,7 +420,10 @@ def collect_segment(stages: list, i: int,
     layout. ``shard_params`` optionally overrides param placement:
     ``(mesh, params_tuple) → shardings pytree`` (default: the generic
     :func:`mmlspark_tpu.parallel.mesh.param_shardings` rules plus any
-    per-stage ``device_param_rules``)."""
+    per-stage ``device_param_rules``). ``precision`` pins the segment's
+    :class:`~mmlspark_tpu.core.precision.PrecisionPolicy` (bf16
+    activations / int8 weight-only — the serve low-precision pass,
+    applied by :func:`segment_composite`); None keeps the f32 plan."""
 
     def note(msg: str) -> None:
         if explain is not None:
@@ -483,7 +489,7 @@ def collect_segment(stages: list, i: int,
         return None
     return _Segment(i, seg_stages, entry_col, entry_meta, metas_in,
                     out_cols, emitters, out_metas, mesh=mesh,
-                    shard_params=shard_params)
+                    shard_params=shard_params, precision=precision)
 
 
 def _collect_segment(stages: list, i: int, table: DataTable
@@ -593,7 +599,14 @@ def segment_composite(seg: "_Segment", mesh: Any) -> tuple:
     """(composite fn, params tuple) for a fused segment on ``mesh`` —
     the ONE builder of the function this module jits. The SPMD audit
     (``analysis.spmd.plan_segment_composite``) traces the same object,
-    so the verified program can never drift from the dispatched one."""
+    so the verified program can never drift from the dispatched one —
+    including the low-precision pass: when ``seg.precision`` is an
+    active :class:`~mmlspark_tpu.core.precision.PrecisionPolicy`, the
+    returned params tuple is the quantized STORAGE form (int8 weights /
+    bf16 leaves — what uploads), and the composite dequantizes inside
+    the trace, casts float activations to bf16 at every stage boundary,
+    and restores each output column to its declared ``ArrayMeta`` dtype
+    so ``device_emit`` sees the layout the f32 plan declared."""
     ops: list[DeviceOp] = []
     for s, meta_in in zip(seg.stages, seg.metas_in):
         op = _stage_device_fn(s, meta_in, mesh)
@@ -604,15 +617,34 @@ def segment_composite(seg: "_Segment", mesh: Any) -> tuple:
 
     in_cols = [s.device_input_col() for s in seg.stages]
     out_cols_per_stage = [s.device_output_col() for s in seg.stages]
+    policy = seg.precision
+    if policy is not None and not policy.active:
+        policy = None
+
+    if policy is None:
+        def composite(all_params: tuple, x: Any) -> tuple:
+            vals = {seg.entry_col: x}
+            for k, op in enumerate(ops):
+                vals[out_cols_per_stage[k]] = op.fn(all_params[k],
+                                                    vals[in_cols[k]])
+            return tuple(vals[c] for c in seg.out_cols)
+
+        return composite, tuple(op.params for op in ops)
+
+    from mmlspark_tpu.core import precision as prec
+
+    stored = tuple(prec.quantize_params(op.params, policy) for op in ops)
 
     def composite(all_params: tuple, x: Any) -> tuple:
-        vals = {seg.entry_col: x}
+        vals = {seg.entry_col: prec.cast_activation(x, policy)}
         for k, op in enumerate(ops):
-            vals[out_cols_per_stage[k]] = op.fn(all_params[k],
-                                                vals[in_cols[k]])
-        return tuple(vals[c] for c in seg.out_cols)
+            p = prec.materialize(all_params[k], policy)
+            vals[out_cols_per_stage[k]] = prec.cast_activation(
+                op.fn(p, vals[in_cols[k]]), policy)
+        return tuple(prec.cast_output(vals[c], seg.out_metas[c].dtype)
+                     for c in seg.out_cols)
 
-    return composite, tuple(op.params for op in ops)
+    return composite, stored
 
 
 def _compile_segment_inner(seg: "_Segment") -> tuple:
@@ -698,7 +730,11 @@ def _cached_segment(seg: _Segment, cache_host: Any) -> tuple:
         return _compile_segment(seg)
     key = (tuple(id(s) for s in seg.stages), seg.entry_col, seg.entry_meta,
            None if seg.mesh is None else _mesh_key(seg.mesh),
-           None if seg.shard_params is None else id(seg.shard_params))
+           None if seg.shard_params is None else id(seg.shard_params),
+           # precision is program identity: an f32 and an int8w serving
+           # of one model never share a compiled entry or device params
+           None if seg.precision is None or not seg.precision.active
+           else seg.precision.cache_token)
     lock = cache_host.__dict__.setdefault("_plan_lock", threading.Lock())
     with lock:
         store = cache_host.__dict__.setdefault("_plan_cache", {})
@@ -827,7 +863,8 @@ def dispatch_segment(seg: _Segment, table: DataTable,
 
 def transform_async(stages: list, table: DataTable,
                     cache_host: Any = None, mesh: Any = None,
-                    shard_params: Callable | None = None) -> PendingTable:
+                    shard_params: Callable | None = None,
+                    precision: Any = None) -> PendingTable:
     """Run a fitted-transformer list over one packed batch, dispatching the
     *trailing* device segment asynchronously (the serving execution engine).
 
@@ -842,7 +879,11 @@ def transform_async(stages: list, table: DataTable,
     ``mesh``/``shard_params`` pin the device segments to an explicit mesh
     and param placement (see :func:`collect_segment`) — the sharded
     serving entry: a DP replica's sub-mesh, or a tp/pp model-parallel
-    layout for a model too big for one chip."""
+    layout for a model too big for one chip. ``precision`` pins every
+    device segment's low-precision policy (bf16 activations / int8
+    weight-only — :mod:`mmlspark_tpu.core.precision`); the offline
+    ``execute_stages`` path never passes one, so batch transforms stay
+    f32."""
     stages = list(stages)
     i = 0
     while i < len(stages):
@@ -851,7 +892,8 @@ def transform_async(stages: list, table: DataTable,
             seg = collect_segment(stages, i,
                                   lambda col: _entry_meta(table, col),
                                   min_stages=1, mesh=mesh,
-                                  shard_params=shard_params)
+                                  shard_params=shard_params,
+                                  precision=precision)
         if seg is not None:
             if seg.end == len(stages):
                 dispatched = dispatch_segment(seg, table, cache_host)

@@ -1,0 +1,290 @@
+"""Plan-level precision/quantization pass for served programs.
+
+The serve path computed in f32 end to end (ROADMAP item 5) while the
+hardware's fast path is bf16 MXU passes and int8 weight traffic. This
+module makes precision a first-class property of a device-plan segment
+(:mod:`mmlspark_tpu.core.plan`): a :class:`PrecisionPolicy` resolved per
+serve segment selects
+
+* ``"f32"``  — the historical behavior, byte-identical programs;
+* ``"bf16"`` — bf16 activations throughout the served program: float
+  entry batches and every inter-stage value cast to bfloat16, ≥2-D float
+  param leaves stored and shipped as bf16 (half the param HBM + H2D
+  bytes); 1-D leaves (biases, norm scales/offsets) STAY f32 so
+  normalization and bias adds keep full-precision accumulation — the
+  numerics contract ``ops/group_norm.py`` documents;
+* ``"int8w"`` — weight-only int8 on top of the bf16 activation policy
+  (à la LLM.int8()/AWQ's weight-only serving mode): every eligible ≥2-D
+  float param leaf is quantized per OUTPUT channel to int8 with an f32
+  scale vector (4× less weight HBM/wire than f32), and the dequantize
+  (``q.astype(f32) * scale → bf16``) happens INSIDE the jitted segment,
+  fused by XLA into the consuming matmul — still exactly one program
+  per (model, bucket).
+
+The pass is applied by ``core/plan.segment_composite`` — the ONE builder
+both the executor jit and the SPMD audit trace — so the verified program
+can never drift from the dispatched one, and the policy's
+:attr:`~PrecisionPolicy.cache_token` is part of the compiled-segment
+cache key, so an f32 and an int8w serving of the same model never share
+a program or a device param tree.
+
+Weight scales are calibrated from the weights themselves (symmetric
+max-abs per output channel — weight-only quantization needs no
+activation statistics); the *parity* of the quantized program against
+the f32 offline transform is calibrated at ``ModelServer.add_model``
+from the analyzer-derived schema plus a sample batch, and pinned
+per model by :meth:`PrecisionPolicy.resolve_tolerance` (docs/quantization.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import numpy as np
+
+MODES = ("f32", "bf16", "int8w")
+
+# default max-abs parity tolerances vs the f32 offline transform, per
+# mode, for models that don't pin their own (docs/quantization.md has
+# the measured per-model table; the serve gate pins the canonical MLP).
+# bf16 matmuls carry ~2^-8 relative error per accumulation chain; int8
+# per-channel weights add ~2^-7 relative weight error on top
+DEFAULT_TOLERANCES = {"f32": 0.0, "bf16": 5e-2, "int8w": 2e-1}
+
+# int8 symmetric range: scales map the per-channel max-abs onto ±127
+_QMAX = 127.0
+
+# leaves smaller than this (per-row fan-in × fan-out) are not worth
+# shipping as int8 — the scale vector and dequant outweigh the win
+MIN_QUANT_SIZE = 256
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionPolicy:
+    """Resolved precision of one served model's device segments.
+
+    ``tolerance`` is the model's pinned max-abs parity bound against the
+    f32 offline transform (None = the mode default); ``min_quant_size``
+    gates which param leaves int8-quantize (smaller leaves cast to bf16
+    instead). The policy is hashable and its :attr:`cache_token` folds
+    into the compiled-segment cache key.
+    """
+
+    mode: str = "f32"
+    tolerance: float | None = None
+    min_quant_size: int = MIN_QUANT_SIZE
+
+    def __post_init__(self):
+        if self.mode not in MODES:
+            raise ValueError(
+                f"unknown precision mode {self.mode!r}; one of {MODES}")
+        if self.tolerance is not None and self.tolerance < 0:
+            raise ValueError(
+                f"precision tolerance must be >= 0: {self.tolerance}")
+        if self.min_quant_size < 1:
+            raise ValueError(
+                f"min_quant_size must be >= 1: {self.min_quant_size}")
+
+    @staticmethod
+    def parse(obj: Any) -> "PrecisionPolicy | None":
+        """``None`` | mode string | dict of fields | policy → policy.
+
+        ``None`` stays None (the f32 fast path: the plan applies no pass
+        and the cache key component is None, so existing callers compile
+        byte-identical programs)."""
+        if obj is None:
+            return None
+        if isinstance(obj, PrecisionPolicy):
+            return obj
+        if isinstance(obj, str):
+            return PrecisionPolicy(mode=obj)
+        if isinstance(obj, dict):
+            return PrecisionPolicy(**obj)
+        raise TypeError(
+            f"cannot parse a PrecisionPolicy from {type(obj).__name__}: "
+            f"{obj!r}")
+
+    @property
+    def active(self) -> bool:
+        """False for f32 — the plan treats an f32 policy exactly like no
+        policy (same cache entries, no wrapping)."""
+        return self.mode != "f32"
+
+    @property
+    def cache_token(self) -> tuple:
+        return (self.mode, self.min_quant_size)
+
+    def resolve_tolerance(self) -> float:
+        """The pinned parity bound, defaulted per mode."""
+        if self.tolerance is not None:
+            return float(self.tolerance)
+        return DEFAULT_TOLERANCES[self.mode]
+
+    def describe(self) -> str:
+        return f"{self.mode}(tol={self.resolve_tolerance():g})"
+
+
+class QuantizedLeaf:
+    """One int8-quantized param leaf: ``q`` int8 ``[..., C]`` plus the
+    per-output-channel f32 ``scale`` ``[C]``. Registered as a pytree
+    node, so device placement, sharding rules, and jit tracing all see
+    the two component arrays as ordinary leaves — the int8 tensor ships
+    thin over H2D and lives thin in HBM; :func:`materialize` dequantizes
+    inside the jitted program."""
+
+    __slots__ = ("q", "scale")
+
+    def __init__(self, q: Any, scale: Any):
+        self.q = q
+        self.scale = scale
+
+    def __repr__(self) -> str:
+        shape = getattr(self.q, "shape", None)
+        return f"QuantizedLeaf(int8{list(shape or ())})"
+
+
+def _quant_flatten(leaf: QuantizedLeaf):
+    return (leaf.q, leaf.scale), None
+
+
+def _quant_flatten_with_keys(leaf: QuantizedLeaf):
+    from jax.tree_util import GetAttrKey
+    return ((GetAttrKey("q"), leaf.q),
+            (GetAttrKey("scale"), leaf.scale)), None
+
+
+def _quant_unflatten(_aux, children) -> QuantizedLeaf:
+    return QuantizedLeaf(*children)
+
+
+def _register() -> None:
+    import jax
+    try:
+        jax.tree_util.register_pytree_with_keys(
+            QuantizedLeaf, _quant_flatten_with_keys, _quant_unflatten)
+    except ValueError:  # pragma: no cover - double import guard
+        pass
+
+
+_register()
+
+
+def _is_quant(x: Any) -> bool:
+    return isinstance(x, QuantizedLeaf)
+
+
+def quantize_channelwise(w: np.ndarray) -> QuantizedLeaf:
+    """Symmetric per-output-channel int8 quantization of one ≥2-D float
+    weight (host-side numpy — the quantized tree is what uploads, so the
+    H2D wire ships int8). Channels = the LAST axis (flax kernel layout:
+    ``(..., in, out)`` / HWIO)."""
+    wf = np.asarray(w, np.float32)
+    amax = np.max(np.abs(wf), axis=tuple(range(wf.ndim - 1)))
+    scale = np.where(amax > 0, amax / _QMAX, 1.0).astype(np.float32)
+    q = np.clip(np.rint(wf / scale), -_QMAX, _QMAX).astype(np.int8)
+    return QuantizedLeaf(q, scale)
+
+
+def _eligible_int8(leaf: Any, policy: PrecisionPolicy) -> bool:
+    arr = np.asarray(leaf) if not hasattr(leaf, "dtype") else leaf
+    return (np.issubdtype(np.dtype(arr.dtype), np.floating)
+            and getattr(arr, "ndim", 0) >= 2
+            and int(np.prod(arr.shape)) >= policy.min_quant_size)
+
+
+def quantize_params(params: Any, policy: PrecisionPolicy) -> Any:
+    """The host-side half of the pass: map a segment's param pytree to
+    its low-precision storage form.
+
+    * int8w: eligible ≥2-D float leaves → :class:`QuantizedLeaf`;
+    * bf16 (and int8w's non-quantized ≥2-D floats): cast to bfloat16;
+    * 1-D float leaves (biases, norm scales) and non-floats: unchanged
+      (f32 accumulation for the cheap adds; int/bool leaves are layout).
+    """
+    if not policy.active:
+        return params
+    import jax
+    import jax.numpy as jnp
+
+    def one(leaf):
+        arr = np.asarray(leaf)
+        if not np.issubdtype(arr.dtype, np.floating):
+            return leaf
+        if arr.ndim < 2:
+            # keep the f32 constants f32: normalization scale/bias and
+            # conv/dense biases accumulate at full precision
+            return np.asarray(arr, np.float32)
+        if policy.mode == "int8w" and _eligible_int8(arr, policy):
+            return quantize_channelwise(arr)
+        return np.asarray(arr, jnp.bfloat16)
+
+    return jax.tree_util.tree_map(one, params)
+
+
+def materialize(params: Any, policy: PrecisionPolicy) -> Any:
+    """The in-program half: rebuild the compute-form param tree INSIDE
+    the jitted segment. Dequantization (int8 → f32 scale multiply →
+    bf16) traces here, so XLA fuses it into the consuming matmul and
+    the weight's HBM-resident form stays int8."""
+    if not policy.active:
+        return params
+    import jax
+    import jax.numpy as jnp
+
+    def one(leaf):
+        if _is_quant(leaf):
+            return (leaf.q.astype(jnp.float32)
+                    * leaf.scale).astype(jnp.bfloat16)
+        return leaf
+
+    return jax.tree_util.tree_map(one, params, is_leaf=_is_quant)
+
+
+def cast_activation(x: Any, policy: PrecisionPolicy) -> Any:
+    """bf16 activation cast at a stage boundary: float values narrow to
+    bfloat16, everything else (uint8 image batches, int ids, bools)
+    passes through — integer entries already ship thin and the stage's
+    own upcast convention handles them."""
+    if not policy.active:
+        return x
+    import jax.numpy as jnp
+    if hasattr(x, "dtype") and np.issubdtype(np.dtype(x.dtype),
+                                             np.floating) \
+            and x.dtype != jnp.bfloat16:
+        return x.astype(jnp.bfloat16)
+    return x
+
+
+def cast_output(x: Any, dtype: str) -> Any:
+    """Restore a segment output to its declared column dtype, so
+    ``device_emit`` and the serve wire see exactly the layout the f32
+    plan declared (``ArrayMeta.dtype``) whatever the internal policy."""
+    import jax.numpy as jnp
+    want = np.dtype(dtype)
+    if getattr(x, "dtype", None) == want:
+        return x
+    return jnp.asarray(x, want)
+
+
+def quantized_bytes(params: Any) -> tuple[int, int]:
+    """(storage bytes, f32-equivalent bytes) of a (possibly quantized)
+    param tree — the honest accounting behind the bench's weight-HBM
+    claim. A :class:`QuantizedLeaf`'s scale vector counts toward
+    STORAGE only (it is quantization overhead; the f32 model has no
+    such leaf, so it must not inflate the denominator)."""
+    import jax
+
+    def size_of(leaf) -> int:
+        return int(np.prod(getattr(leaf, "shape", ()) or (1,)))
+
+    stored = 0
+    f32_equiv = 0
+    for leaf in jax.tree_util.tree_leaves(params, is_leaf=_is_quant):
+        if _is_quant(leaf):
+            stored += size_of(leaf.q) + size_of(leaf.scale) * 4
+            f32_equiv += size_of(leaf.q) * 4
+            continue
+        stored += size_of(leaf) * np.dtype(leaf.dtype).itemsize
+        f32_equiv += size_of(leaf) * 4
+    return stored, f32_equiv

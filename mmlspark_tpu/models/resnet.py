@@ -19,13 +19,16 @@ config 3 "ResNet-50 ImageFeaturizer"). TPU-first choices:
     downloader/src/main/scala/Schema.scala:54-74). Used transiently at
     bundle-publish time; carries a ``batch_stats`` collection.
   - ``"none"``: the **folded inference variant** — no norm ops at all;
-    convs carry a bias. :func:`fold_batchnorm` converts a trained
-    ``"batch"`` net into this form algebraically (frozen BN statistics
-    fold into the conv weights: ``W' = W·γ/√(σ²+ε)``,
+    each conv is followed by an explicit float32 bias-add site
+    (``fold*`` — :class:`_FoldedBias`). :func:`fold_batchnorm` converts
+    a trained ``"batch"`` net into this form algebraically (frozen BN
+    statistics fold into the conv weights: ``W' = W·γ/√(σ²+ε)``,
     ``b' = β − μγ/√(σ²+ε)``), so frozen-backbone featurization pays
     **zero** norm HBM traffic — each activation is written once by its
     conv (bias+ReLU fused into the epilogue by XLA) instead of being
-    re-read for per-sample normalization.
+    re-read for per-sample normalization. The μ/σ-derived bias stays
+    f32 even in a bf16 net (the add is the BN centering: in bf16 it
+    cancels catastrophically against trained-scale conv outputs).
 * Fully convolutional + global average pool, so featurization works at any
   input size the pipeline resizes to.
 
@@ -76,6 +79,31 @@ def _gn(name: str, groups: int, dtype: Any, impl: str, y, relu: bool = False):
     return nn.relu(y) if relu else y
 
 
+class _FoldedBias(nn.Module):
+    """The folded-BN constant site of a ``norm="none"`` net.
+
+    Holds the μ/σ-derived bias ``β − μγ/√(σ²+ε)`` (:func:`fold_batchnorm`)
+    as an EXPLICIT float32 param and performs the add in float32 before
+    casting back to the compute dtype. Inside the conv (the previous
+    layout) a ``dtype=bf16`` net quantized the constant AND the add to
+    bf16 — for trained statistics the conv output and its centering bias
+    are large near-cancelling values, so the normalization numerics
+    silently degraded (the same accumulate-in-f32 contract
+    ``ops/group_norm.py`` keeps). The bias is C values per site: keeping
+    it f32 costs nothing against the bf16 kernel HBM win."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, relu: bool = False):
+        bias = self.param("bias", nn.initializers.zeros,
+                          (x.shape[-1],), jnp.float32)
+        y = x.astype(jnp.float32) + bias
+        if relu:
+            y = jnp.maximum(y, 0.0)
+        return y.astype(self.dtype)
+
+
 class _NormCtx:
     """Per-site norm dispatch shared by the stem and the blocks."""
 
@@ -89,15 +117,19 @@ class _NormCtx:
 
     @property
     def conv_bias(self) -> bool:
-        # folded nets carry the (BN-derived) bias on the conv itself
-        return self.norm == "none"
+        # no conv ever carries a bias: folded nets hold the BN-derived
+        # constant at an explicit f32 add site (_FoldedBias) instead —
+        # a bias inside a dtype=bf16 conv is added in bf16
+        return False
 
     def __call__(self, site: str, y, relu: bool = False):
         """``site`` is the conv name; norm params live at its paired name
-        (conv1→gn1/bn1, proj→gn_proj/bn_proj, conv_stem→gn_stem/bn_stem)."""
-        if self.norm == "none":
-            return nn.relu(y) if relu else y
+        (conv1→gn1/bn1, proj→gn_proj/bn_proj, conv_stem→gn_stem/bn_stem;
+        folded nets: conv1→fold1 …)."""
         pair = _NORM_PAIRS[site]
+        if self.norm == "none":
+            return _FoldedBias(dtype=self.dtype,
+                               name="fold" + pair)(y, relu)
         if self.norm == "batch":
             y = nn.BatchNorm(use_running_average=not self.train,
                              momentum=0.9, epsilon=1e-5, dtype=self.dtype,
@@ -283,8 +315,23 @@ def fold_batchnorm(variables: Any, eps: float = 1e-5,
     so the folded net computes *identical* math with zero norm ops — the
     reference's zoo ResNet-50 is exactly such a BN network whose inference
     cost folds away (reference: downloader/src/main/scala/Schema.scala:54-74,
-    ImageFeaturizer.scala:116-140). ``param_dtype`` optionally casts the
-    folded params (bf16 halves inference HBM weight traffic).
+    ImageFeaturizer.scala:116-140). The fold arithmetic runs in float64;
+    the μ/σ-derived bias lands at the net's ``fold*`` sites
+    (:class:`_FoldedBias`) and ALWAYS stays float32 — ``param_dtype``
+    (bf16 halves inference HBM weight traffic) casts only the ≥2-D conv/
+    dense kernels, never the folded normalization constants, so a bf16
+    inference variant keeps its mean/variance accumulation in f32 (the
+    ``ops/group_norm.py`` contract; regression-pinned against the f64
+    oracle in tests/test_ops.py).
+
+    LAYOUT NOTE (round 12): folded trees previously stored the bias
+    inside the conv subtree (``{conv1: {kernel, bias}}``); it now lives
+    at the sibling ``fold*`` site (``{conv1: {kernel}, fold1: {bias}}``)
+    matching the ``norm="none"`` architecture's :class:`_FoldedBias`
+    params. The in-repo zoo/publish paths fold at load so nothing
+    in-tree is affected, but a folded bundle PUBLISHED to a model repo
+    before this round must be re-published (re-fold from its BN source;
+    loading the old layout fails with a flax param-structure mismatch).
     """
     params, stats = variables["params"], variables["batch_stats"]
 
@@ -302,8 +349,9 @@ def fold_batchnorm(variables: Any, eps: float = 1e-5,
                 kernel = np.asarray(val["kernel"], np.float64) * inv
                 bias = (np.asarray(bn["bias"], np.float64)
                         - np.asarray(st["mean"], np.float64) * inv)
-                out[key] = {"kernel": jnp.asarray(kernel, jnp.float32),
-                            "bias": jnp.asarray(bias, jnp.float32)}
+                out[key] = {"kernel": jnp.asarray(kernel, jnp.float32)}
+                out["fold" + _NORM_PAIRS[key]] = {
+                    "bias": jnp.asarray(bias, jnp.float32)}
             elif isinstance(val, Mapping):
                 out[key] = fold(val, s.get(key, {}))
             else:
@@ -312,6 +360,10 @@ def fold_batchnorm(variables: Any, eps: float = 1e-5,
 
     folded = fold(params, stats)
     if param_dtype is not None:
+        # kernels only: 1-D leaves (dense biases, the fold* constants)
+        # keep f32 accumulation — see the docstring contract
         folded = jax.tree_util.tree_map(
-            lambda a: jnp.asarray(a, param_dtype), folded)
+            lambda a: (jnp.asarray(a, param_dtype)
+                       if getattr(a, "ndim", 0) >= 2
+                       else jnp.asarray(a, jnp.float32)), folded)
     return folded

@@ -35,25 +35,52 @@ class BhtdSelfAttention(nn.Module):
     the head axis moves next to batch BEFORE the score/weighted-sum
     einsums instead of XLA inserting transposes around each one
     (measured ~4% faster fwd+bwd at ViT-B shapes on v5e, PERF_NOTES
-    round 4)."""
+    round 4).
+
+    ``impl`` selects the attention compute (same params either way):
+
+    * ``"einsum"`` — the historical two-einsum + full softmax path;
+    * ``"flash"`` / ``"flash_xla"`` / ``"flash_pallas"`` — the fused
+      online-softmax path (:mod:`mmlspark_tpu.ops.pallas.attention`,
+      the serving-path attention: the score matrix never materializes
+      in HBM), mapping to the kernel's ``auto``/``xla``/``pallas``
+      backend selection.
+    """
 
     heads: int
     dtype: Any = jnp.bfloat16
+    impl: str = "einsum"
+
+    IMPLS = ("einsum", "flash", "flash_xla", "flash_pallas")
 
     @nn.compact
     def __call__(self, x):
+        if self.impl not in self.IMPLS:
+            # validate up front: 'pallas'/'xla' (the kernel's own flag
+            # vocabulary) must not silently run the einsum path
+            raise ValueError(
+                f"unknown attention impl {self.impl!r}; one of "
+                f"{list(self.IMPLS)}")
         B, T, D = x.shape
         H = self.heads
         dh = D // H
         q = nn.DenseGeneral((H, dh), dtype=self.dtype, name="query")(x)
         k = nn.DenseGeneral((H, dh), dtype=self.dtype, name="key")(x)
         v = nn.DenseGeneral((H, dh), dtype=self.dtype, name="value")(x)
-        q = q.transpose(0, 2, 1, 3) * (dh ** -0.5)   # [B,H,T,dh]
-        k = k.transpose(0, 2, 1, 3)
+        k = k.transpose(0, 2, 1, 3)                  # [B,H,T,dh]
         v = v.transpose(0, 2, 1, 3)
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k)
-        probs = jax.nn.softmax(scores, axis=-1)
-        o = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        if self.impl.startswith("flash"):
+            from mmlspark_tpu.ops.pallas.attention import flash_attention
+            kernel_impl = {"flash": "auto", "flash_xla": "xla",
+                           "flash_pallas": "pallas"}[self.impl]
+            o = flash_attention(q.transpose(0, 2, 1, 3), k, v,
+                                impl=kernel_impl)
+            o = o.astype(self.dtype)
+        else:
+            q = q.transpose(0, 2, 1, 3) * (dh ** -0.5)
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k)
+            probs = jax.nn.softmax(scores, axis=-1)
+            o = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
         o = o.transpose(0, 2, 1, 3)                  # [B,T,H,dh]
         return nn.DenseGeneral(D, axis=(-2, -1), dtype=self.dtype,
                                name="out")(o)
@@ -69,12 +96,21 @@ class EncoderBlock(nn.Module):
     @nn.compact
     def __call__(self, x):
         h = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
-        if self.attn_impl == "bhtd":
-            h = BhtdSelfAttention(heads=self.heads, dtype=self.dtype,
-                                  name="attn")(h)
-        else:
+        if self.attn_impl == "bhtd" or self.attn_impl.startswith("flash"):
+            h = BhtdSelfAttention(
+                heads=self.heads, dtype=self.dtype, name="attn",
+                impl=("einsum" if self.attn_impl == "bhtd"
+                      else self.attn_impl))(h)
+        elif self.attn_impl == "flax":
             h = nn.MultiHeadDotProductAttention(
                 num_heads=self.heads, dtype=self.dtype, name="attn")(h, h)
+        else:
+            # 'pallas'/'xla' (the kernel flag vocabulary) must not fall
+            # through to the flax reference — its param tree differs, so
+            # a checkpoint would fail to restore much later and opaquely
+            raise ValueError(
+                f"unknown attn_impl {self.attn_impl!r}; one of ['bhtd', "
+                "'flax', 'flash', 'flash_xla', 'flash_pallas']")
         x = x + h
         h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
         h = nn.Dense(self.mlp_dim, dtype=self.dtype, name="mlp_in")(h)
@@ -100,7 +136,10 @@ class ViT(nn.Module):
     # (B=128: 137→178 ms/step) — memory capacity is not the binding
     # constraint there; the flag exists for models/batches that OOM
     remat: bool = False
-    attn_impl: str = "bhtd"  # see BhtdSelfAttention; "flax" = reference
+    attn_impl: str = "bhtd"  # see BhtdSelfAttention; "flax" = reference;
+    #                          "flash"/"flash_xla"/"flash_pallas" = the
+    #                          fused online-softmax serving path
+    #                          (ops/pallas/attention.py)
     # microbatch count when the encoder stack runs pipelined over a pp
     # mesh (bubble fraction (pp-1)/(M+pp-1)); batch must divide by
     # microbatches × dp extent

@@ -54,10 +54,6 @@ def _gn_kernel(x_ref, scale_ref, bias_ref, o_ref, *, num_groups: int,
     cg = c // num_groups
     xs = x_ref[0].reshape(hw, c).astype(jnp.float32)
 
-    # per-channel partials (sublane reduction — cheap on the VPU)
-    s1 = jnp.sum(xs, axis=0, keepdims=True)            # (1, C)
-    s2 = jnp.sum(xs * xs, axis=0, keepdims=True)       # (1, C)
-
     # channel→group aggregation as a mask matmul (lane-aligned; avoids
     # lane-dim reshapes that Mosaic lays out badly)
     ch = jax.lax.broadcasted_iota(jnp.int32, (c, num_groups), 0)
@@ -68,15 +64,27 @@ def _gn_kernel(x_ref, scale_ref, bias_ref, o_ref, *, num_groups: int,
     # visibly corrupts means over thousands of elements
     denom = float(hw * cg)
     hi = jax.lax.Precision.HIGHEST
+
+    # TWO-PASS (centered) variance. The one-pass E[x²] − E[x]² form
+    # cancels catastrophically in f32 for feature maps whose mean
+    # dominates their spread (x ~ μ ± σ with μ ≫ σ: E[x²] and E[x]²
+    # agree to ~σ²/μ² relative — at μ=200, σ=0.02 the f32 one-pass
+    # variance was pure noise). Centering first costs one extra pass
+    # over the VMEM-resident block and keeps every accumulation f32 —
+    # the same stance flax's force_float32_reductions takes, and what a
+    # bf16 activation policy (docs/quantization.md) relies on
+    s1 = jnp.sum(xs, axis=0, keepdims=True)            # (1, C) Σx
     g1 = jnp.dot(s1, mask, precision=hi) / denom       # (1, G) group mean
-    g2 = jnp.dot(s2, mask, precision=hi) / denom       # (1, G) E[x²]
-    rstd = jax.lax.rsqrt(jnp.maximum(g2 - g1 * g1, 0.0) + eps)
+    mean_c = jnp.dot(g1, mask.T, precision=hi)         # (1, C) broadcast
+    xc = xs - mean_c                                   # centered block
+    s2 = jnp.sum(xc * xc, axis=0, keepdims=True)       # (1, C) Σ(x−μ)²
+    g2 = jnp.dot(s2, mask, precision=hi) / denom       # (1, G) variance
+    rstd = jax.lax.rsqrt(jnp.maximum(g2, 0.0) + eps)
 
     # group→channel broadcast via the transposed mask
-    mean_c = jnp.dot(g1, mask.T, precision=hi)         # (1, C)
     rstd_c = jnp.dot(rstd, mask.T, precision=hi)       # (1, C)
 
-    out = (xs - mean_c) * rstd_c
+    out = xc * rstd_c
     out = out * scale_ref[0].reshape(1, c).astype(jnp.float32) \
         + bias_ref[0].reshape(1, c).astype(jnp.float32)
     if relu:

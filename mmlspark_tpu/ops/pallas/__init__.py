@@ -1,22 +1,35 @@
-"""Hand-written Pallas kernels for the train-input hot path.
+"""Hand-written Pallas kernels — the repo's kernel library.
 
-XLA schedules most of the device preprocessing chain well (elementwise
-augment ops fuse into the surrounding step program for free), but the
-fused gather path — crop + bilinear resize + normalize — lowers as four
-separate batched gathers plus three blend passes over f32 intermediates,
-each a round-trip through HBM. The kernels here do that chain in one
-VMEM-resident pass per sample. Every kernel ships with a pure-XLA
-reference implementation pinned ≤ 1 ULP equal (tests/test_train_preprocess
-and the tier-1 ``check_train_device_preprocess`` gate), and runs in
-interpreter mode on non-TPU backends so CPU tests execute the kernel
-itself, not a shadow path.
+XLA schedules most device compute well (elementwise chains fuse into
+the surrounding program for free), so kernels exist only where the
+default lowering measurably loses to a VMEM-resident formulation:
+
+* :mod:`~mmlspark_tpu.ops.pallas.resize` — the train-input gather path
+  (crop + bilinear resize + normalize), which XLA lowers as four
+  batched gathers plus three f32 blend passes through HBM;
+* :mod:`~mmlspark_tpu.ops.pallas.attention` — flash-style fused
+  attention (online-softmax tiling): the serving-path attention of
+  ``models/vit.py`` and the local block of
+  ``parallel/ring_attention.py``, replacing three HBM materializations
+  of the ``[B, H, Tq, Tk]`` score matrix.
+
+Every kernel keeps the PR 10 discipline: ONE shared body = Pallas
+kernel = XLA reference = numpy oracle, the kernel ULP-pinned against
+the reference UNDER JIT, ``interpret=True`` off-TPU so CPU tier-1
+executes the kernel body itself, and an ``impl: auto|xla|pallas`` flag
+with a VMEM-budget fallback to the reference.
 """
 
+from mmlspark_tpu.ops.pallas.attention import (
+    attention_block_update, flash_attention, flash_attention_host,
+    flash_attention_reference,
+)
 from mmlspark_tpu.ops.pallas.resize import (
     fused_resize_norm, fused_resize_norm_host, fused_resize_norm_reference,
 )
 
 __all__ = [
-    "fused_resize_norm", "fused_resize_norm_host",
-    "fused_resize_norm_reference",
+    "attention_block_update", "flash_attention", "flash_attention_host",
+    "flash_attention_reference", "fused_resize_norm",
+    "fused_resize_norm_host", "fused_resize_norm_reference",
 ]

@@ -1,0 +1,325 @@
+"""Fused flash-style attention (online-softmax tiling) as one Pallas pass.
+
+The serving-path attention of :mod:`mmlspark_tpu.models.vit` and the
+local block of :mod:`mmlspark_tpu.parallel.ring_attention`. Under plain
+XLA, attention materializes the ``[B, H, Tq, Tk]`` score matrix in HBM
+three times over (scores → masked scores → softmax weights) before the
+weighted sum; the kernel keeps one (batch, head) tile's Q/K/V blocks in
+VMEM and accumulates the softmax online (running max + denominator, Dao
+et al.'s FlashAttention recurrence — the same recurrence
+``ring_attention`` already runs across ring hops, here applied across
+K blocks inside one chip), so the score matrix never touches HBM.
+
+The PR 10 kernel discipline (``ops/pallas/resize.py``):
+
+* ONE shared body — :func:`_online_update` (a single K/V block's
+  online-softmax update over 2-D ``[T, D]`` tiles) and
+  :func:`_flash_tile` (the block loop) are written over the ``xp``
+  namespace, so the SAME code is the Pallas kernel body, the XLA
+  reference (``vmap`` over batch × heads), and the numpy oracle —
+  implementations cannot drift apart op by op;
+* the kernel is pinned ≤ 1 ULP against :func:`flash_attention_reference`
+  UNDER JIT (eager comparisons drift via FMA contraction — repo
+  convention), and the numpy oracle is pinned against the jitted
+  reference (tests/test_attention.py);
+* ``interpret=True`` off-TPU, so CPU tier-1 executes the kernel body
+  itself, not a shadow path;
+* ``impl: auto | xla | pallas`` selects the backend (auto = kernel on
+  TPU, reference elsewhere), and tiles past the VMEM budget fall back
+  to the reference — identical math, different schedule.
+
+Masking semantics match ``parallel/ring_attention``: ``kv_mask`` is a
+``[B, Tk]`` key-validity mask (True = real key), ``causal`` adds the
+lower-triangular constraint, and fully-masked query rows yield EXACT
+zeros (the guarded accumulator), not NaN. The mask ships as one
+``[B, Tq, Tk]`` int8 tensor consumed identically by all three
+implementations.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+IMPLS = ("auto", "xla", "pallas")
+
+# K-block width of the online-softmax loop: one MXU-lane-aligned stripe
+# of the score tile per update
+DEFAULT_BLOCK_K = 128
+
+# the denominator guard for fully-masked query rows (exactly the
+# ring/ulysses value, so the paths agree bit-for-bit on masked rows)
+_DENOM_FLOOR = np.float32(1e-30)
+
+
+def _online_update(q, ks, vs, keep, m, denom, acc, scale, xp):
+    """THE shared body: one K/V block's flash-attention update for one
+    (batch, head) tile.
+
+    ``q`` ``[Tq, D]`` f32, ``ks``/``vs`` ``[Tk, D]`` f32, ``keep``
+    ``[Tq, Tk]`` bool, carry ``m``/``denom`` ``[Tq, 1]`` f32 and ``acc``
+    ``[Tq, D]`` f32. Returns the updated ``(m, denom, acc)``. Also the
+    per-hop local-block update of ``ring_attention`` (each ring step IS
+    one such update with the resident K/V block)."""
+    scores = xp.dot(q, ks.T) * scale
+    scores = xp.where(keep, scores, -xp.inf)
+    blk_max = xp.max(scores, axis=-1, keepdims=True)
+    m_new = xp.maximum(m, blk_max)
+    # guard -inf - -inf (rows with every key masked so far)
+    corr = xp.where(xp.isfinite(m), xp.exp(m - m_new), np.float32(0))
+    p = xp.exp(xp.where(xp.isfinite(scores), scores - m_new, -xp.inf))
+    acc = acc * corr + xp.dot(p, vs)
+    denom = denom * corr + xp.sum(p, axis=-1, keepdims=True)
+    return m_new, denom, acc
+
+
+def _flash_tile(q, k, v, keep, scale, xp, block_k: int):
+    """Full attention for one (batch, head) tile via the online-softmax
+    block loop: ``q`` ``[Tq, D]``, ``k``/``v`` ``[Tk, D]``, ``keep``
+    ``[Tq, Tk]`` bool → ``[Tq, D]`` f32. The block loop is a static
+    python loop (``Tk``/``block_k`` are trace-time constants), so the
+    SAME code unrolls identically in the kernel, the XLA reference, and
+    the numpy oracle."""
+    tq, d = q.shape
+    tk = k.shape[0]
+    m = xp.full((tq, 1), -xp.inf, np.float32)
+    denom = xp.zeros((tq, 1), np.float32)
+    acc = xp.zeros((tq, d), np.float32)
+    for start in range(0, tk, block_k):
+        stop = min(start + block_k, tk)
+        m, denom, acc = _online_update(
+            q, k[start:stop], v[start:stop], keep[:, start:stop],
+            m, denom, acc, scale, xp)
+    return acc / xp.maximum(denom, _DENOM_FLOOR)
+
+
+def _mask3(b: int, tq: int, tk: int, kv_mask, causal: bool):
+    """The one ``[B, Tq, Tk]`` int8 mask every implementation consumes
+    (True→1 = attend). Built with jnp (traced); callers on the host
+    oracle path convert with numpy themselves via :func:`host_mask3`."""
+    if kv_mask is None:
+        keep = jnp.ones((b, tq, tk), bool)
+    else:
+        keep = jnp.broadcast_to(jnp.asarray(kv_mask, bool)[:, None, :],
+                                (b, tq, tk))
+    if causal:
+        keep = keep & jnp.tril(jnp.ones((tq, tk), bool))[None]
+    return keep.astype(jnp.int8)
+
+
+def host_mask3(b: int, tq: int, tk: int, kv_mask, causal: bool
+               ) -> np.ndarray:
+    """Numpy twin of :func:`_mask3` for the oracle path."""
+    if kv_mask is None:
+        keep = np.ones((b, tq, tk), bool)
+    else:
+        keep = np.broadcast_to(np.asarray(kv_mask, bool)[:, None, :],
+                               (b, tq, tk)).copy()
+    if causal:
+        keep = keep & np.tril(np.ones((tq, tk), bool))[None]
+    return keep.astype(np.int8)
+
+
+def _resolve_scale(scale, d: int) -> np.float32:
+    """The f32 softmax scale — np.float32 so all implementations
+    multiply by the bit-identical constant."""
+    return np.float32(1.0 / np.sqrt(d) if scale is None else scale)
+
+
+def flash_attention_reference(q, k, v, mask3, scale,
+                              block_k: int = DEFAULT_BLOCK_K):
+    """Pure-XLA anchor: the SAME ``_flash_tile`` body vmapped over
+    (batch, heads). ``q``/``k``/``v`` ``[B, H, T, D]`` (any float
+    dtype — upcast to f32 like the ring path), ``mask3`` ``[B, Tq, Tk]``
+    int8. Returns ``[B, H, Tq, D]`` float32."""
+    s = np.float32(scale)
+
+    def tile(q2, k2, v2, keep2):
+        return _flash_tile(q2.astype(jnp.float32),
+                           k2.astype(jnp.float32),
+                           v2.astype(jnp.float32),
+                           keep2 != 0, s, jnp, block_k)
+
+    over_h = jax.vmap(tile, in_axes=(0, 0, 0, None))
+    return jax.vmap(over_h)(q, k, v, mask3)
+
+
+def flash_attention_host(q, k, v, mask3, scale,
+                         block_k: int = DEFAULT_BLOCK_K) -> np.ndarray:
+    """Numpy oracle: the identical tile body, python-looped over
+    (batch, heads)."""
+    q = np.asarray(q, np.float32)
+    k = np.asarray(k, np.float32)
+    v = np.asarray(v, np.float32)
+    mask3 = np.asarray(mask3)
+    s = np.float32(scale)
+    b, h, tq, d = q.shape
+    out = np.empty((b, h, tq, d), np.float32)
+    for bi in range(b):
+        keep = mask3[bi] != 0
+        for hi in range(h):
+            out[bi, hi] = _flash_tile(q[bi, hi], k[bi, hi], v[bi, hi],
+                                      keep, s, np, block_k)
+    return out
+
+
+# ---- the Pallas kernels ----
+
+def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *,
+                  scale: np.float32, block_k: int):
+    # one (batch, head) tile per program: refs arrive [1, 1, T, D] /
+    # [1, Tq, Tk]; squeeze to the 2-D tiles the shared body works on
+    q = q_ref[0, 0].astype(jnp.float32)
+    k = k_ref[0, 0].astype(jnp.float32)
+    v = v_ref[0, 0].astype(jnp.float32)
+    keep = mask_ref[0] != 0
+    o_ref[0, 0] = _flash_tile(q, k, v, keep, scale, jnp, block_k)
+
+
+def _flash_call(q, k, v, mask3, scale, block_k: int):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    kern = functools.partial(_flash_kernel, scale=np.float32(scale),
+                             block_k=block_k)
+    return pl.pallas_call(
+        kern,
+        grid=(b, h),
+        in_specs=[
+            pl.BlockSpec((1, 1, tq, d), lambda i, j: (i, j, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, tk, d), lambda i, j: (i, j, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, tk, d), lambda i, j: (i, j, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tq, tk), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, tq, d), lambda i, j: (i, j, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b, h, tq, d), jnp.float32),
+        interpret=jax.default_backend() != "tpu",
+    )(q, k, v, mask3)
+
+
+def _fits_vmem(tq: int, tk: int, d: int, block_k: int) -> bool:
+    """Conservative per-(batch, head) VMEM bound: f32 Q/K/V tiles and
+    accumulator (lane dim padded to 128), the int8 mask, and two f32
+    score stripes of ``block_k``. Past the ~16 MB budget the wrapper
+    falls back to the XLA reference — identical math."""
+    d_pad = -(-d // 128) * 128
+    bk = -(-min(block_k, tk) // 128) * 128
+    est = 4 * (2 * tk * d_pad + 2 * tq * d_pad) \
+        + tq * (-(-tk // 128) * 128) + 4 * 2 * tq * bk
+    return est < 14 * 2 ** 20
+
+
+def resolve_impl(impl: str) -> str:
+    """``auto`` → the kernel on the TPU backend, the XLA reference
+    elsewhere (tier-1 exercises the kernel explicitly via
+    ``impl="pallas"``, which runs it in interpreter mode off-TPU)."""
+    if impl not in IMPLS:
+        raise ValueError(
+            f"unknown attention impl {impl!r}; one of {IMPLS}")
+    if impl == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "xla"
+    return impl
+
+
+def flash_attention(q, k, v, kv_mask=None, causal: bool = False,
+                    scale=None, impl: str = "auto",
+                    block_k: int = DEFAULT_BLOCK_K):
+    """Fused attention over ``[B, H, T, D]`` operands (bhtd layout —
+    what :class:`~mmlspark_tpu.models.vit.BhtdSelfAttention` computes
+    in). ``kv_mask``: ``[B, Tk]`` bool key-validity mask (True = real
+    key); ``causal`` adds the triangular constraint. Returns
+    ``[B, H, Tq, D]`` float32 (callers cast back to their compute
+    dtype); fully-masked query rows are exact zeros."""
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    s = _resolve_scale(scale, d)
+    mask3 = _mask3(b, tq, tk, kv_mask, causal)
+    if resolve_impl(impl) == "pallas" and _fits_vmem(tq, tk, d, block_k):
+        return _flash_call(q, k, v, mask3, s, block_k)
+    return flash_attention_reference(q, k, v, mask3, s, block_k)
+
+
+# ---- the ring-hop local block: one online update as a kernel ----
+
+def _update_kernel(q_ref, k_ref, v_ref, mask_ref, m_ref, d_ref, a_ref,
+                   mo_ref, do_ref, ao_ref, *, scale: np.float32):
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    keep = mask_ref[0] != 0
+    m, denom, acc = _online_update(q, k, v, keep, m_ref[0, 0],
+                                   d_ref[0, 0], a_ref[0, 0], scale, jnp)
+    mo_ref[0, 0] = m
+    do_ref[0, 0] = denom
+    ao_ref[0, 0] = acc
+
+
+def _update_call(q4, k4, v4, mask3, m, denom, acc, scale):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, tq, d = q4.shape
+    tk = k4.shape[2]
+
+    def tile4(i, j):
+        return (i, j, 0, 0)
+
+    def tile_mask(i, j):
+        return (i, 0, 0)
+
+    kern = functools.partial(_update_kernel, scale=np.float32(scale))
+    spec4 = lambda last: pl.BlockSpec((1, 1, tq, last), tile4,  # noqa: E731
+                                      memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        kern,
+        grid=(b, h),
+        in_specs=[
+            spec4(d),
+            pl.BlockSpec((1, 1, tk, d), tile4, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, tk, d), tile4, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tq, tk), tile_mask,
+                         memory_space=pltpu.VMEM),
+            spec4(1), spec4(1), spec4(d),
+        ],
+        out_specs=(spec4(1), spec4(1), spec4(d)),
+        out_shape=(jax.ShapeDtypeStruct((b, h, tq, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((b, h, tq, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((b, h, tq, d), jnp.float32)),
+        interpret=jax.default_backend() != "tpu",
+    )(q4, k4, v4, mask3, m, denom, acc)
+
+
+def attention_block_update(q4, k4, v4, keep3, m, denom, acc, scale,
+                           impl: str = "xla"):
+    """One flash block update over batched ``[B, H, T, D]`` operands —
+    ``ring_attention``'s per-hop local block behind its ``impl`` flag.
+
+    ``keep3``: ``[B, Tq, Tk]`` bool (shared across heads). Carry
+    ``m``/``denom`` ``[B, H, Tq, 1]``, ``acc`` ``[B, H, Tq, D]``, all
+    f32. ``impl="xla"`` runs the shared body vmapped (exactly the
+    historical inline update); ``impl="pallas"`` runs it as one fused
+    kernel per (batch, head) tile — the score block never leaves VMEM.
+    """
+    s = np.float32(scale)
+    if resolve_impl(impl) == "pallas" \
+            and _fits_vmem(q4.shape[2], k4.shape[2], q4.shape[3],
+                           k4.shape[2]):
+        return _update_call(q4, k4, v4, keep3.astype(jnp.int8),
+                            m, denom, acc, s)
+
+    def upd(q2, k2, v2, keep2, m2, d2, a2):
+        return _online_update(q2, k2, v2, keep2, m2, d2, a2, s, jnp)
+
+    over_h = jax.vmap(upd, in_axes=(0, 0, 0, None, 0, 0, 0))
+    return jax.vmap(over_h)(q4, k4, v4, keep3, m, denom, acc)

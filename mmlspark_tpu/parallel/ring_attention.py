@@ -111,7 +111,8 @@ def _run_sharded(body, mesh, axis, batch_axis, q, k, v, kv_mask):
 
 
 def ring_attention(q, k, v, mesh, axis: str = "sp", causal: bool = False,
-                   kv_mask=None, batch_axis: str | None = "dp"):
+                   kv_mask=None, batch_axis: str | None = "dp",
+                   impl: str = "auto"):
     """Distributed attention over sequence shards.
 
     Args are *global* [B, L, H, D] arrays (or already sharded); output is
@@ -119,12 +120,25 @@ def ring_attention(q, k, v, mesh, axis: str = "sp", causal: bool = False,
     ``batch_axis`` size. ``kv_mask`` ([B, L] bool, True = real key) rotates
     around the ring with its K/V block so pad keys never receive attention
     weight.
+
+    ``impl`` selects the LOCAL block's implementation — the collective
+    schedule (one ``ppermute`` per hop per rotating operand) is identical
+    either way. Each hop is one flash online-softmax block update
+    (:func:`mmlspark_tpu.ops.pallas.attention.attention_block_update`,
+    the ONE shared body): ``"xla"`` runs it vmapped under plain XLA,
+    ``"pallas"`` as the fused kernel (the per-hop score block never
+    leaves VMEM), ``"auto"`` = the kernel on TPU, XLA elsewhere.
     """
     import jax
     import jax.numpy as jnp
 
+    from mmlspark_tpu.ops.pallas.attention import (
+        attention_block_update, resolve_impl,
+    )
+
+    resolved = resolve_impl(impl)
     sp = mesh.shape[axis]
-    scale = 1.0 / np.sqrt(q.shape[-1])
+    scale = np.float32(1.0 / np.sqrt(q.shape[-1]))
 
     def body(ql, kl, vl, maskl):
         # ql/kl/vl: [B, l, H, D] local shards; online-softmax accumulation
@@ -134,7 +148,7 @@ def ring_attention(q, k, v, mesh, axis: str = "sp", causal: bool = False,
         acc = jnp.zeros((B, H, l, D), jnp.float32)
         denom = jnp.zeros((B, H, l, 1), jnp.float32)
         m = jnp.full((B, H, l, 1), -jnp.inf, jnp.float32)
-        qf = ql.astype(jnp.float32)
+        qf = ql.astype(jnp.float32).transpose(0, 2, 1, 3)  # [B,H,l,D]
         perm = [(i, (i + 1) % sp) for i in range(sp)]
 
         kv = (kl.astype(jnp.float32), vl.astype(jnp.float32), maskl)
@@ -142,22 +156,14 @@ def ring_attention(q, k, v, mesh, axis: str = "sp", causal: bool = False,
             kc, vc, mc = kv
             # K block index currently resident on this device
             kv_idx = (me - step) % sp
-            scores = jnp.einsum("bqhd,bkhd->bhqk", qf, kc) * scale
-            keep = mc[:, None, None, :]
+            keep = jnp.broadcast_to(mc[:, None, :], (B, l, l))
             if causal:
                 q_pos = me * l + jnp.arange(l)[:, None]
                 k_pos = kv_idx * l + jnp.arange(l)[None, :]
-                keep = keep & (k_pos <= q_pos)[None, None]
-            scores = jnp.where(keep, scores, -jnp.inf)
-            blk_max = jnp.max(scores, axis=-1, keepdims=True)
-            m_new = jnp.maximum(m, blk_max)
-            # guard -inf - -inf (fully masked rows so far)
-            corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_new), 0.0)
-            p = jnp.exp(jnp.where(jnp.isfinite(scores), scores - m_new,
-                                  -jnp.inf))
-            acc = acc * corr + jnp.einsum("bhqk,bkhd->bhqd", p, vc)
-            denom = denom * corr + jnp.sum(p, axis=-1, keepdims=True)
-            m = m_new
+                keep = keep & (k_pos <= q_pos)[None]
+            m, denom, acc = attention_block_update(
+                qf, kc.transpose(0, 2, 1, 3), vc.transpose(0, 2, 1, 3),
+                keep, m, denom, acc, scale, impl=resolved)
             if step + 1 < sp:
                 kv = jax.lax.ppermute(kv, axis, perm)
         out = acc / jnp.maximum(denom, 1e-30)
@@ -168,21 +174,33 @@ def ring_attention(q, k, v, mesh, axis: str = "sp", causal: bool = False,
 
 def ulysses_attention(q, k, v, mesh, axis: str = "sp",
                       causal: bool = False, kv_mask=None,
-                      batch_axis: str | None = "dp"):
+                      batch_axis: str | None = "dp",
+                      impl: str = "auto"):
     """All-to-all (DeepSpeed-Ulysses style) sequence parallelism.
 
     Re-shards sequence → heads with one ``all_to_all``, runs full-sequence
     local attention on each head group, and re-shards back. H must divide by
     the ``axis`` size. ``kv_mask``: [B, L] bool, True = real key.
+
+    ``impl`` selects the local attention after the re-shard (the
+    collective schedule is identical either way): ``"xla"`` keeps the
+    plain full-softmax path, ``"pallas"`` runs the fused flash kernel
+    (:func:`mmlspark_tpu.ops.pallas.attention.flash_attention`),
+    ``"auto"`` = the kernel on TPU, plain XLA elsewhere.
     """
     import jax
     import jax.numpy as jnp
 
+    from mmlspark_tpu.ops.pallas.attention import (
+        flash_attention, resolve_impl,
+    )
+
+    resolved = resolve_impl(impl)
     sp = mesh.shape[axis]
     if q.shape[2] % sp:
         raise ValueError(
             f"heads ({q.shape[2]}) must divide the {axis!r} axis ({sp})")
-    scale = 1.0 / np.sqrt(q.shape[-1])
+    scale = np.float32(1.0 / np.sqrt(q.shape[-1]))
 
     def body(ql, kl, vl, maskl):
         # [B, l, H, D] → all_to_all → [B, L, H/sp, D]
@@ -195,13 +213,22 @@ def ulysses_attention(q, k, v, mesh, axis: str = "sp",
         vg = a2a(vl, 2, 1)
         # the mask has no head axis: gather the full [B, L] key mask
         mask_g = jax.lax.all_gather(maskl, axis, axis=1, tiled=True)
-        mask = mask_g[:, None, None, :]
-        if causal:
-            L = qg.shape[1]
-            mask = mask & jnp.tril(jnp.ones((L, L), bool))[None, None]
-        out = _local_attention(qg.astype(jnp.float32),
-                               kg.astype(jnp.float32),
-                               vg.astype(jnp.float32), scale, mask)
+        if resolved == "pallas":
+            out4 = flash_attention(
+                qg.astype(jnp.float32).transpose(0, 2, 1, 3),
+                kg.astype(jnp.float32).transpose(0, 2, 1, 3),
+                vg.astype(jnp.float32).transpose(0, 2, 1, 3),
+                kv_mask=mask_g, causal=causal, scale=scale,
+                impl="pallas")
+            out = out4.transpose(0, 2, 1, 3)
+        else:
+            mask = mask_g[:, None, None, :]
+            if causal:
+                L = qg.shape[1]
+                mask = mask & jnp.tril(jnp.ones((L, L), bool))[None, None]
+            out = _local_attention(qg.astype(jnp.float32),
+                                   kg.astype(jnp.float32),
+                                   vg.astype(jnp.float32), scale, mask)
         return a2a(out.astype(ql.dtype), 1, 2)
 
     return _run_sharded(body, mesh, axis, batch_axis, q, k, v, kv_mask)

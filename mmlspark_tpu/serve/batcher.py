@@ -379,7 +379,8 @@ class _Lane:
                            if labels is not None else None):
                 pending = plan.transform_async(
                     self.batcher.stages, packed, self.cache_host,
-                    mesh=self.mesh, shard_params=self.shard_params)
+                    mesh=self.mesh, shard_params=self.shard_params,
+                    precision=self.batcher.precision)
         except BaseException as e:  # noqa: BLE001 — relayed per request
             for r in batch:
                 if r._fail(e):
@@ -458,7 +459,8 @@ class DynamicBatcher:
 
     def __init__(self, name: str, stages: list, cache_host: Any,
                  config: ServeConfig, stats: ServerStats | None = None,
-                 replicas: Any = None, lockstep: Any = None):
+                 replicas: Any = None, lockstep: Any = None,
+                 precision: Any = None):
         self.name = name
         self.stages = list(stages)
         self.cache_host = cache_host
@@ -466,6 +468,10 @@ class DynamicBatcher:
         self.stats = stats or ServerStats(config.stats_window, model=name)
         self.replicas = replicas     # serve.mesh.ReplicaSet | None
         self._lockstep = lockstep    # serve.mesh.LockstepCoordinator | None
+        self.precision = precision   # core.precision.PrecisionPolicy |
+        #                              None — every lane dispatch (and
+        #                              warm compile) pins it, so the
+        #                              served program IS the policy's
         self._cv = threading.Condition()
         self._queue: deque[ServeRequest] = deque()
         self._closed = False     # admission stopped (drain in progress)
@@ -736,7 +742,8 @@ class DynamicBatcher:
         def _one(lane: _Lane) -> None:
             plan.transform_async(self.stages, padded, lane.cache_host,
                                  mesh=lane.mesh,
-                                 shard_params=lane.shard_params).result()
+                                 shard_params=lane.shard_params,
+                                 precision=self.precision).result()
 
         if len(self._lanes) == 1:
             _one(self._lanes[0])
@@ -747,6 +754,21 @@ class DynamicBatcher:
         ) as pool:
             for f in [pool.submit(_one, lane) for lane in self._lanes]:
                 f.result()
+
+    def probe(self, padded: DataTable) -> DataTable:
+        """Synchronously run one padded (bucket-sized) batch through lane
+        0's EXACT dispatch path — same compiled-segment cache, mesh,
+        param placement, and precision policy as production requests —
+        without touching the request stats. The load-time calibration
+        entry: ``ModelServer.add_model`` measures the low-precision
+        program's parity against the f32 offline transform here
+        (docs/quantization.md)."""
+        from mmlspark_tpu.core import plan
+        lane = self._lanes[0]
+        return plan.transform_async(self.stages, padded, lane.cache_host,
+                                    mesh=lane.mesh,
+                                    shard_params=lane.shard_params,
+                                    precision=self.precision).result()
 
     # -- lifecycle --
 

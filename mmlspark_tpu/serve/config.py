@@ -45,6 +45,15 @@ class ServeConfig:
     #                             spec). Drives the /slo burn-rate
     #                             surface and the /healthz state machine
     #                             (docs/observability.md)
+    precision: object = None    # server-wide default serving precision —
+    #                             a core.precision.PrecisionPolicy /
+    #                             "f32"|"bf16"|"int8w" string / dict of
+    #                             policy fields / None (= f32, the
+    #                             historical byte-identical programs).
+    #                             add_model(precision=...) overrides per
+    #                             model; parity vs the f32 offline
+    #                             transform is calibrated at load
+    #                             (docs/quantization.md)
 
     def __post_init__(self):
         buckets = tuple(sorted({int(b) for b in self.buckets}))

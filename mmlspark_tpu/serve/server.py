@@ -110,10 +110,42 @@ def _example_rows(schema: Any, n: int) -> DataTable | None:
     return table
 
 
+def _max_abs_parity(ref: DataTable, got: DataTable,
+                    input_cols: set) -> float | None:
+    """Worst max-abs difference across the transform's numeric output
+    columns (columns the transform ADDED preferred; all shared numeric
+    columns when it only rewrote existing ones). None when nothing
+    numeric is comparable."""
+    cols = [c for c in ref.columns
+            if c in got.columns and c not in input_cols]
+    if not cols:
+        cols = [c for c in ref.columns if c in got.columns]
+    worst = None
+    for c in cols:
+        pair = []
+        for col in (ref[c], got[c]):
+            try:
+                if col.dtype == object:
+                    pair.append(np.stack([np.asarray(v, np.float64)
+                                          for v in col]))
+                else:
+                    pair.append(np.asarray(col, np.float64))
+            except (TypeError, ValueError):
+                pair = []
+                break
+        if len(pair) != 2 or pair[0].shape != pair[1].shape:
+            continue  # non-numeric (images, text) or layout-changing
+        diff = float(np.abs(pair[0] - pair[1]).max()) if pair[0].size \
+            else 0.0
+        worst = diff if worst is None else max(worst, diff)
+    return worst
+
+
 class _ModelEntry:
     def __init__(self, name: str, model: Any, batcher: DynamicBatcher,
                  schema: Any | None, mesh_spec: Any | None = None,
-                 slo: Any = None, health: Any = None):
+                 slo: Any = None, health: Any = None,
+                 precision: Any = None, parity: float | None = None):
         self.name = name
         self.model = model
         self.batcher = batcher
@@ -121,6 +153,8 @@ class _ModelEntry:
         self.mesh_spec = mesh_spec
         self.slo = slo          # obs.slo.SLOTracker
         self.health = health    # obs.health.HealthMonitor
+        self.precision = precision  # core.precision.PrecisionPolicy | None
+        self.parity = parity    # measured max-abs vs f32 offline at load
 
 
 class ModelServer:
@@ -141,7 +175,8 @@ class ModelServer:
     def add_model(self, name: str, model: Any,
                   schema: Any | None = None,
                   example: DataTable | None = None,
-                  mesh: Any = None, shard_params: Any = None) -> None:
+                  mesh: Any = None, shard_params: Any = None,
+                  precision: Any = None) -> None:
         """Register ``model`` under ``name``.
 
         1. **Validate** with the pre-flight analyzer over ``schema`` (or a
@@ -158,15 +193,35 @@ class ModelServer:
            violates its SPMD contract (manual collectives on a dp
            replica; off-contract axes under tp/pp), is a typed
            :class:`ModelLoadError` — still before any device work.
-        3. **Warm** the bucket ladder when concrete example rows are
+        3. **Resolve precision** (optional): ``precision`` (or the
+           server-wide ``ServeConfig.precision``) selects the serving
+           :class:`~mmlspark_tpu.core.precision.PrecisionPolicy` —
+           ``"bf16"`` activations or ``"int8w"`` weight-only int8, both
+           folded into the compile-cache key so every (model, precision)
+           owns its own program ladder and device param tree.
+        4. **Warm** the bucket ladder when concrete example rows are
            available (``example``, or rows synthesized from the schema):
            one compiled program per bucket exists before the first
            request, on EVERY replica.
-        4. **Start** the model's dispatch loop (one lane per replica).
+        5. **Calibrate** (low-precision loads): the quantized program's
+           outputs on the sample batch are measured against the f32
+           offline transform; drift past the policy's pinned tolerance
+           is a typed :class:`ModelLoadError` (docs/quantization.md).
+        6. **Start** the model's dispatch loop (one lane per replica).
         """
         from mmlspark_tpu.analysis import TableSchema, analyze
+        from mmlspark_tpu.core.precision import PrecisionPolicy
 
         stages, cache_host, model = _as_stages(model)
+        try:
+            policy = PrecisionPolicy.parse(
+                precision if precision is not None
+                else self.config.precision)
+        except (TypeError, ValueError) as e:
+            raise ModelLoadError(name, message=(
+                f"model {name!r}: invalid precision policy: {e}")) from e
+        if policy is not None and not policy.active:
+            policy = None  # f32 = the unwrapped fast path
         if schema is None:
             schema = _derived_schema(stages)
         check_schema = schema if schema is not None \
@@ -194,7 +249,8 @@ class ModelServer:
                     f"lockstep models, or drop lockstep for DP scaling"))
             replicas = build_replicas(name, mesh_spec,
                                       shard_params=shard_params)
-            self._audit_sharded(name, stages, schema, mesh_spec, replicas)
+            self._audit_sharded(name, stages, schema, mesh_spec, replicas,
+                                policy)
             # lockstep only on request: build_replicas carves sub-meshes
             # of THIS host's devices, so no serve program today contains
             # a cross-process collective — auto-enabling on process
@@ -222,10 +278,11 @@ class ModelServer:
         stats = ServerStats(self.config.stats_window, model=name)
         batcher = DynamicBatcher(name, stages, cache_host, self.config,
                                  stats, replicas=replicas,
-                                 lockstep=lockstep)
+                                 lockstep=lockstep, precision=policy)
         tracker = SLOTracker(spec, stats,
                              queued_fn=lambda: batcher.queued)
         monitor = HealthMonitor.for_spec(spec)
+        parity = None
         try:
             if self.config.warmup:
                 warm = example
@@ -237,6 +294,8 @@ class ModelServer:
                     _log.info("serve[%s]: no concrete input layout — "
                               "skipping warmup (first request per bucket "
                               "pays the compile)", name)
+            parity = self._calibrate(name, model, batcher, policy,
+                                     example, schema)
         except BaseException:
             batcher.close(drain=False)
             raise
@@ -247,15 +306,19 @@ class ModelServer:
             old = self._models.get(name)
             self._models[name] = _ModelEntry(name, model, batcher, schema,
                                              mesh_spec, slo=tracker,
-                                             health=monitor)
+                                             health=monitor,
+                                             precision=policy,
+                                             parity=parity)
         if old is not None:
             old.batcher.close(drain=True)
-        _log.info("serve[%s]: loaded (%d stage(s), buckets=%s, mesh=%s)",
-                  name, len(stages), self.config.buckets,
-                  mesh_spec.describe() if mesh_spec else "default")
+        _log.info("serve[%s]: loaded (%d stage(s), buckets=%s, mesh=%s, "
+                  "precision=%s)", name, len(stages), self.config.buckets,
+                  mesh_spec.describe() if mesh_spec else "default",
+                  policy.describe() if policy else "f32")
 
     def _audit_sharded(self, name: str, stages: list, schema: Any,
-                       mesh_spec: Any, replicas: Any) -> None:
+                       mesh_spec: Any, replicas: Any,
+                       policy: Any = None) -> None:
         """Static SPMD gate for a sharded serve entry, at load time.
 
         The served segment runs on every replica's sub-mesh, so it must
@@ -263,10 +326,12 @@ class ModelServer:
         DP-replica segment stays manual-collective-free (replicas are
         independent — a collective would deadlock the fan-out), and a
         tp/pp model-parallel segment may communicate only over its
-        model-parallel axes, never ``dp``. Needs a concrete entry layout;
-        a model with no derivable schema skips the audit (the analyzer
-        already passed) and relies on the repo-wide
-        ``check_spmd_clean`` gate."""
+        model-parallel axes, never ``dp``. A low-precision load audits
+        the QUANTIZED composite (``policy`` threads into the plan
+        replay), so the verified program is the dispatched one. Needs a
+        concrete entry layout; a model with no derivable schema skips
+        the audit (the analyzer already passed) and relies on the
+        repo-wide ``check_spmd_clean`` gate."""
         if schema is None or not replicas.replicas:
             return
         from mmlspark_tpu.analysis.spmd import audit_plan_spmd
@@ -277,7 +342,8 @@ class ModelServer:
         try:
             audit = audit_plan_spmd(stages, schema.entry_meta,
                                     mesh=replicas.replicas[0].mesh,
-                                    expect_axes=expect_axes)
+                                    expect_axes=expect_axes,
+                                    precision=policy)
         except Exception as e:  # abstract trace failed: not a verdict
             _log.info("serve[%s]: sharded SPMD audit skipped (%s)",
                       name, e)
@@ -295,6 +361,58 @@ class ModelServer:
             padded = row if bucket == 1 else row.concat(
                 row.take(np.zeros(bucket - 1, dtype=np.int64)))
             batcher.warm(padded)
+
+    def _calibrate(self, name: str, model: Any, batcher: DynamicBatcher,
+                   policy: Any, example: DataTable | None,
+                   schema: Any) -> float | None:
+        """Measured max-abs parity of a low-precision serve program vs
+        the f32 offline transform, on the calibration batch (the caller's
+        ``example`` sample, else one schema-synthesized row). Weight
+        scales need no activation statistics (symmetric per-channel
+        max-abs over the weights themselves); what IS calibrated from
+        data is the *observed* output drift, checked against the
+        policy's pinned tolerance — drift past it fails the load with a
+        typed :class:`ModelLoadError` before the model ever serves.
+        Returns the measured parity (None when no policy is active or no
+        concrete rows exist to calibrate with)."""
+        if policy is None:
+            return None
+        calib = example
+        if calib is None and schema is not None:
+            calib = _example_rows(schema, 1)
+        if calib is None or not len(calib):
+            _log.info("serve[%s]: no calibration rows — %s parity "
+                      "unverified at load (first requests trust the "
+                      "pinned tolerance)", name, policy.describe())
+            return None
+        n = min(len(calib), self.config.max_bucket)
+        calib = calib.take(np.arange(n))
+        bucket = self.config.bucket_for(n, name)
+        padded = calib if bucket == n else calib.take(
+            np.arange(bucket) % n)
+        try:
+            ref = model.transform(calib)          # the f32 offline path
+            got = batcher.probe(padded)           # the served program
+        except BaseException as e:
+            raise ModelLoadError(name, message=(
+                f"model {name!r}: {policy.describe()} calibration run "
+                f"failed: {type(e).__name__}: {e}")) from e
+        if len(got) != len(padded):  # row-changing transform: serving
+            #                          rejects it per batch anyway
+            _log.info("serve[%s]: calibration transform changed the row "
+                      "count — parity unverified", name)
+            return None
+        parity = _max_abs_parity(ref, got.take(np.arange(n)),
+                                 set(calib.columns))
+        tol = policy.resolve_tolerance()
+        if parity is not None and parity > tol:
+            raise ModelLoadError(name, message=(
+                f"model {name!r}: {policy.describe()} serving diverges "
+                f"from the f32 offline transform by max-abs {parity:.4g} "
+                f"on the {n}-row calibration batch (pinned tolerance "
+                f"{tol:g}) — pin a wider per-model tolerance explicitly "
+                "or serve at a wider precision"))
+        return parity
 
     # -- request surface --
 
@@ -344,6 +462,10 @@ class ModelServer:
                 snap["programs_compiled"] = programs
             if e.mesh_spec is not None:
                 snap["mesh"] = e.mesh_spec.describe()
+            if e.precision is not None:
+                snap["precision"] = e.precision.describe()
+                if e.parity is not None:
+                    snap["precision_parity"] = e.parity
             out[e.name] = snap
         return out
 

@@ -32,15 +32,23 @@ from mmlspark_tpu.stages.image import ImageTransformer, UnrollImage
 from mmlspark_tpu.stages.indexers import ValueIndexerModel
 
 
-def assert_schema_matches(pred: TableSchema, obs: TableSchema) -> None:
+def assert_schema_matches(pred: TableSchema, obs: TableSchema,
+                          strict_dtypes: bool = False) -> None:
     """Every concretely-predicted fact must hold in the observed schema;
-    unknown-marked columns must at least exist."""
+    unknown-marked columns must at least exist. ``strict_dtypes`` (the
+    round-12 dtype-flow pin) additionally requires every non-unknown
+    prediction to CARRY a dtype equal to the observed one — a stage
+    whose ``infer_schema`` stops predicting output dtypes fails here,
+    not downstream when a precision policy trusts the declared dtype."""
     assert list(pred.columns) == list(obs.columns)
     for name, p in pred.columns.items():
         o = obs.columns[name]
         if p.kind == "unknown" or o.kind == "unknown":
             continue
         assert p.kind == o.kind, f"{name}: {p.kind} != {o.kind}"
+        if strict_dtypes and o.dtype is not None:
+            assert p.dtype is not None, \
+                f"{name}: no predicted dtype (observed {o.dtype})"
         if p.dtype is not None and o.dtype is not None:
             assert p.dtype == o.dtype, f"{name}: {p.dtype} != {o.dtype}"
         if p.shape is not None and o.shape is not None:
@@ -153,7 +161,37 @@ def test_prediction_matches_execution(case):
         out = PipelineModel(stages).transform(table)
     assert report.plan.uploads == c.uploads, report.plan.format()
     assert report.plan.fetches == c.fetches
-    assert_schema_matches(report.schema, TableSchema.from_table(out))
+    assert_schema_matches(report.schema, TableSchema.from_table(out),
+                          strict_dtypes=True)
+    # the dtype flow (round 12): every device segment's report carries
+    # its per-column output dtypes, equal to what execution produced
+    obs = TableSchema.from_table(out)
+    for seg in report.plan.device_segments:
+        assert seg.out_dtypes, seg.describe()
+        for col, dt in seg.out_dtypes.items():
+            o = obs.columns.get(col)
+            if o is not None and o.dtype is not None:
+                assert dt == o.dtype, (col, dt, o.dtype)
+
+
+def test_plan_report_resolves_precision_and_tolerance():
+    """tools/analyze.py pipeline --precision: each device segment's
+    report names its resolved precision policy and the expected parity
+    tolerance (docs/quantization.md); the predicted schema is
+    policy-independent (outputs restore their declared dtypes)."""
+    stages, table = _case_three_stage_model()
+    base = analyze(stages, TableSchema.from_table(table),
+                   n_rows=len(table))
+    quant = analyze(stages, TableSchema.from_table(table),
+                    n_rows=len(table), precision="int8w")
+    assert quant.ok
+    seg = quant.plan.device_segments[0]
+    assert seg.precision == "int8w" and seg.tolerance == 0.2
+    assert "precision int8w" in seg.describe()
+    assert "scores:float32" in seg.describe()
+    assert base.plan.device_segments[0].precision == "f32"
+    assert base.schema.summary() == quant.schema.summary()
+    assert "precision int8w" in quant.format()
 
 
 def test_audit_structure_matches_describe_plan():

@@ -276,6 +276,79 @@ def test_jx107_clean_counterparts():
     assert lint_source(src_pragma, "x.py") == []
 
 
+JX108_FLAGGED = '''
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(params, x):
+    scale = np.float64(0.5)                           # JX108
+    y = x * scale
+    return jnp.zeros((4,), dtype=np.float64) + y      # JX108
+
+
+class Stage:
+    def device_fn(self, meta):
+        offset = np.double(1.0)                       # JX108
+
+        def fwd(params, x):
+            z = jnp.asarray(0.1, dtype="float64")     # JX108
+            return x * offset + z
+
+        return fwd
+
+
+def train(batches, state, step_masked):
+    for b in batches:
+        lr = np.float64(1e-3)                         # JX108
+        state, metrics = step_masked(state, b, lr)
+    return state
+
+
+def serve_loop(batches, dispatch_async):
+    outs = []
+    for b in batches:
+        outs.append(dispatch_async(b * np.float64(2)))    # JX108
+    return outs
+'''
+
+
+def test_jx108_flags_f64_in_device_code():
+    findings = lint_source(JX108_FLAGGED, "fixture108.py")
+    got = sorted((f.rule, f.line) for f in findings)
+    lines = JX108_FLAGGED.splitlines()
+    want = sorted(("JX108", i + 1) for i, text in enumerate(lines)
+                  if "# JX108" in text)
+    assert got == want, (got, want)
+
+
+def test_jx108_clean_counterparts():
+    # f32 spellings and python literals are the prescribed fix; f64 in
+    # plain host code (no step/dispatch loop, not traced) is fine
+    clean = JX108_FLAGGED.replace("float64", "float32").replace(
+        "np.double", "np.float32")
+    assert [f.rule for f in lint_source(clean, "x.py")
+            if f.rule == "JX108"] == []
+    host = ("import numpy as np\n"
+            "def offline_report(rows):\n"
+            "    acc = np.float64(0)\n"
+            "    for r in rows:\n"
+            "        acc += np.mean(r, dtype=np.float64)\n"
+            "    return acc\n")
+    assert lint_source(host, "x.py") == []
+
+
+def test_jx108_pragma_suppresses():
+    src = ("import jax\nimport numpy as np\n"
+           "@jax.jit\n"
+           "def step(x):\n"
+           "    s = np.float64(0.5)  # lint-jax: allow(JX108)\n"
+           "    return x * s\n")
+    assert lint_source(src, "x.py") == []
+
+
 def test_pragma_suppresses():
     src = ("import jax\n"
            "@jax.jit\n"

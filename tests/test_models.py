@@ -316,6 +316,56 @@ def test_fold_batchnorm_numerics_parity():
                                rtol=1e-4, atol=1e-5)
 
 
+def test_fold_batchnorm_bf16_keeps_constants_f32_and_tracks_reference():
+    """The folded-BN constants contract (round 12): under
+    ``param_dtype=bf16`` only the ≥2-D kernels narrow — the μ/σ-derived
+    ``fold*`` biases (and every 1-D leaf) stay float32 and are added at
+    an explicit f32 site, so a bf16 inference variant's error is bounded
+    by the conv-output quantization alone, never by quantized
+    normalization constants. Pinned on trained-scale statistics (means
+    far from 0) against the f32 BN net in inference mode."""
+    import jax
+    import jax.numpy as jnp
+    from mmlspark_tpu.models.resnet import fold_batchnorm, resnet18_thin
+
+    bn = resnet18_thin(norm="batch", dtype=jnp.float32)
+    r = np.random.default_rng(0)
+    x = jnp.asarray(r.normal(size=(4, 32, 32, 3)).astype(np.float32)
+                    * 50 + 100)  # raw-pixel-scale input
+    variables = bn.init(jax.random.PRNGKey(0), x)
+    rs = np.random.default_rng(1)
+
+    def inflate(tree):  # trained-like stats: means ~20, vars ~5
+        out = {}
+        for k, v in tree.items():
+            if isinstance(v, dict):
+                out[k] = inflate(v)
+            elif k == "mean":
+                out[k] = jnp.asarray(rs.normal(20, 10, v.shape),
+                                     jnp.float32)
+            else:
+                out[k] = jnp.asarray(
+                    np.abs(rs.normal(5, 2, v.shape)) + 0.5, jnp.float32)
+        return out
+
+    variables = {"params": variables["params"],
+                 "batch_stats": inflate(variables["batch_stats"])}
+    ref = np.asarray(bn.apply(variables, x, train=False,
+                              output="features"))
+    folded = fold_batchnorm(variables, param_dtype=jnp.bfloat16)
+    for path, leaf in jax.tree_util.tree_flatten_with_path(folded)[0]:
+        name = "/".join(str(k) for k in path)
+        if "fold" in name or leaf.ndim < 2:
+            assert leaf.dtype == jnp.float32, (name, leaf.dtype)
+        else:
+            assert leaf.dtype == jnp.bfloat16, (name, leaf.dtype)
+    nf = resnet18_thin(norm="none", dtype=jnp.bfloat16)
+    got = np.asarray(nf.apply({"params": folded}, x, output="features"))
+    scale = np.abs(ref).max()
+    assert np.abs(got - ref).max() / scale < 2e-2, (
+        np.abs(got - ref).max(), scale)
+
+
 def test_s2d_stem_matches_direct_stem():
     """The space-to-depth stem is a layout trick: same params, same output
     as the direct 7x7/s2 conv stem."""
@@ -335,20 +385,27 @@ def test_s2d_stem_matches_direct_stem():
 
 
 def test_resnet_infer_zoo_bundle():
-    """The zoo inference variant: bf16 folded params, runnable end to end
-    through the bundle API, feature dim matches the train variant."""
+    """The zoo inference variant: bf16 folded KERNELS, runnable end to
+    end through the bundle API, feature dim matches the train variant.
+    The μ/σ-derived fold constants (and every 1-D leaf) stay float32 —
+    the accumulate-in-f32 contract of fold_batchnorm: a bf16 centering
+    bias added in bf16 silently degraded normalization numerics."""
     import jax
     import jax.numpy as jnp
 
     b = get_model("ResNet_Small_Infer")
-    assert all(l.dtype == jnp.bfloat16
-               for l in jax.tree_util.tree_leaves(b.params))
+    flat = jax.tree_util.tree_flatten_with_path(b.params)[0]
+    for path, leaf in flat:
+        want = jnp.bfloat16 if leaf.ndim >= 2 else jnp.float32
+        name = "/".join(str(k) for k in path)
+        assert leaf.dtype == want, (name, leaf.dtype)
     out = b.apply(np.zeros((2, 32, 32, 3), np.float32), output="features")
     assert out.shape == (2, 128)
-    # no norm params anywhere in the folded tree
-    flat = jax.tree_util.tree_flatten_with_path(b.params)[0]
+    # no norm params anywhere in the folded tree (the fold* sites hold
+    # only the f32 constants)
     names = {"/".join(str(k) for k in path) for path, _ in flat}
     assert not any("gn" in n or "bn" in n for n in names), names
+    assert any("fold" in n for n in names), names
 
 
 def test_resnet_infer_featurizer_product_path():

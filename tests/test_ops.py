@@ -60,6 +60,60 @@ class TestKernelEquivalence:
                                        rtol=1e-4, atol=1e-4)
 
 
+class TestF32Accumulation:
+    """Regression pins for the accumulate-in-f32 contract (round 12): a
+    bf16 activation policy (docs/quantization.md) feeds norms bf16
+    inputs, so the statistics math must hold up independent of the
+    input's numeric range. The kernel's former one-pass E[x²]−E[x]²
+    variance cancelled catastrophically in f32 for offset feature maps
+    (measured max err 69.2 at mean=200, spread=0.02 — vs 1e-3 for the
+    two-pass form); both implementations are now pinned against the f64
+    numpy oracle."""
+
+    @staticmethod
+    def oracle_f64(x, scale, bias, groups, eps=1e-6):
+        n, h, w, c = x.shape
+        cg = c // groups
+        xf = np.asarray(x, np.float64).reshape(n, h * w, groups, cg)
+        mean = xf.mean(axis=(1, 3), keepdims=True)
+        var = ((xf - mean) ** 2).mean(axis=(1, 3), keepdims=True)
+        out = (xf - mean) / np.sqrt(var + eps)
+        return (out.reshape(n, h, w, c)
+                * np.asarray(scale, np.float64)
+                + np.asarray(bias, np.float64))
+
+    @pytest.mark.parametrize("center,spread", [
+        (0.0, 1.0), (8.0, 0.05), (64.0, 0.05), (200.0, 0.02),
+    ])
+    def test_offset_feature_maps_match_f64_oracle(self, center, spread):
+        from mmlspark_tpu.ops.group_norm import _group_norm_fwd_pallas
+        r = np.random.default_rng(0)
+        x = jnp.asarray(
+            r.normal(center, spread, (2, 8, 8, 32)).astype(np.float32))
+        s = jnp.asarray(r.normal(size=32).astype(np.float32))
+        b = jnp.asarray(r.normal(size=32).astype(np.float32))
+        want = self.oracle_f64(np.asarray(x, np.float64), s, b, 8)
+        for got in (_group_norm_fwd_pallas(x, s, b, 8, 1e-6, False),
+                    group_norm_reference(x, s, b, 8)):
+            err = np.abs(np.asarray(got, np.float64) - want).max()
+            assert err < 5e-3, (center, spread, err)
+
+    def test_bf16_inputs_track_f64_oracle(self):
+        # bf16 input: the error floor is the input's own quantization —
+        # the f32 statistics must not add to it materially
+        from mmlspark_tpu.ops.group_norm import _group_norm_fwd_pallas
+        r = np.random.default_rng(1)
+        for center in (0.0, 64.0):
+            x = jnp.asarray(r.normal(center, 0.05, (2, 8, 8, 32)),
+                            jnp.bfloat16)
+            s, b = jnp.ones(32), jnp.zeros(32)
+            # the oracle consumes the SAME bf16-quantized values
+            want = self.oracle_f64(np.asarray(x, np.float64), s, b, 8)
+            got = np.asarray(_group_norm_fwd_pallas(
+                x, s, b, 8, 1e-6, False), np.float64)
+            assert np.abs(got - want).max() < 3e-2, center
+
+
 class TestVmemGate:
     def test_large_blocks_fall_back(self):
         # the ResNet stem shape (112·112·64): C=64 pads to 128 lanes → 2×

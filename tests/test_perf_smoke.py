@@ -14,9 +14,9 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
 
 from perf_smoke import (  # noqa: E402
     check_fused_crossings, check_flight_recorder, check_obs_overhead,
-    check_obs_request_tracing, check_serve_batching, check_serve_sharded,
-    check_spmd_clean, check_train_device_preprocess, check_train_elastic,
-    check_train_prefetch,
+    check_obs_request_tracing, check_serve_batching, check_serve_lowprec,
+    check_serve_sharded, check_spmd_clean, check_train_device_preprocess,
+    check_train_elastic, check_train_prefetch,
 )
 
 
@@ -124,6 +124,24 @@ def test_serve_burst_compiles_bounded_and_coalesces():
         or result["programs_compiled"] <= len(result["buckets"])
     assert result["distinct_batch_shapes"] <= len(result["buckets"])
     assert result["batch_occupancy_mean"] > 1.0
+
+
+def test_serve_lowprec_parity_programs_and_audit():
+    """Low-precision serving (round 12): an int8w+bf16-served model's
+    outputs stay within its pinned tolerance of the f32 offline
+    transform across packings, the load-time calibration measured a
+    real parity, compiled programs stay <= len(buckets) per
+    (model, precision), quantized params ship <= 0.35x the f32 bytes,
+    and audit_plan_spmd verifies the quantized segment clean."""
+    result = check_serve_lowprec()
+    assert 0 < result["serve_parity_max_abs"] <= result["pinned_tolerance"]
+    assert 0 < result["calibration_parity"] <= result["pinned_tolerance"]
+    assert result["programs_compiled"] is None \
+        or result["programs_compiled"] <= len(result["buckets"])
+    assert result["distinct_batch_shapes"] <= len(result["buckets"])
+    assert result["weight_bytes_ratio"] <= 0.35
+    assert result["audit_findings"] == 0
+    assert result["audit_collectives"] == 0
 
 
 def test_serve_dp_replica_fanout_multiplies_throughput():

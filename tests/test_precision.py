@@ -1,0 +1,230 @@
+"""The plan-level precision/quantization pass (core/precision.py,
+round 12): policy parsing and identity, per-channel int8 weight
+quantization, the bf16-activation composite transform through
+``core/plan``, compile-cache separation per policy, serve-load
+calibration against the f32 offline transform, and the SPMD audit of
+the quantized segment. docs/quantization.md documents the contracts
+pinned here."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from mmlspark_tpu.core import plan
+from mmlspark_tpu.core.precision import (
+    DEFAULT_TOLERANCES, PrecisionPolicy, QuantizedLeaf, cast_activation,
+    materialize, quantize_channelwise, quantize_params, quantized_bytes,
+)
+from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.models.bundle import ModelBundle
+from mmlspark_tpu.models.jax_model import JaxModel
+from mmlspark_tpu.models.zoo import MLP
+
+
+def mlp_stage(d_in=32, width=64, n_out=8, seed=0):
+    module = MLP(features=(width,), num_outputs=n_out)
+    params = module.init(jax.random.PRNGKey(seed),
+                         np.zeros((1, d_in), np.float32))["params"]
+    bundle = ModelBundle(
+        module=module,
+        params=jax.tree_util.tree_map(np.asarray, params),
+        input_spec=(d_in,), output_names=("features", "logits"))
+    return JaxModel(model=bundle, input_col="x", output_col="scores",
+                    mesh_spec={"dp": 1})
+
+
+def vec_table(n=16, d=32, seed=0, scale=2.0):
+    r = np.random.default_rng(seed)
+    return DataTable({"x": list(
+        (r.normal(size=(n, d)) * scale).astype(np.float32))})
+
+
+class TestPolicy:
+    def test_parse_forms(self):
+        assert PrecisionPolicy.parse(None) is None
+        p = PrecisionPolicy.parse("bf16")
+        assert p.mode == "bf16" and p.active
+        q = PrecisionPolicy.parse({"mode": "int8w", "tolerance": 0.5})
+        assert q.mode == "int8w" and q.resolve_tolerance() == 0.5
+        assert PrecisionPolicy.parse(p) is p
+
+    def test_f32_is_inactive(self):
+        p = PrecisionPolicy.parse("f32")
+        assert not p.active
+        assert p.resolve_tolerance() == 0.0
+
+    def test_invalid_inputs_raise(self):
+        with pytest.raises(ValueError, match="unknown precision mode"):
+            PrecisionPolicy(mode="fp8")
+        with pytest.raises(ValueError, match="tolerance"):
+            PrecisionPolicy(mode="bf16", tolerance=-1.0)
+        with pytest.raises(TypeError, match="cannot parse"):
+            PrecisionPolicy.parse(3.14)
+
+    def test_defaults_and_describe(self):
+        for mode, tol in DEFAULT_TOLERANCES.items():
+            p = PrecisionPolicy(mode=mode)
+            assert p.resolve_tolerance() == tol
+            assert mode in p.describe()
+        # cache tokens differ per mode (program identity)
+        tokens = {PrecisionPolicy(mode=m).cache_token
+                  for m in ("bf16", "int8w")}
+        assert len(tokens) == 2
+
+
+class TestQuantization:
+    def test_channelwise_roundtrip_error_bounded(self):
+        r = np.random.default_rng(0)
+        w = (r.normal(size=(48, 24)) * r.uniform(0.1, 10, size=24)
+             ).astype(np.float32)  # per-channel dynamic ranges
+        leaf = quantize_channelwise(w)
+        assert leaf.q.dtype == np.int8 and leaf.scale.shape == (24,)
+        deq = leaf.q.astype(np.float32) * leaf.scale
+        # symmetric rounding: error ≤ scale/2 per element, per channel
+        assert (np.abs(deq - w) <= leaf.scale / 2 + 1e-7).all()
+
+    def test_zero_channel_is_safe(self):
+        w = np.zeros((8, 4), np.float32)
+        leaf = quantize_channelwise(w)
+        assert (leaf.q == 0).all() and np.isfinite(leaf.scale).all()
+
+    def test_quantize_params_leaf_rules(self):
+        import jax.numpy as jnp
+        params = {
+            "kernel": np.ones((64, 32), np.float32),   # → int8
+            "tiny": np.ones((2, 2), np.float32),       # small → bf16
+            "bias": np.ones((32,), np.float32),        # 1-D → f32
+            "ids": np.arange(4, dtype=np.int32),       # non-float → as-is
+        }
+        out = quantize_params(params, PrecisionPolicy(mode="int8w"))
+        assert isinstance(out["kernel"], QuantizedLeaf)
+        assert out["tiny"].dtype == jnp.bfloat16
+        assert out["bias"].dtype == np.float32
+        assert out["ids"].dtype == np.int32
+        # bf16 mode: kernels narrow, no int8
+        out16 = quantize_params(params, PrecisionPolicy(mode="bf16"))
+        assert out16["kernel"].dtype == jnp.bfloat16
+        assert out16["bias"].dtype == np.float32
+
+    def test_materialize_and_cast_roundtrip(self):
+        import jax.numpy as jnp
+        pol = PrecisionPolicy(mode="int8w")
+        stored = quantize_params(
+            {"w": np.full((32, 16), 0.5, np.float32)}, pol)
+        live = materialize(stored, pol)
+        assert live["w"].dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(live["w"], np.float32),
+                                   0.5, rtol=2e-2)
+        x = jnp.ones((4, 3), jnp.float32)
+        assert cast_activation(x, pol).dtype == jnp.bfloat16
+        u8 = jnp.ones((4, 3), jnp.uint8)
+        assert cast_activation(u8, pol).dtype == jnp.uint8
+
+    def test_int8_storage_ships_thin(self):
+        jm = mlp_stage()
+        seg = plan.collect_segment(
+            [jm], 0, lambda c: plan._entry_meta(vec_table(), c),
+            min_stages=1, precision=PrecisionPolicy(mode="int8w"))
+        _fn, stored = plan.segment_composite(seg, plan._segment_mesh(seg))
+        nbytes, f32_bytes = quantized_bytes(stored)
+        assert nbytes < 0.35 * f32_bytes  # ~4x weight cut (+scales)
+
+
+class TestPlanPass:
+    def test_parity_and_output_dtype_per_mode(self):
+        jm = mlp_stage()
+        table = vec_table()
+        ref = np.stack(list(jm.transform(table)["scores"]))
+        for mode, tol in (("f32", 0.0), ("bf16", 5e-2), ("int8w", 2e-1)):
+            out = plan.transform_async(
+                [jm], table, jm,
+                precision=PrecisionPolicy(mode=mode)).result()
+            got = np.stack(list(out["scores"]))
+            assert got.dtype == np.float32  # declared dtype restored
+            diff = np.abs(got - ref).max()
+            if mode == "f32":
+                assert diff == 0.0  # inactive policy: byte-identical
+            else:
+                assert 0 < diff <= tol, (mode, diff)
+
+    def test_policies_never_share_compiled_entries(self):
+        jm = mlp_stage()
+        table = vec_table(n=4)
+        for mode in (None, "bf16", "int8w"):
+            pol = PrecisionPolicy.parse(mode)
+            plan.transform_async([jm], table, jm,
+                                 precision=pol).result()
+        cache = jm.__dict__["_plan_cache"]
+        assert len(cache) == 3  # one entry per (layout, policy)
+        # and an explicit f32 policy shares the unset-policy entry
+        plan.transform_async([jm], table, jm,
+                             precision=PrecisionPolicy(mode="f32")
+                             ).result()
+        assert len(jm.__dict__["_plan_cache"]) == 3
+
+    def test_audit_plan_spmd_verifies_quantized_segment_clean(self):
+        from mmlspark_tpu.analysis.spmd import audit_plan_spmd
+        jm = mlp_stage()
+        table = vec_table()
+        audit = audit_plan_spmd(
+            [jm], lambda c: plan._entry_meta(table, c), n_rows=len(table),
+            precision=PrecisionPolicy(mode="int8w"))
+        assert audit.ok, audit.format()
+        assert len(audit.segments) == 1
+        assert audit.segments[0].schedule.ops == []
+
+
+class TestServeCalibration:
+    def test_load_measures_parity_and_serves_within_it(self):
+        from mmlspark_tpu.serve import ModelServer, ServeConfig
+        jm = mlp_stage()
+        table = vec_table(n=20)
+        ref = np.stack(list(jm.transform(table)["scores"]))
+        server = ModelServer(ServeConfig(buckets=(1, 8), max_queue=64,
+                                         deadline_ms=None))
+        try:
+            server.add_model("m", mlp_stage(), precision="int8w",
+                             example=table.take(np.arange(8)))
+            snap = server.snapshot()["m"]
+            assert snap["precision"].startswith("int8w")
+            assert 0 < snap["precision_parity"] <= 2e-1
+            # mixed packings: single rows and multi-row requests
+            handles = [server.submit(
+                "m", table.take(np.arange(i, min(i + 5, 20))))
+                for i in range(0, 20, 5)]
+            handles += [server.submit("m", table.take(np.arange(i, i + 1)))
+                        for i in range(4)]
+            outs = [h.result(timeout=60) for h in handles]
+        finally:
+            server.close()
+        got = np.concatenate(
+            [np.stack(list(o["scores"])) for o in outs[:4]])
+        assert np.abs(got - ref).max() <= 2e-1
+        for i, o in enumerate(outs[4:]):
+            assert np.abs(np.asarray(o["scores"][0]) - ref[i]).max() \
+                <= 2e-1
+
+    def test_drift_past_pinned_tolerance_fails_the_load(self):
+        from mmlspark_tpu.serve import ModelServer, ServeConfig
+        from mmlspark_tpu.serve.errors import ModelLoadError
+        server = ModelServer(ServeConfig(buckets=(1, 8),
+                                         deadline_ms=None))
+        try:
+            with pytest.raises(ModelLoadError, match="diverges"):
+                server.add_model(
+                    "m", mlp_stage(),
+                    precision={"mode": "int8w", "tolerance": 1e-9},
+                    example=vec_table(n=4))
+        finally:
+            server.close()
+
+    def test_invalid_policy_is_a_typed_load_error(self):
+        from mmlspark_tpu.serve import ModelServer, ServeConfig
+        from mmlspark_tpu.serve.errors import ModelLoadError
+        server = ModelServer(ServeConfig())
+        try:
+            with pytest.raises(ModelLoadError, match="invalid precision"):
+                server.add_model("m", mlp_stage(), precision="fp4")
+        finally:
+            server.close()

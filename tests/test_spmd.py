@@ -62,11 +62,17 @@ EXPECTED_SCHEDULES = {
     "serve_dp_replica": [],
     "serve_tp_segment": [],
     "serve_pp_segment": [("ppermute", ("pp",)), ("psum", ("pp",))],
+    # the int8w+bf16 quantized serve segments: the precision pass
+    # (dequant + activation casts) is pure elementwise math — it must
+    # introduce NO collectives on a replica nor under GSPMD-tp
+    "serve_int8w_replica": [],
+    "serve_int8w_tp": [],
 }
 
 # shard_map sites per entry point: 1 for every manual-collective module,
 # 0 for the GSPMD-only serve segments (no shard_map at all)
-EXPECTED_SITES = {"serve_dp_replica": 0, "serve_tp_segment": 0}
+EXPECTED_SITES = {"serve_dp_replica": 0, "serve_tp_segment": 0,
+                  "serve_int8w_replica": 0, "serve_int8w_tp": 0}
 
 
 @pytest.mark.parametrize("ep", ENTRY_POINTS, ids=lambda e: e.name)

@@ -3,7 +3,7 @@
 Three subcommands::
 
     python tools/analyze.py pipeline <saved-stage-dir> --schema schema.json
-        [--rows N] [--strict]
+        [--rows N] [--precision f32|bf16|int8w] [--strict]
     python tools/analyze.py code [path ...]
     python tools/analyze.py spmd [target ...] [--schema schema.json]
         [--rows N] [--cpu-devices N]
@@ -60,7 +60,8 @@ def cmd_pipeline(args: argparse.Namespace) -> int:
         spec = json.load(fh)
     schema = TableSchema.from_spec(spec)
     stage = PipelineStage.load(args.model)
-    report = analyze(stage, schema, n_rows=args.rows)
+    report = analyze(stage, schema, n_rows=args.rows,
+                     precision=args.precision)
     print(report.format())
     if report.errors or (args.strict and report.warnings):
         return 1
@@ -131,6 +132,11 @@ def main(argv: list[str] | None = None) -> int:
                    help="JSON file declaring the input column schema")
     p.add_argument("--rows", type=int, default=None,
                    help="row count for concrete crossing prediction")
+    p.add_argument("--precision", default=None,
+                   choices=["f32", "bf16", "int8w"],
+                   help="resolve each device segment's serving precision "
+                        "policy in the plan report (mode + expected "
+                        "parity tolerance; docs/quantization.md)")
     p.add_argument("--strict", action="store_true",
                    help="exit non-zero on warnings too")
     p.set_defaults(func=cmd_pipeline)

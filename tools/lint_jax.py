@@ -32,6 +32,16 @@ jax initialization) catching the mistakes that cost the most on TPU:
   through the bounded in-flight window and drain the *oldest* entry (or
   fetch after the loop) — the discipline of
   ``mmlspark_tpu/serve/batcher.py``.
+* **JX108 implicit f64 promotion in device code** — ``np.float64(...)``/
+  ``np.double(...)`` scalar constructors or ``dtype=np.float64`` /
+  ``dtype="float64"`` arguments inside a jit-traced body, a device-stage
+  body (a function defined inside ``device_fn``/``device_fn_mesh``), or
+  a step/serve dispatch loop. numpy float64 scalars are STRONGLY typed
+  under jax promotion, so one leaking into jitted math silently widens
+  a bf16/f32 activation chain (the exact degradation a bf16 serving
+  policy exists to avoid — docs/quantization.md); python float literals
+  are weak-typed and fine, which is why the rule targets the np scalar
+  forms specifically.
 * **JX107 host-side image work under a device-preprocess spec** —
   ``imgops.resize``/any ``cv2.*`` call/PIL decode (``Image.open``,
   ``decode_image``) inside a train step loop or inside a function fed to
@@ -116,6 +126,10 @@ RULES = {
              "producer while a device-preprocess spec is active; ship "
              "thin uint8 and replay the geometry on device "
              "(train/preprocess.py)",
+    "JX108": "np.float64/np.double scalar (or dtype=float64) inside "
+             "device-stage bodies or step/serve loops; numpy f64 scalars "
+             "are strongly typed and silently widen bf16/f32 activation "
+             "chains — use np.float32 or a python literal",
     "JX201": "collective under data-dependent control flow (lax.cond/"
              "switch/while_loop); hoist it out — hosts that disagree on "
              "the predicate deadlock",
@@ -142,6 +156,13 @@ _COND_CALLS = {"cond", "switch", "while_loop"}
 
 # the callee-name hint marking a train-step call whose outputs JX105 tracks
 _STEP_HINT = "step"
+
+# JX108: the strongly-typed f64 spellings (namespace attr names) and the
+# namespaces they ride on. jnp.float64 is included — with x64 disabled it
+# canonicalizes, but code written against it flips behavior the moment a
+# library enables x64
+_F64_ATTRS = {"float64", "double"}
+_F64_NAMESPACES = {"np", "numpy", "onp", "jnp"}
 
 # PIL-style decode roots for JX107 (cv2 is matched as a whole namespace)
 _PIL_ROOTS = {"Image", "PIL"}
@@ -326,19 +347,65 @@ class _Linter(ast.NodeVisitor):
                               "a dispatched batch",
                               "inside the serve dispatch loop",
                               flag_np=True)
+        has_step = any(
+            isinstance(sub, ast.Call)
+            and (name := _callee_name(sub.func)) is not None
+            and _is_step_call(name)
+            for sub in ast.walk(node))
         # JX107: host image work in a loop that dispatches train steps,
         # in a module where a device-preprocess spec is active
-        if self.uses_device_preprocess:
-            has_step = any(
-                isinstance(sub, ast.Call)
-                and (name := _callee_name(sub.func)) is not None
-                and _is_step_call(name)
-                for sub in ast.walk(node))
-            if has_step:
-                self._lint_host_image_calls(node, "the train step loop")
+        if self.uses_device_preprocess and has_step:
+            self._lint_host_image_calls(node, "the train step loop")
+        # JX108: f64 scalars built in a step or serve dispatch loop —
+        # they feed the loop's device calls as strong float64
+        has_dispatch = any(
+            isinstance(sub, ast.Call)
+            and (name := _callee_name(sub.func)) is not None
+            and _is_dispatch_call(name)
+            for sub in ast.walk(node))
+        if has_step or has_dispatch:
+            self._lint_f64_sites(node)
         self.loop_depth += 1
         self.generic_visit(node)
         self.loop_depth -= 1
+
+    # -- JX108: strongly-typed f64 leaking into device code --
+
+    def _is_f64_expr(self, expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Constant) and expr.value in ("float64",
+                                                             "double"):
+            return True
+        if isinstance(expr, ast.Attribute) and expr.attr in _F64_ATTRS:
+            root = expr.value
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            return isinstance(root, ast.Name) \
+                and root.id in _F64_NAMESPACES
+        return False
+
+    def _lint_f64_sites(self, scope: ast.AST) -> None:
+        """Flag f64-spelling sites anywhere in ``scope`` (a traced body,
+        a device-stage body, or a step/serve loop). The message is
+        context-free so a site reachable through two scopes (a jitted
+        fn inside a step loop) reports once — ``_emit`` dedups."""
+        for sub in ast.walk(scope):
+            if not isinstance(sub, ast.Call):
+                continue
+            if self._is_f64_expr(sub.func):
+                self._emit(sub, "JX108",
+                           f"{ast.unparse(sub.func)}(...) builds a "
+                           "strongly-typed float64 scalar in device "
+                           "code — it silently widens bf16/f32 "
+                           "activation chains; use np.float32 or a "
+                           "python literal")
+                continue
+            for kw in sub.keywords:
+                if kw.arg == "dtype" and self._is_f64_expr(kw.value):
+                    self._emit(sub, "JX108",
+                               f"dtype={ast.unparse(kw.value)} in device "
+                               "code — it silently widens bf16/f32 "
+                               "activation chains; use np.float32 or a "
+                               "python literal")
 
     def _lint_host_image_calls(self, scope: ast.AST, where: str) -> None:
         for sub in ast.walk(scope):
@@ -575,6 +642,11 @@ class _Linter(ast.NodeVisitor):
         name = getattr(node, "name", None)
         if _has_jit_decorator(node) or (name and name in self.jitted_names):
             self._lint_traced_body(node)
+        if name in ("device_fn", "device_fn_mesh"):
+            # a device-stage body: everything built here (closure
+            # constants included) flows into the planner's jitted
+            # composite — JX108 guards the f64 spellings
+            self._lint_f64_sites(node)
 
     def lint_lambdas(self) -> None:
         for lam in self.jitted_lambdas:
@@ -583,6 +655,7 @@ class _Linter(ast.NodeVisitor):
     def _lint_traced_body(self, fn: ast.AST) -> None:
         """Flag host syncs anywhere inside a traced function (nested defs
         included — they trace too)."""
+        self._lint_f64_sites(fn)  # JX108 rides every traced body
         body = fn.body if isinstance(fn.body, list) else [fn.body]
         for stmt in body:
             for node in ast.walk(stmt):

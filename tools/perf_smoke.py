@@ -48,6 +48,14 @@ flake on a loaded CI box):
   overlap measures the fan-out honestly), outputs bit-identical across
   replica counts, all four replicas used, and compiled programs still ≤
   ``len(buckets)`` per model — never replicas × buckets.
+* **serve low-precision (int8w+bf16)** — a model served through the
+  plan-level precision pass (``core/precision.py``: per-channel int8
+  weights dequantized in-program, bf16 activations) must stay within
+  its pinned per-model tolerance of the f32 OFFLINE transform across
+  packings, compile ≤ ``len(buckets)`` programs per (model, precision),
+  ship ≤ 0.35× the f32 param bytes, record a real load-time calibration
+  parity, and have its QUANTIZED segment verify clean (zero manual
+  collectives) under ``audit_plan_spmd``.
 * **obs disabled-path overhead** — the observability seams threaded
   through the fused pipeline (docs/observability.md) must cost < 2% of
   the microbench when the tracer is off. Gated on a measured analytic
@@ -803,6 +811,131 @@ def check_serve_sharded(min_speedup: float = 2.5) -> dict:
     }
 
 
+def check_serve_lowprec(tolerance: float = 6e-2) -> dict:
+    """Serve a model int8w+bf16 (weight-only int8, bf16 activations —
+    core/precision.py); raise AssertionError unless its outputs stay
+    within the pinned per-model ``tolerance`` of the f32 OFFLINE
+    transform across packings (single-row, partial-bucket, and
+    full-bucket requests), compiled programs stay ≤ ``len(buckets)``
+    for the (model, precision), the load-time calibration measured a
+    real (non-zero, in-tolerance) parity, the quantized params ship
+    ≤ 0.35× the f32 bytes, and ``audit_plan_spmd`` verifies the
+    QUANTIZED segment clean (zero manual collectives) — the serving
+    half of ROADMAP item 5, gated the PR 9 way on counted seams, not
+    wall clock."""
+    import jax
+
+    from mmlspark_tpu.analysis.spmd import audit_plan_spmd
+    from mmlspark_tpu.core import plan
+    from mmlspark_tpu.core.precision import (
+        PrecisionPolicy, quantized_bytes,
+    )
+    from mmlspark_tpu.data.table import DataTable
+    from mmlspark_tpu.models.bundle import ModelBundle
+    from mmlspark_tpu.models.jax_model import JaxModel
+    from mmlspark_tpu.models.zoo import MLP
+    from mmlspark_tpu.serve import ModelServer, ServeConfig
+
+    buckets, d_in, n_req = (1, 8), 24, 24
+    rng = np.random.default_rng(0)
+    module = MLP(features=(32,), num_outputs=8)
+    params = module.init(jax.random.PRNGKey(0),
+                         np.zeros((1, d_in), np.float32))["params"]
+    bundle = ModelBundle(
+        module=module,
+        params=jax.tree_util.tree_map(np.asarray, params),
+        input_spec=(d_in,), output_names=("features", "logits"))
+
+    def jm():
+        return JaxModel(model=bundle, input_col="x", output_col="scores",
+                        mesh_spec={"dp": 1})
+
+    rows = (rng.normal(size=(n_req, d_in)) * 2).astype(np.float32)
+    table = DataTable({"x": list(rows)})
+    ref = np.stack(list(jm().transform(table)["scores"]))  # f32 offline
+
+    policy = PrecisionPolicy(mode="int8w", tolerance=tolerance)
+    served = jm()
+    server = ModelServer(ServeConfig(buckets=buckets, max_queue=n_req + 8,
+                                     deadline_ms=None))
+    try:
+        server.add_model("m", served, precision=policy,
+                         example=table.take(np.arange(8)))
+        snap_load = server.snapshot()["m"]
+        # packings: 8 single-row, 2× 4-row (partial bucket), 1× 8-row
+        handles = [(i, 1, server.submit("m", table.take(np.arange(i, i + 1))))
+                   for i in range(8)]
+        handles += [(i, 4, server.submit(
+            "m", table.take(np.arange(i, i + 4)))) for i in (8, 12)]
+        handles += [(16, 8, server.submit(
+            "m", table.take(np.arange(16, 24))))]
+        worst = 0.0
+        for start, n, h in handles:
+            got = np.stack(list(h.result(timeout=120)["scores"]))
+            worst = max(worst, float(
+                np.abs(got - ref[start:start + n]).max()))
+        programs = server.compiled_programs("m")
+        snap = server.stats("m").snapshot()
+    finally:
+        server.close()
+
+    assert worst > 0.0, (
+        "int8w serving returned the f32 outputs bit-for-bit — the "
+        "precision pass is not engaging (cache key or policy threading "
+        "regressed)")
+    assert worst <= tolerance, (
+        f"int8w+bf16 serving diverges from the f32 offline transform by "
+        f"max-abs {worst:.4g} across packings (pinned per-model "
+        f"tolerance {tolerance:g})")
+    calibrated = snap_load.get("precision_parity")
+    assert calibrated is not None and 0 < calibrated <= tolerance, (
+        f"load-time calibration parity {calibrated!r} is missing or "
+        f"out of tolerance — ModelServer.add_model's calibration flow "
+        "regressed")
+    assert snap_load.get("precision", "").startswith("int8w")
+    if programs is not None:
+        assert programs <= len(buckets), (
+            f"{programs} XLA programs for a {len(buckets)}-bucket ladder "
+            "under ONE precision — per-(model, precision) compiles must "
+            "stay on the ladder")
+    assert snap["distinct_batch_shapes"] <= len(buckets)
+
+    # the quantized storage really ships thin (the HBM/wire win)
+    seg = plan.collect_segment(
+        [served], 0, lambda c: plan._entry_meta(table, c),
+        min_stages=1, precision=policy)
+    _fn, stored = plan.segment_composite(seg, plan._segment_mesh(seg))
+    nbytes, f32_bytes = quantized_bytes(stored)
+    assert nbytes <= 0.35 * f32_bytes, (
+        f"quantized params are {nbytes} B vs {f32_bytes} B f32 — int8 "
+        "weight storage regressed")
+
+    # the QUANTIZED segment verifies clean against the serve contracts
+    audit = audit_plan_spmd([served],
+                            lambda c: plan._entry_meta(table, c),
+                            n_rows=n_req, precision=policy)
+    assert audit.ok and len(audit.segments) == 1, audit.format()
+    assert audit.segments[0].schedule.ops == [], (
+        "the precision pass introduced manual collectives into the "
+        "served segment")
+
+    return {
+        "buckets": list(buckets),
+        "requests": len(handles),
+        "precision": policy.describe(),
+        "pinned_tolerance": tolerance,
+        "calibration_parity": calibrated,
+        "serve_parity_max_abs": worst,
+        "programs_compiled": programs,
+        "distinct_batch_shapes": snap["distinct_batch_shapes"],
+        "quantized_bytes": nbytes,
+        "f32_bytes": f32_bytes,
+        "weight_bytes_ratio": round(nbytes / f32_bytes, 4),
+        "audit_findings": len(audit.findings),
+        "audit_collectives": len(audit.segments[0].schedule.ops),
+    }
+
+
 def check_obs_request_tracing(n_req: int = 200, dp: int = 4) -> dict:
     """A serve burst across dp replica lanes; raise AssertionError
     unless every completed request resolves to exactly one request
@@ -1248,6 +1381,7 @@ def main() -> int:
         train_elastic = check_train_elastic()
         serve = check_serve_batching()
         serve_sharded = check_serve_sharded()
+        serve_lowprec = check_serve_lowprec()
         obs_overhead = check_obs_overhead()
         obs_tracing = check_obs_request_tracing()
         flight_rec = check_flight_recorder()
@@ -1261,6 +1395,7 @@ def main() -> int:
                       "train_elastic": train_elastic,
                       "serve": serve,
                       "serve_sharded": serve_sharded,
+                      "serve_lowprec": serve_lowprec,
                       "obs_overhead": obs_overhead,
                       "obs_request_tracing": obs_tracing,
                       "flight_recorder": flight_rec, "spmd": spmd}))

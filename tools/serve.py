@@ -100,6 +100,16 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--schema", default=None,
                     help="JSON column-spec file (tools/analyze.py format) "
                          "used for validation + bucket warmup")
+    ap.add_argument("--precision", default=None,
+                    choices=["f32", "bf16", "int8w"],
+                    help="serving precision policy (docs/quantization.md)"
+                         ": bf16 activations, or int8 weight-only on top;"
+                         " parity vs the f32 offline transform is "
+                         "calibrated at load against the policy's pinned "
+                         "tolerance (typed ModelLoadError on drift)")
+    ap.add_argument("--precision-tolerance", type=float, default=None,
+                    help="per-model max-abs parity pin for --precision "
+                         "(default: the mode's documented tolerance)")
     ap.add_argument("--no-warmup", action="store_true",
                     help="skip compiling the bucket ladder at load")
     ap.add_argument("--obs", action="store_true",
@@ -148,13 +158,27 @@ def main(argv: list[str] | None = None) -> int:
         print(str(e), file=sys.stderr)
         return 2
 
+    precision = None
+    if args.precision and args.precision != "f32":
+        precision = {"mode": args.precision}
+        if args.precision_tolerance is not None:
+            precision["tolerance"] = args.precision_tolerance
+    elif args.precision_tolerance is not None:
+        # a tolerance without an active low-precision mode would be
+        # silently ignored — refuse loudly instead
+        print("--precision-tolerance needs --precision bf16|int8w "
+              "(f32 serving is bit-exact; there is nothing to pin)",
+              file=sys.stderr)
+        return 2
+
     config = ServeConfig(
         buckets=tuple(int(b) for b in args.buckets.split(",")),
         max_queue=args.max_queue,
         deadline_ms=args.deadline_ms or None,
         warmup=not args.no_warmup,
         mesh=mesh,
-        slo=slo)
+        slo=slo,
+        precision=precision)
     server = ModelServer(config)
     try:
         for model_name, model in _load_models(args.model, args.name):
@@ -170,6 +194,7 @@ def main(argv: list[str] | None = None) -> int:
         "host": httpd.server_address[0],
         "port": httpd.server_address[1],
         "buckets": list(config.buckets),
+        "precision": args.precision or "f32",
         "max_queue": config.max_queue,
         "deadline_ms": config.deadline_ms,
         "mesh": mesh.describe() if mesh is not None else None,
