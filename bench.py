@@ -387,6 +387,93 @@ def bench_serve_precision(jm, rng, n_total: int = 128,
     return out
 
 
+def bench_serve_swap(rng, n_total: int = 160, conc: int = 8) -> dict:
+    """Hot-swap under load A/B (round 13): client-observed latency with
+    a version hot-swap landing mid-window vs an identical steady-state
+    window, plus the dropped-request count (the zero-downtime claim,
+    measured). Client-side timing, not ServerStats — the swap replaces
+    the stats registry with the new version's, and the number that
+    matters spans both."""
+    import threading
+
+    from mmlspark_tpu.data.table import DataTable
+    from mmlspark_tpu.models.jax_model import JaxModel
+    from mmlspark_tpu.models.zoo import get_model
+    from mmlspark_tpu.serve import Client, ModelServer, ServeConfig
+
+    imgs = rng.integers(0, 255, size=(n_total, 32 * 32 * 3)
+                        ).astype(np.uint8)
+    tables = [DataTable({"image": [imgs[i]]}) for i in range(n_total)]
+
+    def model(seed):
+        return JaxModel(model=get_model("ConvNet_CIFAR10", widths=(8, 16),
+                                        dense_width=32, seed=seed),
+                        input_col="image", output_col="scores")
+
+    out: dict = {}
+    for label in ("steady", "swap"):
+        server = ModelServer(ServeConfig(
+            buckets=(1, 8, 32), max_queue=n_total + conc,
+            deadline_ms=None))
+        server.add_model("m", model(seed=0), example=tables[0],
+                         version=1)
+        client = Client(server)
+        lat: list[float] = []
+        errors: list[str] = []
+        lock = threading.Lock()
+
+        def worker(k: int) -> None:
+            # per-REQUEST error capture: one failure must count as one
+            # dropped request and the rest of the window still run —
+            # aborting the worker would shrink the sample and
+            # under-report the very outage this A/B exists to measure
+            for i in range(k, n_total, conc):
+                t0 = time.perf_counter()
+                try:
+                    client.predict("m", tables[i], timeout=600)
+                except BaseException as e:  # noqa: BLE001 — counted
+                    with lock:
+                        errors.append(f"{type(e).__name__}: {e}")
+                    continue
+                dt = time.perf_counter() - t0
+                with lock:
+                    lat.append(dt)
+
+        threads = [threading.Thread(target=worker, args=(k,))
+                   for k in range(conc)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        swap_wall_ms = None
+        if label == "swap":
+            # land the swap inside the window: v2 loads + warms its
+            # ladder while v1 serves, then the name flips atomically
+            time.sleep(0.05)
+            s0 = time.perf_counter()
+            server.add_model("m", model(seed=1), example=tables[0],
+                             version=2)
+            swap_wall_ms = round((time.perf_counter() - s0) * 1e3, 1)
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        server.close()
+        entry = {
+            "rows_per_s": round(len(lat) / wall, 1),
+            "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 2),
+            "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 2),
+            "dropped": len(errors),
+        }
+        if swap_wall_ms is not None:
+            entry["swap_wall_ms"] = swap_wall_ms
+        if errors:
+            entry["first_error"] = errors[0]
+        out[label] = entry
+    steady99, swap99 = out["steady"]["p99_ms"], out["swap"]["p99_ms"]
+    out["p99_ratio_swap_vs_steady"] = (
+        round(swap99 / steady99, 3) if steady99 else None)
+    return out
+
+
 def bench_serve_sharded(jm, rng, n_total: int = 192,
                         conc: int = 8) -> dict:
     """Sharded-serving scaling A/B: one chip (``dp=1``) vs DP-replica
@@ -861,6 +948,17 @@ def main() -> None:
     except Exception as e:  # best-effort metric; label failures accurately
         serve_precision = {"error": f"{type(e).__name__}: {e}"}
 
+    # hot-swap under load (round 13): a version flip mid-window vs an
+    # identical steady window — client-observed p99 and the
+    # dropped-request count (the zero-downtime lifecycle, measured)
+    serve_swap: dict | None = None
+    try:
+        if jm is None:
+            raise RuntimeError("inference setup failed, serve skipped")
+        serve_swap = bench_serve_swap(rng)
+    except Exception as e:  # best-effort metric; label failures accurately
+        serve_swap = {"error": f"{type(e).__name__}: {e}"}
+
     # BASELINE configs 3-5 (flagship models); skip with BENCH_FAST=1
     import os
     extra: dict = {}
@@ -935,6 +1033,13 @@ def main() -> None:
         "serve_ab": serve_ab,
         "serve_sharded": serve_sharded,
         "serve_sharded_speedup": (serve_sharded or {}).get("speedup"),
+        "serve_swap": serve_swap,
+        "serve_swap_p99_ms_steady": (serve_swap or {}).get(
+            "steady", {}).get("p99_ms"),
+        "serve_swap_p99_ms_during": (serve_swap or {}).get(
+            "swap", {}).get("p99_ms"),
+        "serve_swap_dropped": (serve_swap or {}).get(
+            "swap", {}).get("dropped"),
         "serve_precision_ab": serve_precision,
         **{f"serve_rows_per_s_{p}": (serve_precision or {}).get(
             p, {}).get("serve_rows_per_s") for p in ("f32", "bf16",

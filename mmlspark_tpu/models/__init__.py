@@ -9,5 +9,10 @@ CNTK graphs reached over JNI.
 
 from mmlspark_tpu.models.bundle import ModelBundle
 from mmlspark_tpu.models.jax_model import JaxModel
+from mmlspark_tpu.models.repo import (
+    ModelRepo, ModelRepoError, ModelVersion, RepoCorruptError,
+    VersionNotFound,
+)
 
-__all__ = ["ModelBundle", "JaxModel"]
+__all__ = ["ModelBundle", "JaxModel", "ModelRepo", "ModelRepoError",
+           "ModelVersion", "RepoCorruptError", "VersionNotFound"]

@@ -1,0 +1,369 @@
+"""Versioned model repository — the serving side of checkpoint discipline.
+
+The zoo/downloader layer (``data/downloader.py``, the reference's
+``ModelDownloader``) answers "fetch me a model"; a production serve plane
+needs the rest of the lifecycle: *which* build of a model is live, how a
+new build is published without a reader ever observing a half-written
+artifact, and how a corrupt or torn publish is refused instead of served.
+
+Layout (one directory per model, one per version)::
+
+    <root>/<model>/v00001/
+                       VERSION.json     # manifest: files + sha256 digests
+                       model.bundle     # or a saved-stage tree
+    <root>/<model>/v00002/…
+    <root>/<model>/CURRENT              # the live version pointer
+
+Guarantees, in the ``TrainCheckpointer`` discipline (PR 11):
+
+* **atomic publish** — a version is staged in a hidden temp dir and
+  enters the repo via one ``os.replace``; the ``CURRENT`` pointer is
+  rewritten the same way. A crash mid-publish (the
+  ``repo_torn_publish`` fault point) leaves the prior version live and
+  the temp dir inert — no reader path ever sees a partial version.
+* **content digests** — the manifest records a sha256 per file;
+  :meth:`ModelRepo.load` re-verifies before deserializing anything, so
+  bit-rot, truncation, or a hand-edited artifact is a typed
+  :class:`RepoCorruptError`, never a silently-wrong served model.
+* **typed errors** — :class:`VersionNotFound` / :class:`RepoCorruptError`
+  (both :class:`ModelRepoError`), so ``ModelServer`` keeps serving the
+  prior version when a swap source turns out to be bad.
+
+The repo is deliberately a *local directory* contract: ``os.replace``
+atomicity is the point. Remote distribution stays the downloader's job.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import re
+import shutil
+import threading
+import time
+from typing import Any
+
+from mmlspark_tpu.core.logging_utils import get_logger
+
+_log = get_logger(__name__)
+
+
+def _faults():
+    # lazy: the serve package imports heavily (batcher/server/http), and
+    # a training-only job importing mmlspark_tpu.models must not
+    # initialize the whole serve plane — the same direction-discipline
+    # serve/server.py applies when importing models
+    from mmlspark_tpu.serve import faults
+    return faults
+
+VERSION_MANIFEST = "VERSION.json"
+CURRENT_FILE = "CURRENT"
+BUNDLE_FILE = "model.bundle"
+STAGE_DIR = "stage"
+
+_VDIR_RE = re.compile(r"^v(\d{5,})$")
+
+
+class ModelRepoError(Exception):
+    """Base of every versioned-repo error."""
+
+
+class VersionNotFound(ModelRepoError):
+    """No such model/version in the repository."""
+
+    def __init__(self, name: str, version: int | None,
+                 available: list[int]):
+        what = f"version {version}" if version is not None else "versions"
+        super().__init__(
+            f"model {name!r}: no {what} in the repo "
+            f"(available: {available or 'none'})")
+        self.name = name
+        self.version = version
+        self.available = list(available)
+
+
+class RepoCorruptError(ModelRepoError):
+    """A version directory failed integrity verification — missing or
+    malformed manifest, a file named by the manifest absent, or a
+    content-digest mismatch (torn publish, bit-rot, tampering). The
+    version is refused whole; nothing partial is ever deserialized."""
+
+    def __init__(self, name: str, version: int, detail: str):
+        super().__init__(
+            f"model {name!r} v{version}: corrupt version — {detail}")
+        self.name = name
+        self.version = version
+        self.detail = detail
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _walk_files(root: str) -> list[str]:
+    """Every regular file under ``root``, repo-relative, sorted — the
+    digest walk must be order-independent of the filesystem."""
+    out = []
+    for dirpath, _dirs, files in os.walk(root):
+        for fname in files:
+            full = os.path.join(dirpath, fname)
+            out.append(os.path.relpath(full, root))
+    return sorted(out)
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelVersion:
+    """One verified version's identity (what :meth:`ModelRepo.verify`
+    returns): enough to audit a swap decision after the fact."""
+
+    name: str
+    version: int
+    path: str
+    kind: str                    # "bundle" | "stage"
+    created: float
+    digests: dict
+    notes: str = ""
+
+    def describe(self) -> dict:
+        return {"name": self.name, "version": self.version,
+                "kind": self.kind, "created": self.created,
+                "files": len(self.digests), "notes": self.notes}
+
+
+class ModelRepo:
+    """A versioned model repository rooted at a local directory."""
+
+    def __init__(self, root: str):
+        self.root = str(root)
+        os.makedirs(self.root, exist_ok=True)
+        # publishes from sibling threads (a background trainer and a
+        # deploy hook) serialize per process; cross-process safety comes
+        # from the atomic renames (last writer wins on CURRENT)
+        self._lock = threading.Lock()
+
+    # -- paths --
+
+    def _model_dir(self, name: str) -> str:
+        if not name or os.sep in name or name.startswith("."):
+            raise ModelRepoError(f"invalid model name {name!r}")
+        return os.path.join(self.root, name)
+
+    def _version_dir(self, name: str, version: int) -> str:
+        return os.path.join(self._model_dir(name), f"v{version:05d}")
+
+    # -- listing --
+
+    def models(self) -> list[str]:
+        """Model names with at least one published version."""
+        if not os.path.isdir(self.root):
+            return []
+        return sorted(
+            d for d in os.listdir(self.root)
+            if os.path.isdir(os.path.join(self.root, d))
+            and self.versions(d))
+
+    def versions(self, name: str) -> list[int]:
+        """Published (fully renamed-in) versions, ascending. Temp dirs
+        and stray files are invisible by construction."""
+        mdir = self._model_dir(name)
+        if not os.path.isdir(mdir):
+            return []
+        out = []
+        for d in os.listdir(mdir):
+            m = _VDIR_RE.match(d)
+            if m and os.path.isdir(os.path.join(mdir, d)):
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def current_version(self, name: str) -> int:
+        """The live version: the ``CURRENT`` pointer, falling back to
+        the newest published version when the pointer is missing or
+        points at a version that no longer exists (a pruned dir must
+        not brick the model)."""
+        versions = self.versions(name)
+        if not versions:
+            raise VersionNotFound(name, None, [])
+        path = os.path.join(self._model_dir(name), CURRENT_FILE)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                v = int(f.read().strip())
+            if v in versions:
+                return v
+            _log.warning("repo[%s]: CURRENT points at missing v%d; "
+                         "falling back to newest v%d", name, v,
+                         versions[-1])
+        except (OSError, ValueError):
+            pass
+        return versions[-1]
+
+    # -- publish --
+
+    def publish(self, name: str, model: Any, notes: str = "",
+                set_current: bool = True) -> int:
+        """Publish ``model`` (a ``ModelBundle``, or any stage with
+        ``.save``) as the next version; returns the version number.
+
+        The version is staged under a hidden temp dir, digested, and
+        renamed in with ``os.replace`` — readers either see the whole
+        version or none of it. ``set_current=True`` (default) then
+        repoints ``CURRENT`` atomically; ``False`` publishes a dark
+        version (for canary-from-repo flows that flip the pointer only
+        on promotion)."""
+        from mmlspark_tpu.models.bundle import ModelBundle
+        with self._lock:
+            mdir = self._model_dir(name)
+            os.makedirs(mdir, exist_ok=True)
+            version = (self.versions(name) or [0])[-1] + 1
+            vdir = self._version_dir(name, version)
+            tmp = os.path.join(mdir, f".staging-v{version:05d}-{os.getpid()}")
+            if os.path.exists(tmp):
+                shutil.rmtree(tmp)
+            os.makedirs(tmp)
+            try:
+                if isinstance(model, ModelBundle):
+                    from mmlspark_tpu.data.downloader import save_bundle_file
+                    save_bundle_file(model, os.path.join(tmp, BUNDLE_FILE))
+                    kind = "bundle"
+                elif hasattr(model, "save"):
+                    model.save(os.path.join(tmp, STAGE_DIR))
+                    kind = "stage"
+                else:
+                    raise ModelRepoError(
+                        f"model {name!r}: not publishable "
+                        f"({type(model).__name__} is neither a "
+                        "ModelBundle nor a savable stage)")
+                digests = {rel: _sha256_file(os.path.join(tmp, rel))
+                           for rel in _walk_files(tmp)}
+                manifest = {"name": name, "version": version,
+                            "kind": kind, "created": time.time(),
+                            "notes": notes, "files": digests}
+                with open(os.path.join(tmp, VERSION_MANIFEST), "w",
+                          encoding="utf-8") as f:
+                    json.dump(manifest, f, indent=1)
+                # the torn-publish fault point: a crash here leaves the
+                # staging dir (invisible to every reader path) and the
+                # prior version live
+                _faults().hit("repo_torn_publish", model=name)
+                os.replace(tmp, vdir)
+            except BaseException:
+                shutil.rmtree(tmp, ignore_errors=True)
+                raise
+            if set_current:
+                self._write_current(name, version)
+            _log.info("repo[%s]: published v%d (%s, %d file(s))",
+                      name, version, kind, len(digests))
+            return version
+
+    def _write_current(self, name: str, version: int) -> None:
+        path = os.path.join(self._model_dir(name), CURRENT_FILE)
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(str(version))
+        os.replace(tmp, path)
+
+    def set_current(self, name: str, version: int) -> None:
+        """Repoint ``CURRENT`` (atomic); the repo-side rollback — the
+        version must exist and verify."""
+        self.verify(name, version)
+        with self._lock:
+            self._write_current(name, version)
+
+    # -- verify + load --
+
+    def _resolve(self, name: str, version: int | None) -> int:
+        if version is None:
+            return self.current_version(name)
+        if version not in self.versions(name):
+            raise VersionNotFound(name, version, self.versions(name))
+        return version
+
+    def verify(self, name: str, version: int | None = None) -> ModelVersion:
+        """Integrity-check one version against its manifest; returns the
+        verified :class:`ModelVersion` or raises
+        :class:`RepoCorruptError`. Every byte named by the manifest is
+        re-hashed — O(version bytes), the price of never serving a torn
+        artifact."""
+        version = self._resolve(name, version)
+        vdir = self._version_dir(name, version)
+        mpath = os.path.join(vdir, VERSION_MANIFEST)
+        try:
+            with open(mpath, "r", encoding="utf-8") as f:
+                manifest = json.load(f)
+        except FileNotFoundError:
+            raise RepoCorruptError(name, version,
+                                   "manifest missing (torn publish?)")
+        except (OSError, ValueError) as e:
+            raise RepoCorruptError(name, version,
+                                   f"unreadable manifest: {e}")
+        files = manifest.get("files")
+        if not isinstance(files, dict) or not files:
+            raise RepoCorruptError(name, version,
+                                   "manifest names no files")
+        on_disk = set(_walk_files(vdir)) - {VERSION_MANIFEST}
+        missing = sorted(set(files) - on_disk)
+        if missing:
+            raise RepoCorruptError(
+                name, version, f"manifest names missing file(s): "
+                f"{missing[:3]}{'…' if len(missing) > 3 else ''}")
+        for rel, want in sorted(files.items()):
+            got = _sha256_file(os.path.join(vdir, rel))
+            if got != want:
+                raise RepoCorruptError(
+                    name, version,
+                    f"digest mismatch on {rel!r} (manifest "
+                    f"{want[:12]}…, got {got[:12]}…)")
+        return ModelVersion(
+            name=name, version=version, path=vdir,
+            kind=manifest.get("kind", "bundle"),
+            created=float(manifest.get("created", 0.0)),
+            digests=dict(files), notes=manifest.get("notes", ""))
+
+    def load(self, name: str, version: int | None = None
+             ) -> tuple[Any, ModelVersion]:
+        """Verify then deserialize one version; returns
+        ``(model, ModelVersion)``. Verification happens BEFORE any
+        deserialization — a corrupt artifact is refused with a typed
+        error, it never reaches pickle/flax (where a truncated file
+        would surface as an arbitrary exception mid-parse)."""
+        info = self.verify(name, version)
+        _faults().hit("load_failure", model=name)
+        if info.kind == "bundle":
+            from mmlspark_tpu.data.downloader import load_bundle_file
+            model = load_bundle_file(os.path.join(info.path, BUNDLE_FILE))
+        elif info.kind == "stage":
+            from mmlspark_tpu.core.stage import PipelineStage
+            model = PipelineStage.load(os.path.join(info.path, STAGE_DIR))
+        else:
+            raise RepoCorruptError(name, info.version,
+                                   f"unknown artifact kind {info.kind!r}")
+        return model, info
+
+    # -- housekeeping --
+
+    def prune(self, name: str, keep: int = 3) -> list[int]:
+        """Delete all but the newest ``keep`` versions (the CURRENT
+        version is always kept); returns the pruned version numbers."""
+        if keep < 1:
+            raise ValueError(f"keep must be >= 1: {keep}")
+        with self._lock:
+            versions = self.versions(name)
+            current = self.current_version(name) if versions else None
+            doomed = [v for v in versions[:-keep] if v != current]
+            for v in doomed:
+                shutil.rmtree(self._version_dir(name, v),
+                              ignore_errors=True)
+        return doomed
+
+    def describe(self) -> dict:
+        """JSON-safe repo inventory (the CLI's startup line)."""
+        out = {}
+        for name in self.models():
+            out[name] = {"versions": self.versions(name),
+                         "current": self.current_version(name)}
+        return out

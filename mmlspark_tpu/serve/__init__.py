@@ -12,7 +12,16 @@ compiles exactly one program per (model, bucket) — see docs/serving.md.
   requests through the fused device plan (``core.plan.transform_async``).
 * :class:`DynamicBatcher` — per-model bounded queue + coalescing dispatch
   loop with admission control, deadlines, and graceful drain.
-* :class:`Client` — in-process client (deterministic tests, the bench).
+* :class:`Client` — in-process client (deterministic tests, the bench);
+  ``retry=`` retries transient faults through ``core/retry``.
+* :mod:`mmlspark_tpu.serve.lifecycle` — zero-downtime model lifecycle:
+  hot-swap via ``add_model`` re-registration, shadow/canary routing
+  with the pure SLO-driven :class:`PromotionPolicy` (auto-rollback on
+  canary fast-burn or parity drift), every decision journaled; the
+  versioned artifact source is :mod:`mmlspark_tpu.models.repo`.
+* :mod:`mmlspark_tpu.serve.faults` — deterministic seeded fault
+  injection at the serve seams (the reproducible-chaos harness behind
+  the lane self-healing and lifecycle gates).
 * :mod:`mmlspark_tpu.serve.mesh` — sharded serving: DP-replica fan-out,
   tp/pp model-parallel sub-meshes, and multi-host lockstep
   (``ServeMeshSpec``, ``--mesh dp=N[,tp=M]`` on the CLI).
@@ -22,8 +31,15 @@ compiles exactly one program per (model, bucket) — see docs/serving.md.
 
 from mmlspark_tpu.serve.config import ServeConfig  # noqa: F401
 from mmlspark_tpu.serve.errors import (  # noqa: F401
-    BadRequest, DeadlineExceeded, ModelLoadError, ModelNotFound,
-    Overloaded, ServeError, ServerClosed,
+    BadRequest, DeadlineExceeded, LaneFailed, ModelLoadError,
+    ModelNotFound, Overloaded, ServeError, ServerClosed,
+)
+from mmlspark_tpu.serve.faults import (  # noqa: F401
+    FaultPlan, FaultSpec, InjectedFault,
+)
+from mmlspark_tpu.serve.lifecycle import (  # noqa: F401
+    CanarySignal, DecisionJournal, Hold, Promote, PromotionLedger,
+    PromotionPolicy, Rollback,
 )
 from mmlspark_tpu.serve.batcher import (  # noqa: F401
     DynamicBatcher, ServeRequest, THREAD_PREFIX,
@@ -37,15 +53,26 @@ from mmlspark_tpu.serve.stats import ServerStats  # noqa: F401
 
 __all__ = [
     "BadRequest",
+    "CanarySignal",
     "Client",
     "DeadlineExceeded",
+    "DecisionJournal",
     "DynamicBatcher",
+    "FaultPlan",
+    "FaultSpec",
+    "Hold",
+    "InjectedFault",
+    "LaneFailed",
     "ModelLoadError",
     "LockstepCoordinator",
     "ModelNotFound",
     "ModelServer",
+    "Promote",
+    "PromotionLedger",
+    "PromotionPolicy",
     "Replica",
     "ReplicaSet",
+    "Rollback",
     "ServeMeshSpec",
     "build_replicas",
     "Overloaded",

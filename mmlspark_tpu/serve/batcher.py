@@ -60,9 +60,10 @@ from mmlspark_tpu.obs import flight as _obs_flight
 from mmlspark_tpu.obs import runtime as _obs_rt
 from mmlspark_tpu.obs.spans import event as _obs_event
 from mmlspark_tpu.obs.spans import span as _obs_span
+from mmlspark_tpu.serve import faults as _faults
 from mmlspark_tpu.serve.config import ServeConfig
 from mmlspark_tpu.serve.errors import (
-    BadRequest, DeadlineExceeded, Overloaded, ServerClosed,
+    BadRequest, DeadlineExceeded, LaneFailed, Overloaded, ServerClosed,
 )
 from mmlspark_tpu.serve.stats import ServerStats
 
@@ -260,7 +261,7 @@ class _Lane:
 
     __slots__ = ("batcher", "index", "cache_host", "mesh", "shard_params",
                  "replica", "_cv", "_queue", "_window", "_closing",
-                 "_thread", "load", "_hb")
+                 "_thread", "load", "_hb", "alive", "_inhand", "_indrain")
 
     def __init__(self, batcher: "DynamicBatcher", index: int,
                  cache_host: Any, mesh: Any = None,
@@ -275,6 +276,16 @@ class _Lane:
         self._queue: deque = deque()   # (packed, batch, rows, bucket)
         self._window: deque = deque()  # (pending, batch, rows, bucket, t0)
         self._closing = False
+        # lane self-healing state: `alive` flips False exactly once, in
+        # _lane_failed, under this lane's _cv — the scheduler only
+        # assigns to alive lanes, and `assign` itself re-checks so the
+        # acquire→assign race can never strand a batch on a corpse.
+        # _inhand/_indrain track the one work item the worker is
+        # touching outside the queue/window structures, so the healer
+        # can account for EVERY admitted batch when the thread dies
+        self.alive = True
+        self._inhand: tuple | None = None
+        self._indrain: tuple | None = None
         self.load = 0  # queued + in-flight batches; guarded by the
         #                batcher's scheduler condition, not this lane's
         # flight-recorder heartbeat: busy while work is assigned, idle
@@ -293,10 +304,16 @@ class _Lane:
     # -- scheduler side --
 
     def assign(self, packed: DataTable, batch: list, rows: int,
-               bucket: int) -> None:
+               bucket: int) -> bool:
+        """Queue one packed batch for this lane's worker. False when the
+        lane is dead (the healer already swept its queue — appending
+        would strand the batch forever); the caller re-acquires."""
         with self._cv:
+            if not self.alive:
+                return False
             self._queue.append((packed, batch, rows, bucket))
             self._cv.notify()
+        return True
 
     def close(self) -> None:
         with self._cv:
@@ -326,6 +343,22 @@ class _Lane:
         return labels
 
     def _run(self) -> None:
+        try:
+            self._work_loop()
+        except BaseException as e:  # noqa: BLE001 — lane self-healing
+            # a NON-REQUEST exception reached the worker loop (request
+            # failures are relayed inside _dispatch/_drain_one): this
+            # thread is done for, but its queue must not be — hand
+            # everything to the batcher's healer, which requeues the
+            # undispatched work, fails the in-flight window typed, and
+            # restarts the lane under the configured backoff
+            try:
+                self.batcher._lane_failed(self, e)
+            except BaseException:  # pragma: no cover - defensive
+                _log.exception("%s lane %d: self-healing itself failed",
+                               self.batcher.name, self.index)
+
+    def _work_loop(self) -> None:
         while True:
             with self._cv:
                 while (not self._queue and not self._window
@@ -334,6 +367,7 @@ class _Lane:
                         _obs_flight._rec.disarm(self._hb)
                     self._cv.wait()
                 item = self._queue.popleft() if self._queue else None
+                self._inhand = item
                 closing = self._closing
             if _obs_flight._rec is not None:
                 _obs_flight._rec.beat(self._hb)
@@ -347,7 +381,13 @@ class _Lane:
                         _obs_flight._rec.disarm(self._hb)
                     return
                 continue
+            # the lane-death injection point: a fault here models the
+            # motivating bug — a non-request exception killing the
+            # worker with a batch in hand and more queued behind it
+            _faults.hit("lane_death", model=self.batcher.name,
+                        lane=self.index)
             self._dispatch(*item)
+            self._inhand = None
             if len(self._window) >= self.batcher.config.max_inflight:
                 self._drain_one()
 
@@ -377,6 +417,14 @@ class _Lane:
                            if labels is not None else None,
                            _batch_links(batch)
                            if labels is not None else None):
+                # injection points at the dispatch seam: a slow
+                # dispatch (sleep) and a dispatch-time raise — the
+                # latter is relayed per request below, exactly like a
+                # real packing/upload failure
+                _faults.hit("dispatch_slow", model=self.batcher.name,
+                            lane=self.index)
+                _faults.hit("dispatch_raise", model=self.batcher.name,
+                            lane=self.index)
                 pending = plan.transform_async(
                     self.batcher.stages, packed, self.cache_host,
                     mesh=self.mesh, shard_params=self.shard_params,
@@ -392,7 +440,17 @@ class _Lane:
         self._window.append((pending, batch, rows, bucket, now))
 
     def _drain_one(self) -> None:
-        pending, batch, rows, bucket, t0 = self._window.popleft()
+        entry = self._window.popleft()
+        # out of the window but not yet resolved: visible to the healer
+        # (a death inside the drain must still fail this batch typed) —
+        # cleared only on a non-raising drain, so an escaping exception
+        # reaches _lane_failed with the entry still attributable
+        self._indrain = entry
+        self._drain_entry(entry)
+        self._indrain = None
+
+    def _drain_entry(self, entry: tuple) -> None:
+        pending, batch, rows, bucket, t0 = entry
         if _obs_flight._rec is not None:
             _obs_flight._rec.beat(self._hb)
         labels = self._labels()
@@ -479,6 +537,12 @@ class DynamicBatcher:
         # lane scheduling state: lane.load counters live under this
         # condition; lanes notify it as batches resolve
         self._sched_cv = threading.Condition()
+        # lane self-healing: restart budget shared across lanes (bounds
+        # total churn — a model whose lanes keep dying is a model
+        # problem, not a restart problem) and an optional server-side
+        # hook so deaths/restarts land in the lifecycle journal
+        self._lane_restarts_used = 0
+        self.on_lane_event: Any = None
         if replicas is not None:
             self._lanes = [
                 _Lane(self, i, rep.cache_host, mesh=rep.mesh,
@@ -605,18 +669,22 @@ class DynamicBatcher:
         return DataTable(cols, dict(first.meta)), bucket
 
     def _acquire_lane(self) -> _Lane | None:
-        """Least-loaded replica pick (ties → lowest index), bounded at
-        ``max_inflight`` outstanding batches per lane — the scheduler's
-        backpressure. Blocks until a slot frees; None when aborted."""
+        """Least-loaded ALIVE replica pick (ties → lowest index), bounded
+        at ``max_inflight`` outstanding batches per lane — the
+        scheduler's backpressure. Blocks until a slot frees (or, with
+        every lane down, until the supervisor restarts one); None when
+        aborted."""
         with self._sched_cv:
             while not self._abort:
-                lane = min(self._lanes, key=lambda L: (L.load, L.index))
-                if lane.load < self.config.max_inflight:
-                    lane.load += 1
-                    return lane
-                # waiting for a lane slot is the LANES' business, not a
-                # scheduler hang: keep its flight heartbeat fresh (a
-                # stuck lane raises its own)
+                alive = [L for L in self._lanes if L.alive]
+                if alive:
+                    lane = min(alive, key=lambda L: (L.load, L.index))
+                    if lane.load < self.config.max_inflight:
+                        lane.load += 1
+                        return lane
+                # waiting for a lane slot (or a lane restart) is the
+                # LANES' business, not a scheduler hang: keep its
+                # flight heartbeat fresh (a stuck lane raises its own)
                 if _obs_flight._rec is not None:
                     _obs_flight._rec.beat(f"serve/{self.name}/scheduler")
                 self._sched_cv.wait(timeout=0.1)
@@ -635,11 +703,191 @@ class DynamicBatcher:
         # idle server would ripen into a spurious watchdog "hang" dump
         on_sched = threading.current_thread() is self._thread
         with self._sched_cv:
+            # dead lanes are excluded: the healer zeroes a corpse's
+            # load, but an acquire that raced the death can leave a
+            # ghost increment on it — the fence must never spin on a
+            # lane that can no longer drain anything
             while (not self._abort
-                   and any(lane.load for lane in self._lanes)):
+                   and any(lane.load for lane in self._lanes
+                           if lane.alive)):
                 if on_sched and _obs_flight._rec is not None:
                     _obs_flight._rec.beat(f"serve/{self.name}/scheduler")
                 self._sched_cv.wait(timeout=poll_s)
+
+    # -- lane self-healing --
+
+    def _notify_lane_event(self, kind: str, payload: dict) -> None:
+        cb = self.on_lane_event
+        if cb is not None:
+            try:
+                cb(kind, payload)
+            except Exception:  # pragma: no cover - journal must not kill
+                _log.exception("%s: lane-event hook failed", self.name)
+
+    def _lane_failed(self, lane: _Lane, exc: BaseException) -> None:
+        """A lane worker died on a non-request exception (runs ON the
+        dying thread, as its last act). The contract the motivating bug
+        violated: no admitted request may be silently stranded, and
+        capacity loss must be visible, not quiet.
+
+        * **undispatched** batches (the lane's queue + the in-hand item)
+          are requeued onto surviving lanes — they were never
+          dispatched, so re-dispatching can never double-respond;
+        * **in-flight** batches (the async window + a mid-drain entry)
+          lose their results with the worker: each request fails with
+          the typed, retryable :class:`LaneFailed` — never resolved
+          speculatively;
+        * the lane is **restarted** under ``ServeConfig.lane_restart``
+          backoff (reusing the dead lane's compiled-segment cache, so a
+          restart costs no recompile); past the budget the lane stays
+          down and ``lane_health`` keeps reporting the hole — degraded
+          health instead of silently shrunk capacity.
+        """
+        with lane._cv:
+            lane.alive = False
+            stranded: list[tuple] = []
+            if lane._inhand is not None:
+                stranded.append(lane._inhand)
+                lane._inhand = None
+            stranded.extend(lane._queue)
+            lane._queue.clear()
+            inflight = list(lane._window)
+            lane._window.clear()
+            if lane._indrain is not None:
+                inflight.append(lane._indrain)
+                lane._indrain = None
+            lane._closing = True
+        if _obs_flight._rec is not None:
+            _obs_flight._rec.disarm(lane._hb)
+        self.stats.record_lane_death()
+        _log.warning(
+            "%s lane %d died (%s: %s) — requeueing %d undispatched "
+            "batch(es), failing %d in-flight", self.name, lane.index,
+            type(exc).__name__, exc, len(stranded), len(inflight))
+        if _obs_rt._enabled:
+            _obs_event("serve/lane_death", "serve",
+                       {"model": self.name, "lane": lane.index,
+                        "error": f"{type(exc).__name__}: {exc}"})
+        self._notify_lane_event("lane_death", {
+            "model": self.name, "lane": lane.index,
+            "error": f"{type(exc).__name__}: {exc}",
+            "undispatched": len(stranded), "inflight": len(inflight)})
+        err = LaneFailed(self.name, lane.index,
+                         f"{type(exc).__name__}: {exc}")
+        err.__cause__ = exc
+        for entry in inflight:
+            for r in entry[1]:
+                if r._fail(err):
+                    self.stats.record_failed()
+        # free the corpse's load accounting so the scheduler and the
+        # drain fence see real capacity
+        with self._sched_cv:
+            lane.load = 0
+            self._sched_cv.notify_all()
+        with self._cv:
+            closing = self._closed or self._abort
+        # survivors first: requeued work should not wait out the
+        # restart backoff when other lanes can take it now
+        if stranded and not closing:
+            survivors = [L for L in self._lanes
+                         if L.alive and L is not lane]
+            if survivors:
+                self.stats.record_requeued(len(stranded))
+                for item in stranded:
+                    self._requeue(item)
+                stranded = []
+        replacement = None if closing else self._restart_lane(lane)
+        if stranded and replacement is not None:
+            self.stats.record_requeued(len(stranded))
+            for item in stranded:
+                self._requeue(item)
+            stranded = []
+        for packed, batch, rows, bucket in stranded:
+            # no survivor and no restart (budget spent, or shutting
+            # down): the queue must still be answered, typed
+            for r in batch:
+                if r._fail(err if not closing
+                           else ServerClosed(f"model {self.name!r} "
+                                             "closed")):
+                    self.stats.record_failed()
+
+    def _requeue(self, item: tuple) -> None:
+        """Re-assign one undispatched batch to the least-loaded alive
+        lane (expired deadlines are cancelled at the lane's own
+        pre-dispatch check, exactly like first-time assignment)."""
+        while True:
+            with self._sched_cv:
+                if self._abort:
+                    break
+                alive = [L for L in self._lanes if L.alive]
+                if not alive:
+                    break
+                lane = min(alive, key=lambda L: (L.load, L.index))
+                lane.load += 1
+            if lane.assign(*item):
+                return
+        for r in item[1]:
+            if r._fail(LaneFailed(self.name, -1,
+                                  "no surviving lane to requeue onto")):
+                self.stats.record_failed()
+
+    def _restart_lane(self, lane: _Lane) -> _Lane | None:
+        """Spawn a replacement worker for the dead lane's slot under the
+        configured backoff (the dying thread pays the sleep); None when
+        the restart budget is exhausted."""
+        policy = self.config.lane_restart_policy()
+        with self._sched_cv:
+            used = self._lane_restarts_used
+            if used >= policy.max_attempts - 1:
+                _log.error(
+                    "%s lane %d: restart budget (%d) exhausted — lane "
+                    "stays down, capacity degraded", self.name,
+                    lane.index, policy.max_attempts - 1)
+                self._notify_lane_event("lane_down", {
+                    "model": self.name, "lane": lane.index,
+                    "restarts_used": used})
+                return None
+            self._lane_restarts_used = used + 1
+        delay = 0.0
+        for i, d in enumerate(policy.delays()):
+            if i == used:
+                delay = d
+                break
+        if delay:
+            time.sleep(delay)
+        replacement = _Lane(self, lane.index, lane.cache_host,
+                            mesh=lane.mesh,
+                            shard_params=lane.shard_params,
+                            replica=lane.replica)
+        with self._sched_cv:
+            self._lanes[lane.index] = replacement
+            self._sched_cv.notify_all()
+        self.stats.record_lane_restart()
+        _log.info("%s lane %d restarted (attempt %d, %.0f ms backoff)",
+                  self.name, lane.index, used + 1, delay * 1e3)
+        if _obs_rt._enabled:
+            _obs_event("serve/lane_restart", "serve",
+                       {"model": self.name, "lane": lane.index,
+                        "attempt": used + 1})
+        self._notify_lane_event("lane_restart", {
+            "model": self.name, "lane": lane.index, "attempt": used + 1,
+            "backoff_s": round(delay, 3)})
+        return replacement
+
+    def lane_health(self) -> dict:
+        """The capacity surface health checks merge in: a model whose
+        lanes are down is degraded even while its latency percentiles
+        still look clean (fewer lanes = less headroom, invisible until
+        overload)."""
+        with self._sched_cv:
+            lanes = list(self._lanes)
+        return {
+            "lanes": len(lanes),
+            "alive": sum(1 for L in lanes if L.alive),
+            "deaths": self.stats.lane_deaths,
+            "restarts": self.stats.lane_restarts,
+            "requeued_batches": self.stats.requeued_batches,
+        }
 
     def _dispatch(self, batch: list, rows: int) -> None:
         # pack on the scheduler thread: the packing work is what overlaps
@@ -671,11 +919,22 @@ class DynamicBatcher:
                     lane.load -= 1
                     self._sched_cv.notify_all()
                 raise
-        else:
+            if not lane.assign(packed, batch, rows, bucket):
+                # the agreed lane died after the exchange — this process
+                # cannot issue the program it agreed to; typed failure
+                # (relayed per request by the caller), never a silent
+                # re-route that would desync the agreed schedule
+                raise LaneFailed(self.name, lane.index,
+                                 "lane died after lockstep agreement")
+            return
+        while True:
             lane = self._acquire_lane()
             if lane is None:  # aborted while waiting for a slot
                 raise ServerClosed(f"model {self.name!r} closed")
-        lane.assign(packed, batch, rows, bucket)
+            if lane.assign(packed, batch, rows, bucket):
+                return
+            # raced a lane death between acquire and assign: the healer
+            # sweeps the corpse's load accounting; pick another lane
 
     def _run(self) -> None:
         hb = f"serve/{self.name}/scheduler"
@@ -787,10 +1046,22 @@ class DynamicBatcher:
         deadline = time.monotonic() + self.config.drain_timeout_s
         self._thread.join(timeout=self.config.drain_timeout_s)
         stuck = self._thread.is_alive()
-        for lane in self._lanes:
-            lane.close()  # idempotent; _run also closes lanes on exit
-            if not lane.join(max(deadline - time.monotonic(), 0.1)):
-                stuck = True
+        # join to a FIXED POINT over lane replacements: a lane dying
+        # concurrently with close() may still spawn one replacement
+        # (its healer checked the closed flag just before we set it);
+        # joining a corpse only returns after its healer finished, so
+        # any replacement it made is in self._lanes by the next scan
+        joined: set[int] = set()
+        while True:
+            with self._sched_cv:
+                todo = [L for L in self._lanes if id(L) not in joined]
+            if not todo:
+                break
+            for lane in todo:
+                joined.add(id(lane))
+                lane.close()  # idempotent; _run also closes lanes
+                if not lane.join(max(deadline - time.monotonic(), 0.1)):
+                    stuck = True
         if stuck:  # pragma: no cover - defensive
             _log.warning("ServeBatcher[%s] did not stop within %.1fs",
                          self.name, self.config.drain_timeout_s)

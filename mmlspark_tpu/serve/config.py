@@ -45,6 +45,27 @@ class ServeConfig:
     #                             spec). Drives the /slo burn-rate
     #                             surface and the /healthz state machine
     #                             (docs/observability.md)
+    retry_after_s: float = 1.0  # the Retry-After hint on 429/503 HTTP
+    #                             responses: how long a backpressured or
+    #                             drain-bounced client should wait before
+    #                             retrying (rounded UP to whole seconds
+    #                             on the wire — the header's unit)
+    lane_restart: object = None  # lane self-healing pacing — a
+    #                             core.retry.RetryPolicy (None = the
+    #                             default: 3 restarts, 50 ms..2 s
+    #                             deterministic exponential backoff).
+    #                             A dead/wedged dispatch lane has its
+    #                             undispatched batches requeued onto
+    #                             surviving lanes and is restarted under
+    #                             this schedule; past the budget the
+    #                             lane stays down and health degrades
+    lifecycle_dir: str | None = None  # model-lifecycle decision journal:
+    #                             swap/canary/promote/rollback (and lane
+    #                             death/restart) decisions append to
+    #                             <dir>/decisions.jsonl — the serve
+    #                             analog of the training service's
+    #                             supervision forensics. None = journal
+    #                             kept in memory only
     precision: object = None    # server-wide default serving precision —
     #                             a core.precision.PrecisionPolicy /
     #                             "f32"|"bf16"|"int8w" string / dict of
@@ -65,6 +86,20 @@ class ServeConfig:
         if self.max_inflight < 1:
             raise ValueError(
                 f"max_inflight must be >= 1: {self.max_inflight}")
+        if self.retry_after_s <= 0:
+            raise ValueError(
+                f"retry_after_s must be > 0: {self.retry_after_s}")
+
+    def lane_restart_policy(self):
+        """The lane supervisor's restart pacing (``lane_restart`` or the
+        default). Deterministic (jitter=0) by default: lane restarts are
+        a single server's recovery, not a thundering herd, and a
+        reproducible schedule is what the chaos gate pins."""
+        from mmlspark_tpu.core.retry import RetryPolicy
+        if self.lane_restart is not None:
+            return self.lane_restart
+        return RetryPolicy(max_attempts=4, base_delay_s=0.05,
+                           max_delay_s=2.0, jitter=0.0)
 
     @property
     def max_bucket(self) -> int:

@@ -64,6 +64,26 @@ class ServerClosed(ServeError):
     drain)."""
 
 
+class LaneFailed(ServeError):
+    """The dispatch lane carrying this request died before its batch was
+    drained (a non-request exception killed the lane worker, or the
+    restart budget ran out with no survivor to absorb the queue).
+
+    Transient from the client's point of view — the lane supervisor
+    restarts the lane and UNDISPATCHED requests are requeued onto
+    survivors automatically, so only in-flight batches ever surface
+    this; a retry (``Client(..., retry=...)``) lands on a healthy lane.
+    Carries the original lane exception as ``__cause__``.
+    """
+
+    def __init__(self, model: str, lane: int, detail: str):
+        super().__init__(
+            f"model {model!r}: dispatch lane {lane} failed before the "
+            f"result was drained ({detail}); safe to retry")
+        self.model = model
+        self.lane = lane
+
+
 class ModelLoadError(ServeError):
     """The model was rejected at load time, before any device work.
 

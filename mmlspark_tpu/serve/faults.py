@@ -1,0 +1,189 @@
+"""Deterministic seeded fault injection for the serve plane.
+
+Chaos testing a self-healing server is only worth anything when the
+chaos replays: a flaky fault schedule turns every recovery gate into a
+flaky gate. This module is the ONE fault source the serving seams
+consult — a :class:`FaultPlan` of named injection points with
+count-based (``after``/``times``) or seeded-probability (``prob`` drawn
+from ``random.Random(seed)``) triggers, so the same plan + seed fires
+the same faults at the same seam passes, every run.
+
+Injection points live at the seams the real failure modes hit (the
+same seams the flight recorder already heartbeats):
+
+=====================  ====================================================
+point                  seam
+=====================  ====================================================
+``lane_death``         ``serve/batcher._Lane._run`` — a non-request
+                       exception kills the lane worker (the motivating
+                       self-healing bug: stranded queue, silent capacity
+                       loss)
+``dispatch_raise``     ``_Lane._dispatch`` just before the device
+                       dispatch — relayed per request as a failed batch
+``dispatch_slow``      same seam, a ``delay_s`` sleep — a wedged/slow
+                       dispatch without an exception
+``repo_torn_publish``  ``models/repo.ModelRepo.publish`` after the
+                       version files are written, before the atomic
+                       rename — a crash mid-publish
+``load_failure``       ``models/repo.ModelRepo.load`` before
+                       deserialization — a model that cannot come up
+=====================  ====================================================
+
+The seams pay ONE module-attribute check when no plan is installed
+(the ``obs/flight.py`` discipline), so production dispatch loops are
+untouched. Install with :func:`install`/:func:`clear` or the
+:func:`inject` context manager; every firing is recorded in
+``plan.fired`` for assertions and post-mortems.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import random
+import threading
+import time
+from typing import Any, Iterator
+
+
+class InjectedFault(RuntimeError):
+    """A fault fired by the installed :class:`FaultPlan`.
+
+    Deliberately NOT a ``ServeError``: an injected lane death must look
+    exactly like the unexpected non-request exception it models, so the
+    recovery machinery can never special-case "it was only a test".
+    """
+
+    def __init__(self, point: str, message: str = ""):
+        super().__init__(message or f"injected fault at {point!r}")
+        self.point = point
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One fault rule. ``point`` names the seam; ``model``/``lane``
+    (None = any) scope it. The trigger is deterministic: the spec fires
+    on seam passes ``after <= k < after + times`` (k counts MATCHING
+    passes, from 0), optionally gated by a seeded coin flip ``prob``
+    (each matching pass draws once from the spec's own
+    ``random.Random``, so the draw sequence is a pure function of the
+    plan seed and the pass order). ``delay_s`` makes the fault a sleep
+    (slow seam) instead of a raise."""
+
+    point: str
+    model: str | None = None
+    lane: int | None = None
+    after: int = 0
+    times: int = 1
+    prob: float | None = None
+    delay_s: float | None = None
+    message: str = ""
+
+    def __post_init__(self):
+        if self.after < 0 or self.times < 1:
+            raise ValueError(
+                f"need after >= 0 and times >= 1: {self.after}/{self.times}")
+        if self.prob is not None and not 0.0 <= self.prob <= 1.0:
+            raise ValueError(f"prob must be in [0, 1]: {self.prob}")
+
+    def matches(self, point: str, model: str | None,
+                lane: int | None) -> bool:
+        return (point == self.point
+                and (self.model is None or model == self.model)
+                and (self.lane is None or lane == self.lane))
+
+
+class FaultPlan:
+    """A set of :class:`FaultSpec` rules plus the seed that makes their
+    probabilistic triggers replayable. Thread-safe: serve lanes hit the
+    seams concurrently, and the per-spec pass counters (what ``after``
+    indexes) must not lose updates."""
+
+    def __init__(self, specs: Iterator[FaultSpec] | list,
+                 seed: int = 0):
+        self.specs = tuple(specs)
+        self.seed = int(seed)
+        self._rngs = [random.Random(self.seed + i)
+                      for i in range(len(self.specs))]
+        self._passes = [0] * len(self.specs)
+        self._lock = threading.Lock()
+        #: every firing, in order: (point, model, lane, kind) — the
+        #: reproducibility observable chaos tests assert on
+        self.fired: list[tuple] = []
+
+    def fire(self, point: str, model: str | None,
+             lane: int | None) -> None:
+        """Evaluate every matching spec for one seam pass; raises
+        :class:`InjectedFault` or sleeps when a spec triggers."""
+        delay = None
+        raise_spec = None
+        with self._lock:
+            for i, spec in enumerate(self.specs):
+                if not spec.matches(point, model, lane):
+                    continue
+                k = self._passes[i]
+                self._passes[i] = k + 1
+                if not spec.after <= k < spec.after + spec.times:
+                    continue
+                if spec.prob is not None \
+                        and self._rngs[i].random() >= spec.prob:
+                    continue
+                if spec.delay_s is not None:
+                    delay = max(delay or 0.0, spec.delay_s)
+                    self.fired.append((point, model, lane, "delay"))
+                else:
+                    raise_spec = spec
+                    self.fired.append((point, model, lane, "raise"))
+        if delay is not None:
+            time.sleep(delay)
+        if raise_spec is not None:
+            raise InjectedFault(point, raise_spec.message)
+
+    def counts(self) -> dict:
+        """Per-point firing counts (JSON-safe; for gate reports)."""
+        out: dict[str, int] = {}
+        with self._lock:
+            for point, _m, _l, _k in self.fired:
+                out[point] = out.get(point, 0) + 1
+        return out
+
+
+# ---- module surface (the seams check ONE attribute: `_plan`) ----
+
+_plan: FaultPlan | None = None
+
+
+def install(plan: FaultPlan) -> None:
+    """Install ``plan`` as the process-wide fault source (replacing any
+    prior plan — plans don't stack; a chaos run is one schedule)."""
+    global _plan
+    _plan = plan
+
+
+def clear() -> None:
+    global _plan
+    _plan = None
+
+
+@contextlib.contextmanager
+def inject(plan: FaultPlan):
+    """``with faults.inject(plan):`` — install for the block, always
+    cleared on exit (a leaked plan would fault unrelated tests)."""
+    install(plan)
+    try:
+        yield plan
+    finally:
+        clear()
+
+
+def hit(point: str, model: str | None = None,
+        lane: int | None = None) -> None:
+    """The seam call: free (one attribute check) when no plan is
+    installed; may raise :class:`InjectedFault` or sleep otherwise."""
+    if _plan is None:
+        return
+    _plan.fire(point, model, lane)
+
+
+def active() -> bool:
+    return _plan is not None

@@ -38,7 +38,11 @@ Protocol (details + examples in docs/serving.md):
 
 Typed serving errors map to status codes: ``Overloaded`` → 429,
 ``DeadlineExceeded`` → 504, ``ModelNotFound`` → 404, ``BadRequest`` (and
-malformed bodies) → 400, ``ServerClosed`` → 503.
+malformed bodies) → 400, ``ServerClosed`` → 503. The backpressure
+responses — 429, ``ServerClosed`` 503s, and the drain/unhealthy 503
+from ``/healthz`` — carry a ``Retry-After`` header
+(``ServeConfig.retry_after_s``, whole seconds) so generic clients can
+act on the "retry with backoff" contract without parsing bodies.
 
 Each HTTP request blocks its handler thread in ``ModelServer.predict`` —
 the ``ThreadingHTTPServer`` below is exactly the concurrency source the
@@ -138,15 +142,29 @@ class _Handler(BaseHTTPRequestHandler):
     # -- responses --
 
     def _send(self, status: int, body: bytes,
-              content_type: str = "application/json") -> None:
+              content_type: str = "application/json",
+              headers: dict | None = None) -> None:
         self.send_response(status)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        for name, value in (headers or {}).items():
+            self.send_header(name, value)
         self.end_headers()
         self.wfile.write(body)
 
-    def _send_json(self, status: int, payload: Any) -> None:
-        self._send(status, json.dumps(payload).encode("utf-8"))
+    def _send_json(self, status: int, payload: Any,
+                   headers: dict | None = None) -> None:
+        self._send(status, json.dumps(payload).encode("utf-8"),
+                   headers=headers)
+
+    def _retry_after(self) -> dict:
+        """The backpressure hint: ``errors.py`` tells clients to "retry
+        with backoff", so the 429/503 responses must carry something a
+        generic HTTP client can act on. Whole seconds (the header's
+        unit), rounded up, from ``ServeConfig.retry_after_s``."""
+        import math
+        return {"Retry-After":
+                str(max(1, math.ceil(self._ms.config.retry_after_s)))}
 
     def _send_error_typed(self, exc: BaseException) -> None:
         status = 500
@@ -154,8 +172,14 @@ class _Handler(BaseHTTPRequestHandler):
             if isinstance(exc, etype):
                 status = code
                 break
+        headers = None
+        if isinstance(exc, (Overloaded, ServerClosed)):
+            # both are "come back later", not "give up": a full queue
+            # drains, and a draining/swapping server is replaced by a
+            # ready one behind the same balancer
+            headers = self._retry_after()
         self._send_json(status, {"error": type(exc).__name__,
-                                 "message": str(exc)})
+                                 "message": str(exc)}, headers=headers)
 
     # -- routes --
 
@@ -164,10 +188,14 @@ class _Handler(BaseHTTPRequestHandler):
             if self.path == "/healthz":
                 # drain-aware readiness: 503 tells the load balancer to
                 # stop routing here (draining or unhealthy), while the
-                # body keeps answering with the full verdict
+                # body keeps answering with the full verdict; the
+                # Retry-After hint tells a probing client when to look
+                # again
                 payload = self._ms.health()
-                self._send_json(200 if payload["ready"] else 503,
-                                payload)
+                ready = payload["ready"]
+                self._send_json(
+                    200 if ready else 503, payload,
+                    headers=None if ready else self._retry_after())
             elif self.path == "/livez":
                 # liveness is only "the process answers HTTP": always
                 # 200 — a 503 here would make the orchestrator restart

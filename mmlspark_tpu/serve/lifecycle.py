@@ -1,0 +1,389 @@
+"""Model lifecycle — promotion policy, canary state, decision journal.
+
+The serving analog of the training service's supervision split
+(``train/service.py``, PR 11): **sensors** are the PR 8 SLO burn engine
+evaluated over the canary's own stats registry (plus shadow-mode output
+parity); **policy** is :class:`PromotionPolicy` — a PURE decision
+function from a typed :class:`CanarySignal` and the
+:class:`PromotionLedger` to a typed action (promote / rollback / hold),
+unit-testable without a server; the **actuator** is ``ModelServer``
+(``serve/server.py``), which routes the traffic split, samples the
+signal on each lifecycle tick, executes the action, and records every
+decision through :class:`DecisionJournal` (``decisions.jsonl`` on disk
+when ``ServeConfig.lifecycle_dir`` is set, always in memory, mirrored
+as obs ``lifecycle/*`` events + ``serve.lifecycle.*`` counters when the
+tracer is on).
+
+Rollout modes (``ModelServer.deploy_canary``):
+
+* **shadow** — the split fraction of admissions is *mirrored*: the
+  client always gets the stable version's answer; the copy exercises
+  the canary and its outputs are diffed against the stable answers
+  (max-abs parity, the calibration discipline of
+  docs/quantization.md). Zero blast radius; catches crashes, burn,
+  and numerical drift before any client sees the new version.
+* **canary** — the split fraction is *routed*: those clients get the
+  canary's answers. Real exposure, bounded by the fraction.
+
+The routing fraction is a deterministic Bresenham accumulator (every
+``1/fraction``-th admission), not a coin flip: the same admission
+sequence always splits the same way, which is what makes the chaos gate
+reproducible.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any
+
+import numpy as np
+
+from mmlspark_tpu.core.logging_utils import get_logger
+from mmlspark_tpu.obs import runtime as _obs_rt
+from mmlspark_tpu.obs.metrics import registry as _obs_registry
+from mmlspark_tpu.obs.spans import event as _obs_event
+
+_log = get_logger(__name__)
+
+#: decision kinds that bump a ``serve.lifecycle.<kind>s`` counter
+COUNTED_KINDS = ("swap", "canary_deploy", "promote", "rollback",
+                 "lane_death", "lane_restart")
+
+
+def max_abs_parity(ref: Any, got: Any, input_cols: set) -> float | None:
+    """Worst max-abs difference across two tables' numeric output
+    columns (columns beyond the request's inputs preferred; all shared
+    numeric columns when the transform only rewrote existing ones).
+    None when nothing numeric is comparable — the shadow-parity and
+    load-calibration read, shared so the two tolerances mean the same
+    thing."""
+    cols = [c for c in ref.columns
+            if c in got.columns and c not in input_cols]
+    if not cols:
+        cols = [c for c in ref.columns if c in got.columns]
+    worst = None
+    for c in cols:
+        pair = []
+        for col in (ref[c], got[c]):
+            try:
+                if col.dtype == object:
+                    pair.append(np.stack([np.asarray(v, np.float64)
+                                          for v in col]))
+                else:
+                    pair.append(np.asarray(col, np.float64))
+            except (TypeError, ValueError):
+                pair = []
+                break
+        if len(pair) != 2 or pair[0].shape != pair[1].shape:
+            continue  # non-numeric (images, text) or layout-changing
+        diff = float(np.abs(pair[0] - pair[1]).max()) if pair[0].size \
+            else 0.0
+        worst = diff if worst is None else max(worst, diff)
+    return worst
+
+
+# ---------------------------------------------------------------------------
+# decision journal
+# ---------------------------------------------------------------------------
+
+
+class DecisionJournal:
+    """Every lifecycle decision, recorded where forensics can find it.
+
+    Appends one JSON line per decision to ``<dir>/decisions.jsonl``
+    when a directory is configured (the training service's discipline:
+    supervision forensics must not depend on telemetry being enabled),
+    always keeps a bounded in-memory tail, and mirrors into obs
+    (``lifecycle/<kind>`` events + ``serve.lifecycle.<kind>s``
+    counters) when the tracer is on."""
+
+    def __init__(self, directory: str | None = None,
+                 keep: int = 1024):
+        self.path = None
+        if directory:
+            os.makedirs(directory, exist_ok=True)
+            self.path = os.path.join(directory, "decisions.jsonl")
+        self._tail: deque = deque(maxlen=int(keep))
+        self._lock = threading.Lock()
+
+    def record(self, kind: str, payload: dict) -> dict:
+        entry = {"ts": time.time(), "kind": kind, **payload}
+        with self._lock:
+            self._tail.append(entry)
+            if self.path is not None:
+                with open(self.path, "a", encoding="utf-8") as f:
+                    f.write(json.dumps(entry, default=str) + "\n")
+        _log.info("serve lifecycle: %s %s", kind, payload)
+        if _obs_rt._enabled:
+            _obs_event(f"lifecycle/{kind}", "serve",
+                       {k: str(v) for k, v in payload.items()})
+            if kind in COUNTED_KINDS:
+                _obs_registry().counter(f"serve.lifecycle.{kind}s").add()
+        return entry
+
+    def entries(self, kind: str | None = None) -> list[dict]:
+        with self._lock:
+            tail = list(self._tail)
+        return tail if kind is None \
+            else [e for e in tail if e["kind"] == kind]
+
+
+# ---------------------------------------------------------------------------
+# signal, ledger, policy
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class CanarySignal:
+    """One lifecycle tick's sensor reading, fully typed: the canary's
+    burn rates (from its own :class:`~mmlspark_tpu.obs.slo.SLOTracker`
+    sample — ``None`` = not enough canary traffic for a verdict), the
+    short window's terminal count, and — in shadow mode — the worst
+    observed output drift vs the stable version, with the tolerance it
+    is judged against."""
+
+    burn_short: float | None = None
+    burn_long: float | None = None
+    terminal_window: int = 0
+    parity_drift: float | None = None
+    parity_tolerance: float | None = None
+
+
+@dataclasses.dataclass
+class PromotionLedger:
+    """What the policy conditions on across ticks: consecutive clean
+    windows banked toward promotion, and total ticks taken."""
+
+    clean_windows: int = 0
+    ticks: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class Promote:
+    reason: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Rollback:
+    reason: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Hold:
+    reason: str = ""
+    clean: bool = False   # this window banks toward promote_after
+
+
+Action = Any  # Promote | Rollback | Hold
+
+
+@dataclasses.dataclass(frozen=True)
+class PromotionPolicy:
+    """Signal → action, pure. The table (docs/serving.md):
+
+    ===============================  ===================================
+    signal                           action
+    ===============================  ===================================
+    shadow parity drift > tolerance  rollback (wrong answers waiting to
+                                     happen)
+    short-window burn ≥ fast_burn    rollback (the canary is torching
+                                     its error budget)
+    long-window burn ≥ slow_burn     hold, streak reset (sustained
+                                     degradation is not promotable)
+    no burn verdict                  hold (no traffic ≠ healthy)
+    clean window                     bank it; ``promote_after``
+                                     consecutive clean windows promote
+    ===============================  ===================================
+
+    ``fast_burn``/``slow_burn`` default from the SLO spec driving the
+    canary's tracker (:meth:`for_spec`), so "unhealthy for the stable
+    version" and "rollback the canary" mean the same burn.
+    """
+
+    fast_burn: float = 14.0
+    slow_burn: float = 2.0
+    promote_after: int = 3
+
+    def __post_init__(self):
+        if self.promote_after < 1:
+            raise ValueError(
+                f"promote_after must be >= 1: {self.promote_after}")
+        if not (self.fast_burn > 0 and self.slow_burn > 0):
+            raise ValueError("burn thresholds must be > 0")
+
+    @classmethod
+    def for_spec(cls, spec: Any, promote_after: int = 3
+                 ) -> "PromotionPolicy":
+        return cls(fast_burn=spec.fast_burn, slow_burn=spec.slow_burn,
+                   promote_after=promote_after)
+
+    def decide(self, sig: CanarySignal, ledger: PromotionLedger) -> Action:
+        if (sig.parity_drift is not None
+                and sig.parity_tolerance is not None
+                and sig.parity_drift > sig.parity_tolerance):
+            return Rollback(
+                f"shadow parity drift {sig.parity_drift:.4g} exceeds "
+                f"tolerance {sig.parity_tolerance:g}")
+        if sig.burn_short is not None \
+                and sig.burn_short >= self.fast_burn:
+            return Rollback(
+                f"canary fast-burn {sig.burn_short:.1f}x >= "
+                f"{self.fast_burn:g}x budget over the short window "
+                f"({sig.terminal_window} terminal)")
+        if sig.burn_long is not None \
+                and sig.burn_long >= self.slow_burn:
+            return Hold(f"long-window burn {sig.burn_long:.1f}x >= "
+                        f"{self.slow_burn:g}x budget")
+        if sig.burn_short is None:
+            return Hold("insufficient canary traffic for a verdict")
+        if sig.burn_short < self.slow_burn:
+            if ledger.clean_windows + 1 >= self.promote_after:
+                return Promote(
+                    f"{ledger.clean_windows + 1} consecutive clean "
+                    f"windows (burn {sig.burn_short:.2f}x < "
+                    f"{self.slow_burn:g}x)")
+            return Hold(f"clean window "
+                        f"{ledger.clean_windows + 1}/{self.promote_after}",
+                        clean=True)
+        return Hold(f"short-window burn {sig.burn_short:.1f}x above the "
+                    f"promote threshold {self.slow_burn:g}x")
+
+
+# ---------------------------------------------------------------------------
+# canary routing state (owned by ModelServer)
+# ---------------------------------------------------------------------------
+
+
+class CanaryState:
+    """One model's in-flight rollout: the candidate version's batcher
+    plus everything the tick needs — the deterministic router, the
+    shadow comparison ring, the SLO tracker over the canary's own
+    stats, and the promotion ledger."""
+
+    def __init__(self, name: str, version: Any, mode: str,
+                 fraction: float, batcher: Any, tracker: Any,
+                 policy: PromotionPolicy,
+                 parity_tolerance: float | None = None,
+                 max_pending_pairs: int = 256):
+        if mode not in ("canary", "shadow"):
+            raise ValueError(
+                f"canary mode must be 'canary' or 'shadow': {mode!r}")
+        if not 0.0 < fraction <= 1.0:
+            raise ValueError(
+                f"canary fraction must be in (0, 1]: {fraction}")
+        self.name = name
+        self.version = version
+        self.mode = mode
+        self.fraction = float(fraction)
+        self.batcher = batcher
+        self.tracker = tracker          # obs.slo.SLOTracker (canary's)
+        self.policy = policy
+        self.ledger = PromotionLedger()
+        self.entry = None               # the full _ModelEntry promotion
+        #                                 flips in (set by the server)
+        # one policy evaluation at a time: two concurrent /slo pollers
+        # must not interleave sample → decide → ledger-update (a clean
+        # window would double-count toward promotion)
+        self.tick_lock = threading.Lock()
+        self.parity_tolerance = parity_tolerance
+        self._lock = threading.Lock()
+        self._acc = 0.0
+        # shadow mode: (stable request, mirror request) pairs awaiting
+        # both resolutions; bounded drop-oldest — parity is a sampled
+        # signal, not an audit log
+        self._pairs: deque = deque(maxlen=int(max_pending_pairs))
+        self.parity_max: float | None = None
+        self.pairs_compared = 0
+        self.shadow_errors = 0
+
+    # -- routing --
+
+    def route(self) -> bool:
+        """True when this admission belongs to the split — the
+        deterministic Bresenham accumulator: over any window of N
+        admissions, ``round(N * fraction) ± 1`` are taken, in a fixed
+        pattern."""
+        with self._lock:
+            self._acc += self.fraction
+            if self._acc >= 1.0 - 1e-12:
+                self._acc -= 1.0
+                return True
+            return False
+
+    def note_pair(self, stable_req: Any, mirror_req: Any) -> None:
+        with self._lock:
+            self._pairs.append((stable_req, mirror_req))
+
+    # -- sampling --
+
+    def collect_parity(self) -> None:
+        """Fold every fully-resolved shadow pair into the parity
+        signal; unresolved pairs stay pending. Called on the tick (and
+        only there — no comparison thread; an unticked canary costs
+        nothing beyond its mirrored dispatches)."""
+        with self._lock:
+            pending = []
+            done = []
+            while self._pairs:
+                pair = self._pairs.popleft()
+                if pair[0].done and pair[1].done:
+                    done.append(pair)
+                else:
+                    pending.append(pair)
+            self._pairs.extend(pending)
+        for stable_req, mirror_req in done:
+            if mirror_req._error is not None:
+                # already burn-visible via the canary stats' failed
+                # counter; tallied here so the status surface can say
+                # "mirrors are dying" explicitly
+                with self._lock:
+                    self.shadow_errors += 1
+                continue
+            if stable_req._error is not None:
+                continue  # stable-side timeout: nothing to diff
+            drift = max_abs_parity(stable_req._result,
+                                   mirror_req._result,
+                                   set(stable_req.table.columns))
+            if drift is None:
+                continue
+            with self._lock:
+                self.pairs_compared += 1
+                self.parity_max = drift if self.parity_max is None \
+                    else max(self.parity_max, drift)
+            self.batcher.stats.registry.histogram(
+                "serve.canary_parity", window=1024,
+                **self.batcher.stats.labels).observe(drift)
+
+    def signal(self) -> CanarySignal:
+        """Sample the burn engine (one SLO sample on the canary's
+        registry) + the parity ring into one typed signal."""
+        if self.mode == "shadow":
+            self.collect_parity()
+        status = self.tracker.sample()
+        with self._lock:
+            drift = self.parity_max
+        return CanarySignal(
+            burn_short=status.get("burn_rate_short"),
+            burn_long=status.get("burn_rate_long"),
+            terminal_window=(status.get("window_short") or {}).get(
+                "terminal", 0),
+            parity_drift=drift,
+            parity_tolerance=self.parity_tolerance)
+
+    def describe(self) -> dict:
+        with self._lock:
+            return {
+                "version": self.version,
+                "mode": self.mode,
+                "fraction": self.fraction,
+                "clean_windows": self.ledger.clean_windows,
+                "ticks": self.ledger.ticks,
+                "parity_max": self.parity_max,
+                "pairs_compared": self.pairs_compared,
+                "shadow_errors": self.shadow_errors,
+            }

@@ -13,17 +13,20 @@ per (model, bucket) exists before the first request arrives.
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 from typing import Any, Iterable, Mapping
 
 import numpy as np
 
 from mmlspark_tpu.core.logging_utils import get_logger
+from mmlspark_tpu.core.retry import RetryPolicy, call_with_retry
 from mmlspark_tpu.data.table import DataTable
 from mmlspark_tpu.serve.batcher import DynamicBatcher, ServeRequest
 from mmlspark_tpu.serve.config import ServeConfig
 from mmlspark_tpu.serve.errors import (
-    BadRequest, ModelLoadError, ModelNotFound, ServerClosed,
+    BadRequest, DeadlineExceeded, LaneFailed, ModelLoadError,
+    ModelNotFound, Overloaded, ServeError, ServerClosed,
 )
 from mmlspark_tpu.serve.stats import ServerStats
 
@@ -110,42 +113,20 @@ def _example_rows(schema: Any, n: int) -> DataTable | None:
     return table
 
 
-def _max_abs_parity(ref: DataTable, got: DataTable,
-                    input_cols: set) -> float | None:
-    """Worst max-abs difference across the transform's numeric output
-    columns (columns the transform ADDED preferred; all shared numeric
-    columns when it only rewrote existing ones). None when nothing
-    numeric is comparable."""
-    cols = [c for c in ref.columns
-            if c in got.columns and c not in input_cols]
-    if not cols:
-        cols = [c for c in ref.columns if c in got.columns]
-    worst = None
-    for c in cols:
-        pair = []
-        for col in (ref[c], got[c]):
-            try:
-                if col.dtype == object:
-                    pair.append(np.stack([np.asarray(v, np.float64)
-                                          for v in col]))
-                else:
-                    pair.append(np.asarray(col, np.float64))
-            except (TypeError, ValueError):
-                pair = []
-                break
-        if len(pair) != 2 or pair[0].shape != pair[1].shape:
-            continue  # non-numeric (images, text) or layout-changing
-        diff = float(np.abs(pair[0] - pair[1]).max()) if pair[0].size \
-            else 0.0
-        worst = diff if worst is None else max(worst, diff)
-    return worst
+# the ONE parity read both the load-time low-precision calibration and
+# the shadow-canary drift signal use (serve/lifecycle.py), so their
+# tolerances mean the same thing
+from mmlspark_tpu.serve.lifecycle import (  # noqa: E402
+    max_abs_parity as _max_abs_parity,
+)
 
 
 class _ModelEntry:
     def __init__(self, name: str, model: Any, batcher: DynamicBatcher,
                  schema: Any | None, mesh_spec: Any | None = None,
                  slo: Any = None, health: Any = None,
-                 precision: Any = None, parity: float | None = None):
+                 precision: Any = None, parity: float | None = None,
+                 version: Any = None):
         self.name = name
         self.model = model
         self.batcher = batcher
@@ -155,6 +136,8 @@ class _ModelEntry:
         self.health = health    # obs.health.HealthMonitor
         self.precision = precision  # core.precision.PrecisionPolicy | None
         self.parity = parity    # measured max-abs vs f32 offline at load
+        self.version = version  # model-repo version (or caller tag)
+        self.canary: Any = None  # serve.lifecycle.CanaryState | None
 
 
 class ModelServer:
@@ -165,19 +148,31 @@ class ModelServer:
     """
 
     def __init__(self, config: ServeConfig | None = None):
+        from mmlspark_tpu.serve.lifecycle import DecisionJournal
         self.config = config or ServeConfig()
         self._models: dict[str, _ModelEntry] = {}
         self._lock = threading.Lock()
         self._closed = False
+        # lifecycle forensics: swap/canary/promote/rollback and lane
+        # death/restart decisions — decisions.jsonl on disk when
+        # ServeConfig.lifecycle_dir is set, always the in-memory tail
+        self.journal = DecisionJournal(self.config.lifecycle_dir)
 
     # -- loading --
 
-    def add_model(self, name: str, model: Any,
-                  schema: Any | None = None,
-                  example: DataTable | None = None,
-                  mesh: Any = None, shard_params: Any = None,
-                  precision: Any = None) -> None:
-        """Register ``model`` under ``name``.
+    def _build_entry(self, name: str, model: Any,
+                     schema: Any | None = None,
+                     example: DataTable | None = None,
+                     mesh: Any = None, shard_params: Any = None,
+                     precision: Any = None, version: Any = None,
+                     ) -> _ModelEntry:
+        """Validate, shard, warm, and calibrate one servable — the
+        whole load path SHORT of registration, shared by
+        :meth:`add_model` (stable loads and hot-swaps) and
+        :meth:`deploy_canary` (candidate versions warming concurrently
+        with live traffic). Returns a running, warmed entry that is not
+        yet routed any requests; on any failure its batcher is closed
+        before the raise (no leaked dispatch threads).
 
         1. **Validate** with the pre-flight analyzer over ``schema`` (or a
            schema derived from the model's own input contract, or an
@@ -275,10 +270,16 @@ class ModelServer:
         except (TypeError, ValueError) as e:
             raise ModelLoadError(name, message=(
                 f"model {name!r}: invalid SLO spec: {e}")) from e
-        stats = ServerStats(self.config.stats_window, model=name)
+        stats = ServerStats(
+            self.config.stats_window, model=name,
+            extra_labels=None if version is None
+            else {"version": version})
         batcher = DynamicBatcher(name, stages, cache_host, self.config,
                                  stats, replicas=replicas,
                                  lockstep=lockstep, precision=policy)
+        # lane supervision lands in the lifecycle journal: a death or
+        # restart is a capacity decision, same forensics as a swap
+        batcher.on_lane_event = self.journal.record
         tracker = SLOTracker(spec, stats,
                              queued_fn=lambda: batcher.queued)
         monitor = HealthMonitor.for_spec(spec)
@@ -299,22 +300,76 @@ class ModelServer:
         except BaseException:
             batcher.close(drain=False)
             raise
+        return _ModelEntry(name, model, batcher, schema, mesh_spec,
+                           slo=tracker, health=monitor, precision=policy,
+                           parity=parity, version=version)
+
+    def add_model(self, name: str, model: Any,
+                  schema: Any | None = None,
+                  example: DataTable | None = None,
+                  mesh: Any = None, shard_params: Any = None,
+                  precision: Any = None, version: Any = None) -> None:
+        """Register ``model`` under ``name`` (see :meth:`_build_entry`
+        for the validate → shard → warm → calibrate load path).
+
+        Re-registering a served name is the **hot-swap**: the new
+        version loads and warms its whole bucket ladder while the live
+        version keeps serving (compiles release the GIL — the PR 7 warm
+        discipline), then the name flips to the new entry atomically
+        and the old batcher drains — every request admitted before the
+        flip is answered by the version that admitted it, and
+        :meth:`submit` re-routes the flip race, so no request is ever
+        dropped by a swap (``check_serve_lifecycle`` pins this).
+        ``version`` tags the entry (the model-repo version, or any
+        caller label): it labels the per-version stats registry and the
+        journal's swap records."""
+        entry = self._build_entry(name, model, schema=schema,
+                                  example=example, mesh=mesh,
+                                  shard_params=shard_params,
+                                  precision=precision, version=version)
         with self._lock:
             if self._closed:
-                batcher.close(drain=False)
+                entry.batcher.close(drain=False)
                 raise ServerClosed("server is closed")
             old = self._models.get(name)
-            self._models[name] = _ModelEntry(name, model, batcher, schema,
-                                             mesh_spec, slo=tracker,
-                                             health=monitor,
-                                             precision=policy,
-                                             parity=parity)
+            if old is not None:
+                # the outgoing version's canary (if any) dies with it:
+                # a swap supersedes an in-flight rollout
+                canary, old.canary = old.canary, None
+            self._models[name] = entry
         if old is not None:
+            if canary is not None:
+                canary.batcher.close(drain=True)
             old.batcher.close(drain=True)
-        _log.info("serve[%s]: loaded (%d stage(s), buckets=%s, mesh=%s, "
-                  "precision=%s)", name, len(stages), self.config.buckets,
-                  mesh_spec.describe() if mesh_spec else "default",
-                  policy.describe() if policy else "f32")
+            self.journal.record("swap", {
+                "model": name, "from_version": old.version,
+                "to_version": version,
+                "canary_superseded": canary is not None})
+        _log.info("serve[%s]: loaded (buckets=%s, mesh=%s, "
+                  "precision=%s, version=%s)", name, self.config.buckets,
+                  entry.mesh_spec.describe() if entry.mesh_spec
+                  else "default",
+                  entry.precision.describe() if entry.precision
+                  else "f32", version)
+
+    def add_model_from_repo(self, repo: Any, name: str,
+                            version: int | None = None,
+                            schema: Any | None = None,
+                            example: DataTable | None = None,
+                            **kwargs: Any) -> Any:
+        """Load ``name`` from a versioned
+        :class:`~mmlspark_tpu.models.repo.ModelRepo` (a repo object or
+        its root path) and serve it — the repo's digests verify before
+        anything deserializes, so a torn or corrupt version raises the
+        repo's typed error here and a currently-served version keeps
+        serving untouched. Returns the verified ``ModelVersion``."""
+        from mmlspark_tpu.models.repo import ModelRepo
+        if isinstance(repo, str):
+            repo = ModelRepo(repo)
+        model, info = repo.load(name, version)
+        self.add_model(name, model, schema=schema, example=example,
+                       version=info.version, **kwargs)
+        return info
 
     def _audit_sharded(self, name: str, stages: list, schema: Any,
                        mesh_spec: Any, replicas: Any,
@@ -426,16 +481,227 @@ class ModelServer:
     def submit(self, name: str, table: DataTable,
                deadline_ms: float | None = None) -> ServeRequest:
         """Admit a request; returns the awaitable handle. ``deadline_ms``
-        defaults to the server-wide ``ServeConfig.deadline_ms``."""
+        defaults to the server-wide ``ServeConfig.deadline_ms``.
+
+        Swap-safe: a hot-swap that closes the old batcher between this
+        call's entry lookup and its admission re-routes to the entry
+        that now owns the name (the zero-dropped-requests contract) —
+        ``ServerClosed`` only propagates when the SERVER is closing or
+        the model is gone. With a rollout in flight, the canary's
+        deterministic router takes its configured fraction: mirrored
+        (shadow — the stable answer is returned either way) or split
+        (canary — those requests get the candidate's answers)."""
         if deadline_ms is None:
             deadline_ms = self.config.deadline_ms
-        return self._entry(name).batcher.submit(table, deadline_ms)
+        while True:
+            entry = self._entry(name)
+            canary = entry.canary
+            take = canary is not None and canary.route()
+            if take and canary.mode == "canary":
+                try:
+                    return canary.batcher.submit(table, deadline_ms)
+                except ServerClosed:
+                    pass  # rolled back mid-flight: stable serves it
+            try:
+                req = entry.batcher.submit(table, deadline_ms)
+            except ServerClosed:
+                with self._lock:
+                    closed = self._closed
+                    cur = self._models.get(name)
+                if closed or cur is None or cur is entry:
+                    raise
+                continue  # hot-swap raced us: retry on the new entry
+            if take and canary.mode == "shadow":
+                try:
+                    mirror = canary.batcher.submit(table, deadline_ms)
+                    canary.note_pair(req, mirror)
+                except ServeError:
+                    # a shadow must never affect the stable path: a
+                    # mirror bounced by canary admission (overload,
+                    # rollback race) is burn-visible in the canary
+                    # stats, nothing more
+                    pass
+            return req
 
     def predict(self, name: str, table: DataTable,
                 deadline_ms: float | None = None,
                 timeout: float | None = None) -> DataTable:
         """Blocking submit+wait."""
         return self.submit(name, table, deadline_ms).result(timeout)
+
+    # -- rollout: canary/shadow + SLO-driven promotion (lifecycle.py) --
+
+    def deploy_canary(self, name: str, model: Any,
+                      mode: str = "shadow", fraction: float = 0.25,
+                      version: Any = None, schema: Any | None = None,
+                      example: DataTable | None = None,
+                      mesh: Any = None, shard_params: Any = None,
+                      precision: Any = None, policy: Any = None,
+                      parity_tolerance: float | None = None,
+                      promote_after: int = 3) -> None:
+        """Start a rollout of ``model`` as ``name``'s candidate version.
+
+        The candidate goes through the full load path (validate, warm
+        its own bucket ladder, calibrate) while the stable version keeps
+        serving; from then on the configured ``fraction`` of admissions
+        is mirrored (``mode="shadow"``: clients still get stable
+        answers, outputs are diffed) or split (``mode="canary"``: those
+        clients get candidate answers). Each :meth:`lifecycle_tick`
+        samples the candidate's burn engine (+ shadow parity vs
+        ``parity_tolerance``) and runs ``policy``
+        (:class:`~mmlspark_tpu.serve.lifecycle.PromotionPolicy`,
+        default derived from the server's SLO spec): fast-burn or
+        parity drift auto-rolls back, ``promote_after`` consecutive
+        clean windows promote the candidate to stable. Every decision
+        is journaled."""
+        from mmlspark_tpu.obs.slo import SLOSpec
+        from mmlspark_tpu.serve.lifecycle import (
+            CanaryState, PromotionPolicy,
+        )
+        stable = self._entry(name)  # ModelNotFound before any build
+        # everything cheap validates BEFORE the expensive build: a bad
+        # mode/fraction/policy must not leave a fully warmed candidate
+        # batcher running with no owner
+        if mode not in ("canary", "shadow"):
+            raise ValueError(
+                f"canary mode must be 'canary' or 'shadow': {mode!r}")
+        if not 0.0 < fraction <= 1.0:
+            raise ValueError(
+                f"canary fraction must be in (0, 1]: {fraction}")
+        if policy is None:
+            policy = PromotionPolicy.for_spec(
+                SLOSpec.parse(self.config.slo), promote_after)
+        entry = self._build_entry(name, model, schema=schema,
+                                  example=example, mesh=mesh,
+                                  shard_params=shard_params,
+                                  precision=precision, version=version)
+        try:
+            state = CanaryState(name, version, mode, fraction,
+                                entry.batcher, entry.slo, policy,
+                                parity_tolerance=parity_tolerance)
+        except ValueError:
+            entry.batcher.close(drain=False)
+            raise
+        state.entry = entry  # promotion flips this whole entry in
+        with self._lock:
+            if self._closed:
+                entry.batcher.close(drain=False)
+                raise ServerClosed("server is closed")
+            cur = self._models.get(name)
+            if cur is None:
+                entry.batcher.close(drain=False)
+                raise ModelNotFound(name, list(self._models))
+            replaced, cur.canary = cur.canary, state
+        if replaced is not None:
+            replaced.batcher.close(drain=True)
+        self.journal.record("canary_deploy", {
+            "model": name, "version": version, "mode": mode,
+            "fraction": fraction,
+            "stable_version": stable.version,
+            "replaced": None if replaced is None else replaced.version})
+
+    def lifecycle_tick(self, name: str) -> dict | None:
+        """One promotion-policy evaluation for ``name``'s rollout (None
+        when no canary is deployed): sample the canary's SLO burn + the
+        shadow-parity ring into a typed signal, run the pure policy,
+        execute the action. On-demand like every PR 8 sampler — polling
+        this (or ``/slo``) IS the rollout's evaluation cadence."""
+        entry = self._entry(name)
+        canary = entry.canary
+        if canary is None:
+            return None
+        with canary.tick_lock:
+            return self._tick_locked(name, entry, canary)
+
+    def _tick_locked(self, name: str, entry: _ModelEntry,
+                     canary: Any) -> dict | None:
+        from mmlspark_tpu.serve.lifecycle import Hold, Promote, Rollback
+        if entry.canary is not canary:
+            return None  # a concurrent tick already decided
+        sig = canary.signal()
+        action = canary.policy.decide(sig, canary.ledger)
+        canary.ledger.ticks += 1
+        detail = {
+            "model": name, "version": canary.version,
+            "mode": canary.mode, "reason": action.reason,
+            "burn_short": sig.burn_short, "burn_long": sig.burn_long,
+            "terminal_window": sig.terminal_window,
+            "parity_drift": sig.parity_drift,
+            "clean_windows": canary.ledger.clean_windows,
+            "ticks": canary.ledger.ticks,
+        }
+        if isinstance(action, Rollback):
+            if self._end_canary(entry, canary, "rollback", detail):
+                return {"action": "rollback", **detail}
+            return None  # a racing close()/swap already detached it
+        if isinstance(action, Promote):
+            if self._promote(entry, canary, detail):
+                return {"action": "promote", **detail}
+            return None
+        assert isinstance(action, Hold)
+        canary.ledger.clean_windows = (
+            canary.ledger.clean_windows + 1 if action.clean else 0)
+        detail["clean_windows"] = canary.ledger.clean_windows
+        self.journal.record("hold", detail)
+        return {"action": "hold", **detail}
+
+    def rollback(self, name: str, reason: str = "manual") -> dict | None:
+        """Abort ``name``'s rollout now (the operator's big red
+        button); None when no canary is deployed."""
+        entry = self._entry(name)
+        canary = entry.canary
+        if canary is None:
+            return None
+        detail = {"model": name, "version": canary.version,
+                  "mode": canary.mode, "reason": reason}
+        if self._end_canary(entry, canary, "rollback", detail):
+            return {"action": "rollback", **detail}
+        return None
+
+    def _end_canary(self, entry: _ModelEntry, canary: Any,
+                    kind: str, detail: dict) -> bool:
+        """Atomically detach + drain the canary (False when another
+        thread's decision already detached it — exactly one rollback/
+        promote ever executes per rollout)."""
+        with self._lock:
+            if entry.canary is not canary:
+                return False
+            entry.canary = None
+        canary.batcher.close(drain=True)
+        self.journal.record(kind, {**detail, **canary.describe()})
+        return True
+
+    def _promote(self, entry: _ModelEntry, canary: Any,
+                 detail: dict) -> bool:
+        """The candidate becomes stable: its (already warm) entry takes
+        the name atomically, the outgoing stable drains — the same flip
+        as a hot-swap, decided by the burn engine instead of an
+        operator."""
+        with self._lock:
+            if self._closed or entry.canary is not canary \
+                    or self._models.get(entry.name) is not entry:
+                # a racing close() owns teardown of whatever is still
+                # attached — installing the promoted entry after close
+                # snapshots would leak its batcher threads forever
+                return False
+            entry.canary = None
+            promoted = canary.entry
+            promoted.canary = None
+            self._models[entry.name] = promoted
+        entry.batcher.close(drain=True)
+        self.journal.record("promote", {
+            **detail, "from_version": entry.version,
+            **canary.describe()})
+        return True
+
+    def canary_status(self, name: str) -> dict | None:
+        entry = self._entry(name)
+        return None if entry.canary is None else entry.canary.describe()
+
+    def lifecycle_decisions(self, kind: str | None = None) -> list[dict]:
+        """The in-memory decision tail (``decisions.jsonl`` carries the
+        same records on disk when ``lifecycle_dir`` is set)."""
+        return self.journal.entries(kind)
 
     # -- introspection --
 
@@ -466,6 +732,18 @@ class ModelServer:
                 snap["precision"] = e.precision.describe()
                 if e.parity is not None:
                     snap["precision_parity"] = e.parity
+            if e.version is not None:
+                snap["version"] = e.version
+            snap["lane_health"] = e.batcher.lane_health()
+            canary = e.canary
+            if canary is not None:
+                snap["canary"] = {
+                    **canary.describe(),
+                    **{f"stats_{k}": v for k, v in
+                       canary.batcher.stats.snapshot().items()
+                       if k in ("admitted", "completed", "failed",
+                                "timed_out", "rejected_overload")},
+                }
             out[e.name] = snap
         return out
 
@@ -474,8 +752,14 @@ class ModelServer:
         HTTP front end hands to the Prometheus exposition alongside the
         process-wide obs registry."""
         with self._lock:
-            return [e.batcher.stats.registry
-                    for e in self._models.values()]
+            out = []
+            for e in self._models.values():
+                out.append(e.batcher.stats.registry)
+                if e.canary is not None:
+                    # the candidate's per-version series (distinct
+                    # version label) scrape alongside the stable's
+                    out.append(e.canary.batcher.stats.registry)
+            return out
 
     # -- SLO + health surfaces (obs/slo.py + obs/health.py) --
 
@@ -483,23 +767,46 @@ class ModelServer:
         """One SLO sample + health-machine advance for one model:
         (status dict, health dict). The single place the per-model
         health shape is built — ``/slo`` and ``/healthz`` must never
-        diverge on it."""
+        diverge on it. Lane supervision merges in here: a model with a
+        dispatch lane down is at least DEGRADED — restarted-but-
+        shrunken capacity must show on the health surface, not hide
+        behind still-clean latency percentiles."""
+        from mmlspark_tpu.obs.health import DEGRADED, SEVERITY
         status = e.slo.sample()
         verdict = e.health.update_describe(status)
-        return status, {**verdict, "draining": e.batcher.closed}
+        lanes = e.batcher.lane_health()
+        state, reason = verdict["state"], verdict["reason"]
+        if lanes["alive"] < lanes["lanes"] \
+                and SEVERITY[state] < SEVERITY[DEGRADED]:
+            down = lanes["lanes"] - lanes["alive"]
+            state = DEGRADED
+            reason = (f"{down}/{lanes['lanes']} dispatch lane(s) down "
+                      f"({lanes['restarts']} restart(s) used)")
+        return status, {"state": state, "reason": reason,
+                        "draining": e.batcher.closed, "lanes": lanes}
 
     def slo_snapshot(self) -> dict:
         """Sample every model's SLO tracker and advance its health
         machine; the JSON-safe ``/slo`` body. Each call is one burn-rate
         sample per model (registry reads only — no device work, no
         batcher locks beyond the queue-depth read), so polling this IS
-        the sampling cadence."""
+        the sampling cadence — INCLUDING the rollout loop: a model with
+        a canary deployed gets one :meth:`lifecycle_tick` per poll, so
+        an HTTP-only operator's ``/slo`` probes drive auto-rollback/
+        promotion without any in-process caller (the decision, if any,
+        rides along under ``"lifecycle"``)."""
         with self._lock:
             entries = list(self._models.values())
         out = {}
         for e in entries:
+            decision = None
+            if e.canary is not None:
+                decision = self.lifecycle_tick(e.name)
             status, health = self._sample_model_health(e)
-            out[e.name] = {**status, "health": health}
+            body = {**status, "health": health}
+            if decision is not None:
+                body["lifecycle"] = decision
+            out[e.name] = body
         return out
 
     def health(self) -> dict:
@@ -537,6 +844,9 @@ class ModelServer:
             self._closed = True
             entries = list(self._models.values())
         for e in entries:
+            canary, e.canary = e.canary, None
+            if canary is not None:
+                canary.batcher.close(drain=drain)
             e.batcher.close(drain=drain)
 
     def __enter__(self) -> "ModelServer":
@@ -546,28 +856,86 @@ class ModelServer:
         self.close()
 
 
+# what a client-side retry may NEVER retry, regardless of the policy it
+# was handed: an expired deadline is the caller's latency budget spent
+# (retrying busts it by construction), and a malformed request or
+# unknown model will fail identically every time
+_NEVER_RETRY = (DeadlineExceeded, BadRequest, ModelNotFound)
+
+#: the ``retry=True`` policy: transient serving faults only —
+#: ``Overloaded`` (admission backpressure: back off and re-offer) and
+#: ``LaneFailed`` (a dispatch lane died mid-flight; the supervisor
+#: restarts it, a retry lands on healthy capacity)
+DEFAULT_PREDICT_RETRY = RetryPolicy(
+    max_attempts=3, base_delay_s=0.05, max_delay_s=2.0,
+    retry_on=(Overloaded, LaneFailed))
+
+
+def _retry_policy(retry: Any) -> RetryPolicy | None:
+    """Coerce the ``retry=`` argument (None/False = off, True = the
+    default policy, or a caller ``RetryPolicy``) and pin the
+    never-retry guard INTO the predicate — a caller policy with
+    ``retry_on=(ServeError,)`` still cannot re-spend an expired
+    deadline or replay a bad request."""
+    if retry is None or retry is False:
+        return None
+    policy = DEFAULT_PREDICT_RETRY if retry is True else retry
+    orig = policy.retry_if
+    return dataclasses.replace(
+        policy,
+        retry_if=lambda e: not isinstance(e, _NEVER_RETRY)
+        and (orig is None or orig(e)))
+
+
 class Client:
     """In-process client: the deterministic test/bench surface, mirroring
-    what the HTTP front end does without sockets."""
+    what the HTTP front end does without sockets.
 
-    def __init__(self, server: ModelServer):
+    ``retry`` (per call, or a client-wide default) retries TRANSIENT
+    serving faults through :mod:`mmlspark_tpu.core.retry` — by default
+    ``Overloaded`` backpressure and ``LaneFailed`` lane deaths, with
+    jittered exponential backoff. ``DeadlineExceeded``/``BadRequest``/
+    ``ModelNotFound`` are never retried (enforced even against a
+    broader caller policy). Each attempt is a fresh submission with a
+    fresh ``deadline_ms`` budget."""
+
+    def __init__(self, server: ModelServer, retry: Any = None):
         self.server = server
+        self._retry = retry
 
     def predict(self, model: str,
                 rows: DataTable | Iterable[Mapping[str, Any]],
                 deadline_ms: float | None = None,
                 columns: Iterable[str] | None = None,
-                timeout: float | None = None) -> DataTable:
+                timeout: float | None = None,
+                retry: Any = None) -> DataTable:
         if not isinstance(rows, DataTable):
             rows = DataTable.from_rows(list(rows))
-        out = self.server.predict(model, rows, deadline_ms, timeout)
+        policy = _retry_policy(retry if retry is not None
+                               else self._retry)
+        if policy is None:
+            out = self.server.predict(model, rows, deadline_ms, timeout)
+        else:
+            out = call_with_retry(
+                lambda: self.server.predict(model, rows, deadline_ms,
+                                            timeout), policy)
         if columns is not None:
             out = out.select(*columns)
         return out
 
     def predict_async(self, model: str,
                       rows: DataTable | Iterable[Mapping[str, Any]],
-                      deadline_ms: float | None = None) -> ServeRequest:
+                      deadline_ms: float | None = None,
+                      retry: Any = None) -> ServeRequest:
+        """Async submit; ``retry`` covers the SUBMISSION (admission
+        backpressure) only — once a handle exists, waiting on it is the
+        caller's, and retrying a dispatched request would risk the
+        double-response the whole pipeline is built to never produce."""
         if not isinstance(rows, DataTable):
             rows = DataTable.from_rows(list(rows))
-        return self.server.submit(model, rows, deadline_ms)
+        policy = _retry_policy(retry if retry is not None
+                               else self._retry)
+        if policy is None:
+            return self.server.submit(model, rows, deadline_ms)
+        return call_with_retry(
+            lambda: self.server.submit(model, rows, deadline_ms), policy)

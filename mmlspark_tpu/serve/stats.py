@@ -27,7 +27,8 @@ from mmlspark_tpu.obs.metrics import MetricsRegistry
 class ServerStats:
     """Thread-safe metrics surface of one served model."""
 
-    def __init__(self, window: int = 4096, model: str = ""):
+    def __init__(self, window: int = 4096, model: str = "",
+                 extra_labels: dict | None = None):
         self.model = model
         # per-instance registry: a reloaded model (or a second server in
         # the same process/test) starts from zero, never from a prior
@@ -35,6 +36,11 @@ class ServerStats:
         self.registry = MetricsRegistry()
         self._window = int(window)
         lbl = {"model": model} if model else {}
+        if extra_labels:
+            # per-VERSION registries (the model lifecycle): a stable
+            # v1 and its v2 canary carry distinguishable series in
+            # /metrics even while both serve under one model name
+            lbl = {**lbl, **{k: str(v) for k, v in extra_labels.items()}}
         self._lbl = lbl
         reg = self.registry
         # request-side counters (admission → terminal state)
@@ -48,6 +54,12 @@ class ServerStats:
         self._batches = reg.counter("serve.batches", **lbl)
         self._rows_dispatched = reg.counter("serve.rows_dispatched", **lbl)
         self._rows_padded = reg.counter("serve.rows_padded", **lbl)
+        # lane self-healing counters (the supervisor's seam — a lane
+        # death that silently shrank capacity would be invisible in
+        # every latency percentile until overload)
+        self._lane_deaths = reg.counter("serve.lane_deaths", **lbl)
+        self._lane_restarts = reg.counter("serve.lane_restarts", **lbl)
+        self._requeued = reg.counter("serve.requeued_batches", **lbl)
         # bounded reservoirs (latest `window` observations)
         self._e2e_ms = reg.histogram("serve.e2e_ms", window=window, **lbl)
         self._queue_ms = reg.histogram("serve.queue_wait_ms",
@@ -102,6 +114,18 @@ class ServerStats:
     def rows_padded(self) -> int:
         return int(self._rows_padded.value)
 
+    @property
+    def lane_deaths(self) -> int:
+        return int(self._lane_deaths.value)
+
+    @property
+    def lane_restarts(self) -> int:
+        return int(self._lane_restarts.value)
+
+    @property
+    def requeued_batches(self) -> int:
+        return int(self._requeued.value)
+
     # registry-read accessors for the SLO engine (obs/slo.py): burn
     # rates and derived gauges are computed ONLY from these reads —
     # never from new side-channel counters
@@ -143,6 +167,17 @@ class ServerStats:
 
     def record_failed(self) -> None:
         self._failed.add()
+
+    # -- lane supervision side --
+
+    def record_lane_death(self) -> None:
+        self._lane_deaths.add()
+
+    def record_lane_restart(self) -> None:
+        self._lane_restarts.add()
+
+    def record_requeued(self, batches: int = 1) -> None:
+        self._requeued.add(batches)
 
     def record_done(self, e2e_ms: float, queue_ms: float) -> None:
         self._completed.add()
@@ -217,6 +252,9 @@ class ServerStats:
             "batches": self.batches,
             "rows_dispatched": self.rows_dispatched,
             "rows_padded": self.rows_padded,
+            "lane_deaths": self.lane_deaths,
+            "lane_restarts": self.lane_restarts,
+            "requeued_batches": self.requeued_batches,
             "batch_occupancy_mean": self._occupancy.mean(),
             "occupancy_by_bucket": dict(sorted(buckets.items())),
             "e2e_ms": self._e2e_ms.percentiles(),

@@ -1,0 +1,205 @@
+"""Versioned model repo (models/repo.py): atomic publish, digest
+verification, typed corrupt/missing errors, CURRENT pointer semantics —
+the artifact-side guarantees the serving lifecycle builds on."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from mmlspark_tpu.models import (
+    ModelBundle, ModelRepo, RepoCorruptError, VersionNotFound,
+)
+from mmlspark_tpu.models.repo import BUNDLE_FILE, VERSION_MANIFEST
+from mmlspark_tpu.models.zoo import MLP
+from mmlspark_tpu.serve import faults
+from mmlspark_tpu.serve.faults import FaultPlan, FaultSpec, InjectedFault
+
+
+def mlp_bundle(seed=0, in_dim=6):
+    module = MLP(features=(8,), num_outputs=4)
+    params = module.init(jax.random.PRNGKey(seed),
+                         np.zeros((1, in_dim), np.float32))["params"]
+    return ModelBundle(
+        module=module,
+        params=jax.tree_util.tree_map(np.asarray, params),
+        input_spec=(in_dim,),
+        output_names=("features", "logits"),
+        name="mlp")
+
+
+def params_equal(a, b):
+    la = jax.tree_util.tree_leaves(a.params)
+    lb = jax.tree_util.tree_leaves(b.params)
+    return len(la) == len(lb) and all(
+        np.array_equal(x, y) for x, y in zip(la, lb))
+
+
+class TestPublishLoad:
+    def test_roundtrip_and_versioning(self, tmp_path):
+        repo = ModelRepo(str(tmp_path))
+        v1 = repo.publish("mlp", mlp_bundle(seed=0))
+        assert v1 == 1
+        assert repo.versions("mlp") == [1]
+        assert repo.current_version("mlp") == 1
+
+        v2 = repo.publish("mlp", mlp_bundle(seed=1))
+        assert v2 == 2
+        assert repo.current_version("mlp") == 2
+
+        loaded2, info2 = repo.load("mlp")
+        assert info2.version == 2 and info2.kind == "bundle"
+        assert params_equal(loaded2, mlp_bundle(seed=1))
+        loaded1, info1 = repo.load("mlp", version=1)
+        assert info1.version == 1
+        assert params_equal(loaded1, mlp_bundle(seed=0))
+        assert not params_equal(loaded1, loaded2)
+
+    def test_set_current_is_the_repo_side_rollback(self, tmp_path):
+        repo = ModelRepo(str(tmp_path))
+        repo.publish("mlp", mlp_bundle(seed=0))
+        repo.publish("mlp", mlp_bundle(seed=1))
+        repo.set_current("mlp", 1)
+        assert repo.current_version("mlp") == 1
+        _, info = repo.load("mlp")
+        assert info.version == 1
+        with pytest.raises(VersionNotFound):
+            repo.set_current("mlp", 9)
+
+    def test_dark_publish_keeps_current(self, tmp_path):
+        repo = ModelRepo(str(tmp_path))
+        repo.publish("mlp", mlp_bundle(seed=0))
+        repo.publish("mlp", mlp_bundle(seed=1), set_current=False)
+        assert repo.versions("mlp") == [1, 2]
+        assert repo.current_version("mlp") == 1  # dark until promoted
+
+    def test_stage_artifacts_roundtrip(self, tmp_path):
+        from mmlspark_tpu.stages.image import ImageTransformer
+        repo = ModelRepo(str(tmp_path))
+        v = repo.publish("resize", ImageTransformer().resize(8, 8))
+        model, info = repo.load("resize", v)
+        assert info.kind == "stage"
+        assert hasattr(model, "transform")
+
+    def test_listing_and_missing(self, tmp_path):
+        repo = ModelRepo(str(tmp_path))
+        assert repo.models() == []
+        with pytest.raises(VersionNotFound):
+            repo.current_version("nope")
+        repo.publish("a", mlp_bundle())
+        repo.publish("b", mlp_bundle())
+        assert repo.models() == ["a", "b"]
+        assert repo.describe()["a"] == {"versions": [1], "current": 1}
+        with pytest.raises(VersionNotFound):
+            repo.load("a", version=7)
+
+    def test_prune_keeps_current(self, tmp_path):
+        repo = ModelRepo(str(tmp_path))
+        for s in range(4):
+            repo.publish("mlp", mlp_bundle(seed=s))
+        repo.set_current("mlp", 1)
+        doomed = repo.prune("mlp", keep=2)
+        assert doomed == [2]  # v1 is CURRENT, v3/v4 the newest two
+        assert repo.versions("mlp") == [1, 3, 4]
+        assert repo.current_version("mlp") == 1
+
+
+class TestIntegrity:
+    def test_torn_publish_leaves_prior_version_live(self, tmp_path):
+        repo = ModelRepo(str(tmp_path))
+        repo.publish("mlp", mlp_bundle(seed=0))
+        plan = FaultPlan([FaultSpec("repo_torn_publish", model="mlp")])
+        with faults.inject(plan):
+            with pytest.raises(InjectedFault):
+                repo.publish("mlp", mlp_bundle(seed=1))
+        # the torn publish is invisible: no v2, CURRENT untouched, no
+        # staging litter, and the next publish takes the same number
+        assert repo.versions("mlp") == [1]
+        assert repo.current_version("mlp") == 1
+        assert not [d for d in os.listdir(tmp_path / "mlp")
+                    if d.startswith(".staging")]
+        _, info = repo.load("mlp")
+        assert info.version == 1
+        assert repo.publish("mlp", mlp_bundle(seed=1)) == 2
+
+    def test_digest_mismatch_is_typed_and_scoped(self, tmp_path):
+        repo = ModelRepo(str(tmp_path))
+        repo.publish("mlp", mlp_bundle(seed=0))
+        v2 = repo.publish("mlp", mlp_bundle(seed=1))
+        bundle_path = os.path.join(repo._version_dir("mlp", v2),
+                                   BUNDLE_FILE)
+        with open(bundle_path, "r+b") as f:
+            f.seek(100)
+            b = f.read(1)
+            f.seek(100)
+            f.write(bytes([b[0] ^ 0xFF]))
+        with pytest.raises(RepoCorruptError) as ei:
+            repo.load("mlp", v2)
+        assert ei.value.version == 2
+        assert "digest mismatch" in str(ei.value)
+        # the corruption is scoped to v2: v1 still verifies and loads
+        loaded, info = repo.load("mlp", 1)
+        assert info.version == 1
+        assert params_equal(loaded, mlp_bundle(seed=0))
+
+    def test_missing_manifest_and_missing_file(self, tmp_path):
+        repo = ModelRepo(str(tmp_path))
+        v = repo.publish("mlp", mlp_bundle(seed=0))
+        vdir = repo._version_dir("mlp", v)
+        os.rename(os.path.join(vdir, VERSION_MANIFEST),
+                  os.path.join(vdir, VERSION_MANIFEST + ".bak"))
+        with pytest.raises(RepoCorruptError, match="manifest missing"):
+            repo.verify("mlp", v)
+        os.rename(os.path.join(vdir, VERSION_MANIFEST + ".bak"),
+                  os.path.join(vdir, VERSION_MANIFEST))
+        os.remove(os.path.join(vdir, BUNDLE_FILE))
+        with pytest.raises(RepoCorruptError, match="missing file"):
+            repo.load("mlp", v)
+
+    def test_stale_current_pointer_falls_back(self, tmp_path):
+        repo = ModelRepo(str(tmp_path))
+        repo.publish("mlp", mlp_bundle(seed=0))
+        repo.publish("mlp", mlp_bundle(seed=1))
+        with open(tmp_path / "mlp" / "CURRENT", "w") as f:
+            f.write("42")  # pruned/never-existed version
+        assert repo.current_version("mlp") == 2
+
+
+class TestFaultPlanDeterminism:
+    def test_same_plan_same_seed_fires_identically(self):
+        def run():
+            plan = FaultPlan(
+                [FaultSpec("dispatch_raise", after=1, times=2),
+                 FaultSpec("dispatch_raise", prob=0.5, times=100)],
+                seed=7)
+            fired = []
+            for k in range(12):
+                try:
+                    plan.fire("dispatch_raise", "m", 0)
+                    fired.append(("ok", k))
+                except InjectedFault:
+                    fired.append(("fault", k))
+            return fired, plan.counts()
+
+        a, ca = run()
+        b, cb = run()
+        assert a == b
+        assert ca == cb
+        assert ca.get("dispatch_raise", 0) >= 2
+
+    def test_scope_matching(self):
+        plan = FaultPlan([FaultSpec("lane_death", model="m", lane=1)])
+        plan.fire("lane_death", "other", 1)   # wrong model: no fault
+        plan.fire("lane_death", "m", 0)       # wrong lane: no fault
+        with pytest.raises(InjectedFault):
+            plan.fire("lane_death", "m", 1)
+        plan.fire("lane_death", "m", 1)       # times=1: spent
+        assert [f[3] for f in plan.fired] == ["raise"]
+
+    def test_delay_spec_sleeps_instead_of_raising(self):
+        plan = FaultPlan([FaultSpec("dispatch_slow", delay_s=0.01)])
+        plan.fire("dispatch_slow", "m", 0)    # no raise
+        assert plan.fired[0][3] == "delay"

@@ -14,8 +14,9 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
 
 from perf_smoke import (  # noqa: E402
     check_fused_crossings, check_flight_recorder, check_obs_overhead,
-    check_obs_request_tracing, check_serve_batching, check_serve_lowprec,
-    check_serve_sharded, check_spmd_clean, check_train_device_preprocess,
+    check_obs_request_tracing, check_serve_batching,
+    check_serve_lifecycle, check_serve_lowprec, check_serve_sharded,
+    check_spmd_clean, check_train_device_preprocess,
     check_train_elastic, check_train_prefetch,
 )
 
@@ -142,6 +143,32 @@ def test_serve_lowprec_parity_programs_and_audit():
     assert result["weight_bytes_ratio"] <= 0.35
     assert result["audit_findings"] == 0
     assert result["audit_collectives"] == 0
+
+
+def test_serve_lifecycle_survives_seeded_chaos():
+    """Zero-downtime lifecycle (round 13): under the seeded fault plan
+    a lane kill mid-burst self-heals (1 death, 1 restart, work
+    requeued, every response delivered and bit-identical to the stable
+    offline transform), a hot-swap mid-burst answers from both versions
+    with nothing dropped, the induced canary fast-burn auto-rolls back
+    through the pure PromotionPolicy with the decision journaled, and
+    compiled programs stay on the ladder per (model, version)."""
+    result = check_serve_lifecycle()
+    lane = result["lane_kill"]
+    assert lane["responses"] == 32
+    assert lane["lane_deaths"] == 1 and lane["lane_restarts"] == 1
+    assert lane["faults_fired"] == {"lane_death": 1}
+    swap = result["hot_swap"]
+    assert swap["served_v1"] > 0 and swap["served_v2"] >= 4
+    assert swap["served_v1"] + swap["served_v2"] == swap["responses"]
+    for key in ("programs_v1", "programs_v2"):
+        programs = (lane if key == "programs_v1" else swap)[key]
+        assert programs is None or programs <= len(result["buckets"])
+    canary = result["canary"]
+    assert canary["burn_short"] >= 14.0
+    assert "rollback" in canary["decision_kinds"]
+    assert "swap" in canary["decision_kinds"]
+    assert "lane_restart" in canary["decision_kinds"]
 
 
 def test_serve_dp_replica_fanout_multiplies_throughput():

@@ -22,6 +22,7 @@ import pytest
 import jax
 
 from mmlspark_tpu.core.pipeline import PipelineModel
+from mmlspark_tpu.core.retry import RetryPolicy
 from mmlspark_tpu.core.schema import make_image
 from mmlspark_tpu.core.stage import LambdaTransformer
 from mmlspark_tpu.data.table import DataTable
@@ -500,6 +501,171 @@ class TestHTTP:
                 pa.ipc.open_stream(io.BytesIO(resp.read())).read_all()
                 .combine_chunks().to_batches()[0])
         assert "scores" in out and len(out) == 2
+
+
+class TestRetryAfterHeader:
+    """errors.py tells clients to "retry with backoff"; the HTTP front
+    must give them something to act on — the Retry-After header, on
+    both backpressure paths (429 Overloaded, drain-time 503)."""
+
+    def test_429_overloaded_carries_retry_after(self):
+        from mmlspark_tpu.serve.http import start_http_server
+        server = ModelServer(ServeConfig(buckets=(1,), max_queue=1,
+                                         max_inflight=1, warmup=False,
+                                         retry_after_s=2.5))
+        httpd = start_http_server(server, host="127.0.0.1", port=0)
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            server.add_model("slow", sleepy_model(1.0))
+            # saturate the pipeline: lane in-flight + scheduler-held +
+            # the 1-deep queue = 3 accepted; while the first batch
+            # sleeps, the queue slot stays occupied and the HTTP submit
+            # must see 429
+            handles = []
+            deadline = time.monotonic() + 5
+            while len(handles) < 3 and time.monotonic() < deadline:
+                try:
+                    handles.append(server.submit(
+                        "slow", vector_table(np.arange(1.0))))
+                except Overloaded:
+                    time.sleep(0.01)
+            assert len(handles) == 3, "pipeline never saturated"
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _post_json(f"{base}/v1/models/slow:predict",
+                           {"rows": [{"x": 0.0}]})
+            assert exc.value.code == 429
+            # whole seconds, rounded UP from retry_after_s=2.5
+            assert exc.value.headers["Retry-After"] == "3"
+            for h in handles:
+                h.result(timeout=30)
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            server.close()
+
+    def test_drain_time_healthz_503_carries_retry_after(
+            self, http_mlp_server):
+        server, base = http_mlp_server
+        with urllib.request.urlopen(f"{base}/healthz") as r:
+            assert r.status == 200
+            assert r.headers.get("Retry-After") is None  # ready: none
+        server.close(drain=True)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"{base}/healthz")
+        assert exc.value.code == 503
+        assert exc.value.headers["Retry-After"] == "1"  # the default
+        body = json.loads(exc.value.read())
+        assert body["draining"] is True
+
+
+class _Resolved:
+    def __init__(self, table):
+        self._table = table
+
+    def result(self, timeout=None):
+        return self._table
+
+
+class _ScriptedServer:
+    """Submit/predict fail `failures` times with `exc`, then succeed —
+    the deterministic client-retry surface (no timing, no threads)."""
+
+    def __init__(self, failures, exc):
+        self.failures = failures
+        self.exc = exc
+        self.calls = 0
+
+    def predict(self, model, rows, deadline_ms=None, timeout=None):
+        self.calls += 1
+        if self.calls <= self.failures:
+            raise self.exc
+        return rows
+
+    def submit(self, model, rows, deadline_ms=None):
+        self.calls += 1
+        if self.calls <= self.failures:
+            raise self.exc
+        return _Resolved(rows)
+
+
+class TestClientRetry:
+    """Client.predict/predict_async retry= (core/retry.py): transient
+    serving faults only — never DeadlineExceeded/BadRequest."""
+
+    FAST = RetryPolicy(max_attempts=3, base_delay_s=0.0, jitter=0.0,
+                       retry_on=(Overloaded,))
+
+    def test_retried_to_success(self):
+        from mmlspark_tpu.serve.errors import LaneFailed
+        for exc in (Overloaded("m", 8, 8), LaneFailed("m", 0, "died")):
+            stub = _ScriptedServer(2, exc)
+            out = Client(stub).predict("m", vector_table(np.arange(1.0)),
+                                       retry=True)
+            assert stub.calls == 3 and len(out) == 1
+
+    def test_budget_exhausted_raises_the_real_error(self):
+        stub = _ScriptedServer(5, Overloaded("m", 8, 8))
+        with pytest.raises(Overloaded):
+            Client(stub).predict("m", vector_table(np.arange(1.0)),
+                                 retry=self.FAST)
+        assert stub.calls == 3  # max_attempts, then the typed error
+
+    def test_non_retryable_passthrough(self):
+        for exc in (BadRequest("nope"),
+                    DeadlineExceeded("m", 100.0, "queued"),
+                    ModelNotFound("m", [])):
+            stub = _ScriptedServer(5, exc)
+            with pytest.raises(type(exc)):
+                Client(stub).predict("m", vector_table(np.arange(1.0)),
+                                     retry=True)
+            assert stub.calls == 1, f"{type(exc).__name__} was retried"
+
+    def test_never_retry_wins_over_a_broad_caller_policy(self):
+        from mmlspark_tpu.serve.errors import ServeError
+        broad = RetryPolicy(max_attempts=5, base_delay_s=0.0, jitter=0.0,
+                            retry_on=(ServeError,))
+        stub = _ScriptedServer(5, DeadlineExceeded("m", 100.0, "queued"))
+        with pytest.raises(DeadlineExceeded):
+            Client(stub).predict("m", vector_table(np.arange(1.0)),
+                                 retry=broad)
+        assert stub.calls == 1
+        # ...while genuinely transient faults DO use the broad budget
+        stub = _ScriptedServer(4, Overloaded("m", 8, 8))
+        out = Client(stub).predict("m", vector_table(np.arange(1.0)),
+                                   retry=broad)
+        assert stub.calls == 5 and len(out) == 1
+
+    def test_predict_async_retries_submission_only(self):
+        stub = _ScriptedServer(2, Overloaded("m", 8, 8))
+        handle = Client(stub).predict_async(
+            "m", vector_table(np.arange(1.0)), retry=True)
+        assert stub.calls == 3
+        assert len(handle.result()) == 1
+
+    def test_default_off_and_client_wide_default(self):
+        stub = _ScriptedServer(1, Overloaded("m", 8, 8))
+        with pytest.raises(Overloaded):
+            Client(stub).predict("m", vector_table(np.arange(1.0)))
+        stub = _ScriptedServer(1, Overloaded("m", 8, 8))
+        client = Client(stub, retry=self.FAST)  # client-wide default
+        out = client.predict("m", vector_table(np.arange(1.0)))
+        assert stub.calls == 2 and len(out) == 1
+
+    def test_retry_against_a_real_overloaded_server(self):
+        """End-to-end: a 1-deep queue under a slow model rejects, the
+        retrying client eventually lands every request."""
+        model = sleepy_model(0.05)
+        with ModelServer(ServeConfig(buckets=(1,), max_queue=1,
+                                     warmup=False)) as server:
+            server.add_model("slow", model)
+            client = Client(server, retry=RetryPolicy(
+                max_attempts=8, base_delay_s=0.05, max_delay_s=0.4,
+                jitter=0.0, retry_on=(Overloaded,)))
+            outs = []
+            for _ in range(4):
+                outs.append(client.predict(
+                    "slow", vector_table(np.arange(1.0)), timeout=30))
+            assert all(len(o) == 1 for o in outs)
 
 
 class TestHealthAndSLOSurfaces:

@@ -1,0 +1,666 @@
+"""Zero-downtime model lifecycle + self-healing serve plane:
+
+* hot-swap — a version flip under live traffic drops nothing, and every
+  answer is bit-identical to the offline transform of WHICHEVER version
+  served it;
+* shadow/canary routing — a deterministic fraction of admissions is
+  mirrored (shadow: stable answers, outputs diffed) or split (canary:
+  candidate answers);
+* SLO-driven promotion — the pure PromotionPolicy rolls back on canary
+  fast-burn / parity drift and promotes after consecutive clean
+  windows, every decision journaled;
+* lane self-healing — an injected non-request exception killing a lane
+  worker (the motivating stranded-queue bug) requeues undispatched
+  work, fails in-flight typed, restarts the lane, and degrades health
+  while capacity is down;
+* versioned-repo serving — a torn or corrupt version is refused typed
+  while the prior version keeps serving.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from mmlspark_tpu.core.retry import RetryPolicy
+from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.models import ModelBundle, ModelRepo, RepoCorruptError
+from mmlspark_tpu.models.jax_model import JaxModel
+from mmlspark_tpu.models.zoo import MLP
+from mmlspark_tpu.core.stage import LambdaTransformer
+from mmlspark_tpu.serve import (
+    CanarySignal, Client, FaultPlan, FaultSpec, Hold, LaneFailed,
+    ModelServer, Promote, PromotionLedger, PromotionPolicy, Rollback,
+    ServeConfig, THREAD_PREFIX, faults,
+)
+
+IN_DIM = 6
+
+
+def mlp_bundle(seed=0):
+    module = MLP(features=(8,), num_outputs=4)
+    params = module.init(jax.random.PRNGKey(seed),
+                         np.zeros((1, IN_DIM), np.float32))["params"]
+    return ModelBundle(
+        module=module,
+        params=jax.tree_util.tree_map(np.asarray, params),
+        input_spec=(IN_DIM,),
+        output_names=("features", "logits"),
+        name="mlp")
+
+
+def jax_model(seed=0):
+    return JaxModel(model=mlp_bundle(seed), input_col="x",
+                    output_col="s")
+
+
+def vec_table(rows):
+    return DataTable({"x": list(rows)})
+
+
+def rows_of(n, seed=0):
+    return np.random.default_rng(seed).normal(
+        size=(n, IN_DIM)).astype(np.float32)
+
+
+def scores(table):
+    return np.stack([np.asarray(v) for v in table["s"]])
+
+
+def failing_model(out_col="s"):
+    """Host-path model that fails every non-empty transform (the
+    analyzer's 0-row probe passes) — the canary-burn inducer."""
+    def fn(table):
+        if len(table) == 0:
+            return table.with_column(out_col, np.asarray([], object))
+        raise RuntimeError("canary model is broken")
+    return LambdaTransformer(fn=fn)
+
+
+def serve_threads():
+    return [t.name for t in threading.enumerate()
+            if t.name.startswith(THREAD_PREFIX)]
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    yield
+    faults.clear()
+
+
+# ---- hot swap ----
+
+
+class TestHotSwap:
+    def test_swap_under_traffic_zero_dropped_outputs_pinned(self):
+        rows = rows_of(24)
+        jm1, jm2 = jax_model(seed=0), jax_model(seed=1)
+        off1 = scores(jm1.transform(vec_table(rows)))
+        off2 = scores(jm2.transform(vec_table(rows)))
+        assert not np.array_equal(off1, off2)
+
+        server = ModelServer(ServeConfig(buckets=(1, 4), max_queue=256))
+        server.add_model("m", jm1, example=vec_table(rows[:1]),
+                         version=1)
+        results: list[tuple] = []
+        errors: list[BaseException] = []
+        lock = threading.Lock()
+
+        def worker(k):
+            try:
+                for i in range(12):
+                    off = (k * 12 + i) % 22
+                    out = server.predict("m", vec_table(rows[off:off + 2]),
+                                         timeout=60)
+                    with lock:
+                        results.append((off, scores(out)))
+                    time.sleep(0.01)  # keep traffic alive across the swap
+            except BaseException as e:  # noqa: BLE001 — reported
+                with lock:
+                    errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(k,))
+                   for k in range(4)]
+        for t in threads:
+            t.start()
+        # the hot-swap, mid-burst: v2 loads + warms while v1 serves
+        time.sleep(0.02)
+        server.add_model("m", jm2, example=vec_table(rows[:1]),
+                         version=2)
+        for t in threads:
+            t.join()
+        try:
+            assert errors == []          # zero dropped requests
+            assert len(results) == 48
+            v1_served = v2_served = 0
+            for off, got in results:
+                if np.array_equal(got, off1[off:off + 2]):
+                    v1_served += 1
+                elif np.array_equal(got, off2[off:off + 2]):
+                    v2_served += 1
+                else:
+                    raise AssertionError(
+                        "served output matches NEITHER version's "
+                        "offline transform bit-for-bit")
+            assert v1_served + v2_served == 48
+            assert v2_served > 0         # the flip actually happened
+            # post-swap requests are v2, and the journal knows
+            out = scores(server.predict("m", vec_table(rows[:2])))
+            assert np.array_equal(out, off2[:2])
+            swaps = server.lifecycle_decisions("swap")
+            assert len(swaps) == 1
+            assert swaps[0]["from_version"] == 1
+            assert swaps[0]["to_version"] == 2
+            assert server.snapshot()["m"]["version"] == 2
+        finally:
+            server.close()
+        assert serve_threads() == []
+
+    def test_swap_supersedes_inflight_canary(self):
+        server = ModelServer(ServeConfig(buckets=(1, 4), max_queue=32))
+        try:
+            server.add_model("m", jax_model(0), version=1,
+                             example=vec_table(rows_of(1)))
+            server.deploy_canary("m", jax_model(1), mode="shadow",
+                                 fraction=1.0, version=2,
+                                 example=vec_table(rows_of(1)))
+            assert server.canary_status("m")["version"] == 2
+            server.add_model("m", jax_model(2), version=3,
+                             example=vec_table(rows_of(1)))
+            assert server.canary_status("m") is None
+            swap = server.lifecycle_decisions("swap")[0]
+            assert swap["canary_superseded"] is True
+        finally:
+            server.close()
+        assert serve_threads() == []
+
+
+# ---- canary / shadow routing ----
+
+
+class TestCanaryRouting:
+    def test_canary_split_is_deterministic_and_answers_from_canary(self):
+        rows = rows_of(16)
+        jm1, jm2 = jax_model(seed=0), jax_model(seed=1)
+        off1 = scores(jm1.transform(vec_table(rows)))
+        off2 = scores(jm2.transform(vec_table(rows)))
+        server = ModelServer(ServeConfig(buckets=(1, 4), max_queue=64))
+        try:
+            server.add_model("m", jm1, example=vec_table(rows[:1]),
+                             version=1)
+            server.deploy_canary("m", jm2, mode="canary", fraction=0.5,
+                                 version=2,
+                                 example=vec_table(rows[:1]))
+            served = []
+            for i in range(8):
+                out = scores(server.predict("m",
+                                            vec_table(rows[i:i + 1])))
+                if np.array_equal(out, off2[i:i + 1]):
+                    served.append("canary")
+                else:
+                    assert np.array_equal(out, off1[i:i + 1])
+                    served.append("stable")
+            # Bresenham at 0.5: strict alternation, stable first
+            assert served == ["stable", "canary"] * 4
+        finally:
+            server.close()
+        assert serve_threads() == []
+
+    def test_shadow_mirrors_never_change_stable_answers(self):
+        rows = rows_of(12)
+        jm1, jm2 = jax_model(seed=0), jax_model(seed=1)
+        off1 = scores(jm1.transform(vec_table(rows)))
+        server = ModelServer(ServeConfig(buckets=(1, 4), max_queue=64))
+        try:
+            server.add_model("m", jm1, example=vec_table(rows[:1]),
+                             version=1)
+            server.deploy_canary("m", jm2, mode="shadow", fraction=1.0,
+                                 version=2,
+                                 example=vec_table(rows[:1]))
+            for i in range(0, 12, 2):
+                out = scores(server.predict("m",
+                                            vec_table(rows[i:i + 2])))
+                assert np.array_equal(out, off1[i:i + 2])
+            deadline = time.monotonic() + 10
+            status = server.canary_status("m")
+            while time.monotonic() < deadline:
+                server.lifecycle_tick("m")
+                status = server.canary_status("m")
+                if status and status["pairs_compared"] >= 6:
+                    break
+                time.sleep(0.02)
+            assert status["pairs_compared"] >= 6
+            # two different seeds: the mirrored outputs REALLY differ
+            assert status["parity_max"] > 1e-3
+            snap = server.snapshot()["m"]
+            assert snap["canary"]["mode"] == "shadow"
+            assert snap["canary"]["stats_admitted"] >= 6
+        finally:
+            server.close()
+        assert serve_threads() == []
+
+    def test_bad_fraction_and_mode_are_typed(self):
+        server = ModelServer(ServeConfig(buckets=(1,), max_queue=8))
+        try:
+            server.add_model("m", jax_model(0),
+                             example=vec_table(rows_of(1)))
+            with pytest.raises(ValueError, match="fraction"):
+                server.deploy_canary("m", jax_model(1), fraction=0.0,
+                                     example=vec_table(rows_of(1)))
+            with pytest.raises(ValueError, match="mode"):
+                server.deploy_canary("m", jax_model(1), mode="blue",
+                                     example=vec_table(rows_of(1)))
+            assert server.canary_status("m") is None
+        finally:
+            server.close()
+        assert serve_threads() == []
+
+
+# ---- the pure promotion policy ----
+
+
+class TestPromotionPolicy:
+    POLICY = PromotionPolicy(fast_burn=14.0, slow_burn=2.0,
+                             promote_after=3)
+
+    def test_fast_burn_rolls_back(self):
+        act = self.POLICY.decide(
+            CanarySignal(burn_short=20.0, terminal_window=50),
+            PromotionLedger())
+        assert isinstance(act, Rollback)
+        assert "fast-burn" in act.reason
+
+    def test_parity_drift_rolls_back_even_with_clean_burn(self):
+        act = self.POLICY.decide(
+            CanarySignal(burn_short=0.0, parity_drift=0.5,
+                         parity_tolerance=0.1),
+            PromotionLedger(clean_windows=10))
+        assert isinstance(act, Rollback)
+        assert "parity" in act.reason
+
+    def test_no_traffic_holds_without_banking(self):
+        act = self.POLICY.decide(CanarySignal(), PromotionLedger())
+        assert isinstance(act, Hold) and not act.clean
+
+    def test_long_burn_holds_and_resets(self):
+        act = self.POLICY.decide(
+            CanarySignal(burn_short=0.5, burn_long=3.0),
+            PromotionLedger(clean_windows=2))
+        assert isinstance(act, Hold) and not act.clean
+
+    def test_clean_windows_bank_to_promotion(self):
+        ledger = PromotionLedger()
+        sig = CanarySignal(burn_short=0.1, burn_long=0.1,
+                           terminal_window=40)
+        for expected_clean in (1, 2):
+            act = self.POLICY.decide(sig, ledger)
+            assert isinstance(act, Hold) and act.clean
+            ledger.clean_windows = expected_clean
+        act = self.POLICY.decide(sig, ledger)
+        assert isinstance(act, Promote)
+
+    def test_policy_validates(self):
+        with pytest.raises(ValueError):
+            PromotionPolicy(promote_after=0)
+
+
+# ---- the closed loop: burn -> rollback, clean -> promote ----
+
+
+class TestAutoRollbackAndPromote:
+    SLO = {"objective": 0.99, "min_requests": 4, "window_s": 30.0,
+           "long_window_s": 60.0}
+
+    def test_canary_fast_burn_auto_rolls_back(self, tmp_path):
+        rows = rows_of(12)
+        jm1 = jax_model(seed=0)
+        off1 = scores(jm1.transform(vec_table(rows)))
+        server = ModelServer(ServeConfig(
+            buckets=(1, 4), max_queue=64, slo=self.SLO,
+            lifecycle_dir=str(tmp_path)))
+        try:
+            server.add_model("m", jm1, example=vec_table(rows[:1]),
+                             version=1)
+            server.deploy_canary("m", failing_model(), mode="shadow",
+                                 fraction=1.0, version=2)
+            first = server.lifecycle_tick("m")
+            assert first["action"] == "hold"  # no canary traffic yet
+            for i in range(8):
+                out = scores(server.predict(
+                    "m", vec_table(rows[i:i + 1]), timeout=30))
+                assert np.array_equal(out, off1[i:i + 1])
+            # let the mirrors reach terminal state AND the tick step
+            # past the burn ring's coalescing resolution (a tick inside
+            # the same step would fold into the pre-traffic baseline)
+            time.sleep(0.1)
+            deadline = time.monotonic() + 10
+            decision = None
+            while time.monotonic() < deadline:
+                decision = server.lifecycle_tick("m")
+                if decision is None or decision["action"] == "rollback":
+                    break
+                time.sleep(0.05)
+            assert decision is not None
+            assert decision["action"] == "rollback"
+            assert decision["burn_short"] >= 14.0
+            assert server.canary_status("m") is None
+            # stable untouched, decisions on disk
+            out = scores(server.predict("m", vec_table(rows[:2])))
+            assert np.array_equal(out, off1[:2])
+            kinds = [e["kind"] for e in server.lifecycle_decisions()]
+            assert "canary_deploy" in kinds and "rollback" in kinds
+            with open(tmp_path / "decisions.jsonl") as f:
+                lines = f.read().strip().splitlines()
+            import json
+            assert any(json.loads(ln)["kind"] == "rollback"
+                       for ln in lines)
+        finally:
+            server.close()
+        assert serve_threads() == []
+
+    def test_clean_canary_promotes_and_takes_the_name(self):
+        rows = rows_of(12)
+        jm1, jm2 = jax_model(seed=0), jax_model(seed=1)
+        off2 = scores(jm2.transform(vec_table(rows)))
+        server = ModelServer(ServeConfig(
+            buckets=(1, 4), max_queue=64, slo=self.SLO))
+        try:
+            server.add_model("m", jm1, example=vec_table(rows[:1]),
+                             version=1)
+            server.deploy_canary("m", jm2, mode="canary", fraction=1.0,
+                                 version=2, promote_after=2,
+                                 example=vec_table(rows[:1]))
+            deadline = time.monotonic() + 15
+            decision = None
+            while time.monotonic() < deadline:
+                for i in range(6):
+                    server.predict("m", vec_table(rows[i:i + 1]),
+                                   timeout=30)
+                decision = server.lifecycle_tick("m")
+                if decision is None or decision["action"] == "promote":
+                    break
+                time.sleep(0.05)
+            assert decision is not None
+            assert decision["action"] == "promote"
+            assert server.canary_status("m") is None
+            assert server.snapshot()["m"]["version"] == 2
+            out = scores(server.predict("m", vec_table(rows[:2])))
+            assert np.array_equal(out, off2[:2])
+            kinds = [e["kind"] for e in server.lifecycle_decisions()]
+            assert "promote" in kinds
+        finally:
+            server.close()
+        assert serve_threads() == []
+
+    def test_slo_polling_drives_the_rollout(self):
+        """An HTTP-only operator never calls lifecycle_tick: polling
+        /slo (= slo_snapshot) must itself advance the rollout loop, so
+        a burning canary rolls back with no in-process caller."""
+        rows = rows_of(8)
+        server = ModelServer(ServeConfig(
+            buckets=(1, 4), max_queue=64, slo=self.SLO))
+        try:
+            server.add_model("m", jax_model(0), version=1,
+                             example=vec_table(rows[:1]))
+            server.deploy_canary("m", failing_model(), mode="shadow",
+                                 fraction=1.0, version=2)
+            server.slo_snapshot()  # banks the pre-traffic baseline
+            for i in range(8):
+                server.predict("m", vec_table(rows[i:i + 1]), timeout=30)
+            time.sleep(0.1)
+            deadline = time.monotonic() + 10
+            rolled = False
+            while time.monotonic() < deadline and not rolled:
+                body = server.slo_snapshot()["m"]
+                decision = body.get("lifecycle")
+                rolled = (decision or {}).get("action") == "rollback" \
+                    or server.canary_status("m") is None
+                time.sleep(0.05)
+            assert rolled
+            assert server.canary_status("m") is None
+            kinds = [e["kind"] for e in server.lifecycle_decisions()]
+            assert "rollback" in kinds
+        finally:
+            server.close()
+        assert serve_threads() == []
+
+    def test_manual_rollback(self):
+        server = ModelServer(ServeConfig(buckets=(1,), max_queue=8))
+        try:
+            server.add_model("m", jax_model(0), version=1,
+                             example=vec_table(rows_of(1)))
+            server.deploy_canary("m", jax_model(1), version=2,
+                                 example=vec_table(rows_of(1)))
+            out = server.rollback("m", reason="operator said so")
+            assert out["action"] == "rollback"
+            assert server.canary_status("m") is None
+            assert server.rollback("m") is None  # idempotent-ish
+        finally:
+            server.close()
+        assert serve_threads() == []
+
+
+# ---- lane self-healing (the motivating regression) ----
+
+
+class TestLaneSelfHealing:
+    def test_lane_death_requeues_restarts_and_answers_everything(self):
+        """Regression for the motivating bug: a lane worker killed by a
+        non-request exception previously stranded its queued requests
+        past their deadlines — no reject, no health change, capacity
+        silently gone. Now: requeued, restarted, counted."""
+        rows = rows_of(12)
+        jm = jax_model(seed=0)
+        offline = scores(jm.transform(vec_table(rows)))
+        server = ModelServer(ServeConfig(buckets=(1, 4), max_queue=64))
+        try:
+            server.add_model("m", jm, example=vec_table(rows[:1]))
+            plan = FaultPlan([FaultSpec("lane_death", model="m")])
+            with faults.inject(plan):
+                handles = [server.submit("m", vec_table(rows[i:i + 2]))
+                           for i in range(0, 12, 2)]
+                outs = [h.result(timeout=30) for h in handles]
+            assert plan.counts().get("lane_death") == 1
+            for k, out in enumerate(outs):
+                assert np.array_equal(scores(out),
+                                      offline[2 * k:2 * k + 2])
+            snap = server.snapshot()["m"]
+            assert snap["lane_deaths"] == 1
+            assert snap["lane_restarts"] == 1
+            assert snap["completed"] == 6
+            assert snap["lane_health"]["alive"] == \
+                snap["lane_health"]["lanes"] == 1
+            kinds = [e["kind"] for e in server.lifecycle_decisions()]
+            assert "lane_death" in kinds and "lane_restart" in kinds
+        finally:
+            server.close()
+        assert serve_threads() == []
+
+    def test_lane_death_with_survivors_requeues_onto_them(self):
+        if len(jax.devices()) < 2:
+            pytest.skip("needs >= 2 devices for dp=2")
+        rows = rows_of(16)
+        jm = jax_model(seed=0)
+        offline = scores(jm.transform(vec_table(rows)))
+        server = ModelServer(ServeConfig(buckets=(1, 4), max_queue=64,
+                                         mesh="dp=2"))
+        try:
+            server.add_model("m", jm, example=vec_table(rows[:1]))
+            plan = FaultPlan([FaultSpec("lane_death", model="m",
+                                        lane=0)])
+            with faults.inject(plan):
+                handles = [server.submit("m", vec_table(rows[i:i + 2]))
+                           for i in range(0, 16, 2)]
+                outs = [h.result(timeout=30) for h in handles]
+            for k, out in enumerate(outs):
+                assert np.array_equal(scores(out),
+                                      offline[2 * k:2 * k + 2])
+            # the survivor answered the requeued work immediately; the
+            # replacement lane arrives after the restart backoff
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if server.stats("m").lane_restarts == 1:
+                    break
+                time.sleep(0.02)
+            snap = server.snapshot()["m"]
+            assert snap["lane_deaths"] == 1
+            assert snap["lane_restarts"] == 1
+            assert snap["lane_health"]["alive"] == 2
+        finally:
+            server.close()
+        assert serve_threads() == []
+
+    def test_inflight_batch_fails_typed_lane_failed(self):
+        """A batch already dispatched when its lane dies loses its
+        result with the worker: typed, retryable LaneFailed — never a
+        silent hang, never a speculative resolve."""
+        rows = rows_of(4)
+        jm = jax_model(seed=0)
+        server = ModelServer(ServeConfig(buckets=(1, 2), max_queue=64,
+                                         max_inflight=2))
+        try:
+            server.add_model("m", jm, example=vec_table(rows[:1]))
+            # two layout-INcompatible requests (1 row vs 2 rows in one
+            # batch slot -> different bucket shapes is not enough; the
+            # compat key differs on row-count layout of object cells) —
+            # force two separate batches via distinct column layouts
+            plan = FaultPlan([FaultSpec("lane_death", model="m",
+                                        after=1)])
+            with faults.inject(plan):
+                # batch 1 dispatches (enters the async window), batch 2
+                # is the in-hand item when the fault fires
+                a = server.submit("m", vec_table(rows[:1]))
+                time.sleep(0.15)  # let batch 1 reach the window
+                b = server.submit("m", vec_table(rows[1:3]))
+                got_a = None
+                try:
+                    got_a = a.result(timeout=30)
+                except LaneFailed:
+                    pass
+                out_b = b.result(timeout=30)
+            # b was undispatched at death: requeued, answered correctly
+            offline = scores(jm.transform(vec_table(rows)))
+            assert np.array_equal(scores(out_b), offline[1:3])
+            if got_a is not None:
+                # the race where batch 1 drained before the fault —
+                # then nothing was in flight and a is simply correct
+                assert np.array_equal(scores(got_a), offline[:1])
+            snap = server.snapshot()["m"]
+            assert snap["lane_deaths"] == 1
+            assert snap["lane_restarts"] == 1
+        finally:
+            server.close()
+        assert serve_threads() == []
+
+    def test_exhausted_restart_budget_degrades_health(self):
+        from mmlspark_tpu.obs.health import DEGRADED
+        rows = rows_of(4)
+        server = ModelServer(ServeConfig(
+            buckets=(1, 2), max_queue=64,
+            lane_restart=RetryPolicy(max_attempts=1, jitter=0.0)))
+        try:
+            server.add_model("m", jax_model(0),
+                             example=vec_table(rows[:1]))
+            plan = FaultPlan([FaultSpec("lane_death", model="m")])
+            with faults.inject(plan):
+                h = server.submit("m", vec_table(rows[:2]))
+                with pytest.raises(LaneFailed):
+                    h.result(timeout=30)
+            snap = server.snapshot()["m"]
+            assert snap["lane_deaths"] == 1
+            assert snap["lane_restarts"] == 0
+            assert snap["lane_health"]["alive"] == 0
+            health = server.health()
+            verdict = health["model_health"]["m"]
+            assert verdict["state"] == DEGRADED
+            assert "lane(s) down" in verdict["reason"]
+            kinds = [e["kind"] for e in server.lifecycle_decisions()]
+            assert "lane_down" in kinds
+        finally:
+            server.close()
+        assert serve_threads() == []
+
+    def test_dispatch_raise_fault_is_relayed_per_request(self):
+        from mmlspark_tpu.serve.faults import InjectedFault
+        rows = rows_of(2)
+        server = ModelServer(ServeConfig(buckets=(1, 2), max_queue=16))
+        try:
+            server.add_model("m", jax_model(0),
+                             example=vec_table(rows[:1]))
+            plan = FaultPlan([FaultSpec("dispatch_raise", model="m")])
+            with faults.inject(plan):
+                h = server.submit("m", vec_table(rows))
+                with pytest.raises(InjectedFault):
+                    h.result(timeout=30)
+            # a dispatch-time raise fails the batch, not the lane
+            snap = server.snapshot()["m"]
+            assert snap["failed"] == 1
+            assert snap["lane_deaths"] == 0
+            out = server.predict("m", vec_table(rows))  # lane fine
+            assert len(out) == 2
+        finally:
+            server.close()
+        assert serve_threads() == []
+
+
+# ---- versioned-repo serving ----
+
+
+class TestRepoServing:
+    def test_serve_current_and_pinned_versions(self, tmp_path):
+        repo = ModelRepo(str(tmp_path))
+        repo.publish("m", mlp_bundle(seed=0))
+        repo.publish("m", mlp_bundle(seed=1))
+        server = ModelServer(ServeConfig(buckets=(1, 4), max_queue=16))
+        try:
+            info = server.add_model_from_repo(repo, "m")
+            assert info.version == 2
+            assert server.snapshot()["m"]["version"] == 2
+            info = server.add_model_from_repo(repo, "m", version=1)
+            assert info.version == 1
+            assert server.snapshot()["m"]["version"] == 1
+        finally:
+            server.close()
+        assert serve_threads() == []
+
+    def test_corrupt_version_refused_prior_keeps_serving(self, tmp_path):
+        """Satellite: torn-publish recovery. A version directory whose
+        digests don't match its manifest is refused with a typed error
+        and NO partial load reaches the batcher — the server keeps
+        serving the version it already has."""
+        import os
+        from mmlspark_tpu.models.repo import BUNDLE_FILE
+        repo = ModelRepo(str(tmp_path))
+        repo.publish("m", mlp_bundle(seed=0))
+        rows = rows_of(4)
+        # a repo-served bundle is wrapped reading column "input" and
+        # writing "scores" (the CLI's bundle contract)
+        table = DataTable({"input": list(rows)})
+        ref_model = JaxModel(model=mlp_bundle(seed=0), input_col="input",
+                             output_col="scores")
+        off1 = np.stack([np.asarray(v) for v in
+                         ref_model.transform(table)["scores"]])
+        server = ModelServer(ServeConfig(buckets=(1, 4), max_queue=16))
+        try:
+            server.add_model_from_repo(repo, "m")
+            v2 = repo.publish("m", mlp_bundle(seed=1))
+            bundle_path = os.path.join(repo._version_dir("m", v2),
+                                       BUNDLE_FILE)
+            with open(bundle_path, "r+b") as f:
+                f.seek(64)
+                byte = f.read(1)
+                f.seek(64)
+                f.write(bytes([byte[0] ^ 0xFF]))
+            with pytest.raises(RepoCorruptError):
+                server.add_model_from_repo(repo, "m")
+            # the swap never happened: v1 still serving, bit-identical
+            assert server.snapshot()["m"]["version"] == 1
+            out = server.predict("m", table)
+            got = np.stack([np.asarray(v) for v in out["scores"]])
+            assert np.array_equal(got, off1)
+        finally:
+            server.close()
+        assert serve_threads() == []

@@ -56,6 +56,18 @@ flake on a loaded CI box):
   ship ≤ 0.35× the f32 param bytes, record a real load-time calibration
   parity, and have its QUANTIZED segment verify clean (zero manual
   collectives) under ``audit_plan_spmd``.
+* **serve lifecycle (zero-downtime + self-healing)** — under a SEEDED
+  fault plan (``serve/faults.py``: count-deterministic triggers, so the
+  chaos replays): a lane worker killed mid-burst by an injected
+  non-request exception self-heals (undispatched batches requeued,
+  in-flight failed typed-retryable, lane restarted under backoff) with
+  zero dropped or duplicated responses; a hot-swap mid-burst flips the
+  model version with every answer bit-identical to some version's
+  offline transform and the new version provably taking traffic; an
+  induced canary fast-burn auto-rolls back via the pure
+  ``PromotionPolicy`` with the decision journaled to
+  ``decisions.jsonl``; compiled programs stay ≤ ``len(buckets)`` per
+  (model, version).
 * **obs disabled-path overhead** — the observability seams threaded
   through the fused pipeline (docs/observability.md) must cost < 2% of
   the microbench when the tracer is off. Gated on a measured analytic
@@ -811,6 +823,243 @@ def check_serve_sharded(min_speedup: float = 2.5) -> dict:
     }
 
 
+def check_serve_lifecycle() -> dict:
+    """Zero-downtime model lifecycle under a seeded fault plan: a lane
+    kill mid-burst self-heals (requeue + restart, nothing dropped), a
+    hot-swap mid-burst flips versions with every answer bit-identical
+    to SOME version's offline transform, an induced canary fast-burn
+    auto-rolls back with the decision journaled, and compiled programs
+    stay ≤ len(buckets) per (model, version). All triggers are
+    count-deterministic (serve/faults.py) — the chaos replays."""
+    import tempfile
+    import threading
+    import time
+
+    import jax
+
+    from mmlspark_tpu.core.retry import RetryPolicy
+    from mmlspark_tpu.core.stage import LambdaTransformer
+    from mmlspark_tpu.data.table import DataTable
+    from mmlspark_tpu.models.bundle import ModelBundle
+    from mmlspark_tpu.models.jax_model import JaxModel
+    from mmlspark_tpu.models.repo import ModelRepo
+    from mmlspark_tpu.models.zoo import MLP
+    from mmlspark_tpu.serve import (
+        Client, FaultPlan, FaultSpec, ModelServer, ServeConfig,
+        THREAD_PREFIX, faults,
+    )
+
+    buckets, d_in, n_rows = (1, 4, 8), 6, 24
+
+    def bundle(seed):
+        module = MLP(features=(8,), num_outputs=4)
+        params = module.init(jax.random.PRNGKey(seed),
+                             np.zeros((1, d_in), np.float32))["params"]
+        return ModelBundle(
+            module=module,
+            params=jax.tree_util.tree_map(np.asarray, params),
+            input_spec=(d_in,), output_names=("features", "logits"),
+            name="m")
+
+    def tbl(sl):
+        return DataTable({"x": list(sl)})
+
+    def sc(out):
+        return np.stack([np.asarray(v) for v in out["s"]])
+
+    rows = np.random.default_rng(0).normal(
+        size=(n_rows, d_in)).astype(np.float32)
+    workdir = tempfile.mkdtemp(prefix="serve_lifecycle_")
+
+    # the versioned repo is the artifact source: digests verify on load
+    repo = ModelRepo(os.path.join(workdir, "repo"))
+    v1 = repo.publish("m", bundle(seed=0))
+    v2 = repo.publish("m", bundle(seed=1))
+    jm1 = JaxModel(model=repo.load("m", v1)[0], input_col="x",
+                   output_col="s")
+    jm2 = JaxModel(model=repo.load("m", v2)[0], input_col="x",
+                   output_col="s")
+    off1 = sc(jm1.transform(tbl(rows)))
+    off2 = sc(jm2.transform(tbl(rows)))
+    assert not np.array_equal(off1, off2)
+
+    def burning_canary():
+        def fn(table):
+            if len(table) == 0:
+                return table.with_column("s", np.asarray([], object))
+            raise RuntimeError("induced canary failure")
+        return LambdaTransformer(fn=fn)
+
+    server = ModelServer(ServeConfig(
+        buckets=buckets, max_queue=512, lifecycle_dir=workdir,
+        slo={"objective": 0.99, "min_requests": 4, "window_s": 30.0,
+             "long_window_s": 60.0},
+        lane_restart=RetryPolicy(max_attempts=4, base_delay_s=0.02,
+                                 max_delay_s=0.1, jitter=0.0)))
+    result: dict = {"buckets": list(buckets)}
+    try:
+        server.add_model("m", jm1, example=tbl(rows[:1]), version=v1)
+
+        def burst(pace_s=0.0):
+            """4 client threads × 8 two-row requests; returns
+            [(offset, scores)] — every response, exactly one per
+            request (the zero-dropped/zero-duplicated observable)."""
+            client = Client(server, retry=True)  # LaneFailed retries
+            results, errors = [], []
+            lock = threading.Lock()
+
+            def worker(k):
+                try:
+                    for i in range(8):
+                        off = ((k * 8 + i) * 2) % (n_rows - 2)
+                        out = client.predict(
+                            "m", tbl(rows[off:off + 2]), timeout=60)
+                        with lock:
+                            results.append((off, sc(out)))
+                        if pace_s:
+                            time.sleep(pace_s)
+                except BaseException as e:  # noqa: BLE001 — reported
+                    with lock:
+                        errors.append(f"{type(e).__name__}: {e}")
+
+            threads = [threading.Thread(target=worker, args=(k,))
+                       for k in range(4)]
+            for t in threads:
+                t.start()
+            return threads, results, errors
+
+        # -- phase 1: seeded lane kill mid-burst ----------------------
+        plan = FaultPlan([FaultSpec("lane_death", model="m", after=2)],
+                         seed=42)
+        with faults.inject(plan):
+            threads, results, errors = burst()
+            for t in threads:
+                t.join()
+        assert errors == [], f"lane-kill burst dropped requests: {errors}"
+        assert len(results) == 32
+        for off, got in results:
+            assert np.array_equal(got, off1[off:off + 2]), (
+                "a response during lane self-healing was not "
+                "bit-identical to the stable version's offline transform")
+        snap1 = server.snapshot()["m"]
+        assert snap1["lane_deaths"] == 1, snap1["lane_deaths"]
+        assert snap1["lane_restarts"] == 1
+        assert snap1["lane_health"]["alive"] == 1
+        programs_v1 = server.compiled_programs("m")
+        if programs_v1 is not None:
+            assert programs_v1 <= len(buckets)
+        result["lane_kill"] = {
+            "responses": len(results),
+            "lane_deaths": snap1["lane_deaths"],
+            "lane_restarts": snap1["lane_restarts"],
+            "requeued_batches": snap1["requeued_batches"],
+            "faults_fired": plan.counts(),
+            "programs_v1": programs_v1,
+        }
+
+        # -- phase 2: hot-swap mid-burst ------------------------------
+        # traffic provably SPANS the flip: workers keep submitting
+        # until the swap completes, then a few more — so both versions
+        # answer requests in one burst, deterministically
+        flipped = threading.Event()
+        results, errors = [], []
+        lock = threading.Lock()
+        client = Client(server, retry=True)
+
+        def swap_worker(k):
+            try:
+                done_after = i = 0
+                while done_after < 3 and i < 500:
+                    off = ((k * 8 + i) * 2) % (n_rows - 2)
+                    out = client.predict("m", tbl(rows[off:off + 2]),
+                                         timeout=60)
+                    with lock:
+                        results.append((off, sc(out)))
+                    if flipped.is_set():
+                        done_after += 1
+                    i += 1
+            except BaseException as e:  # noqa: BLE001 — reported
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=swap_worker, args=(k,))
+                   for k in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.02)
+        server.add_model("m", jm2, example=tbl(rows[:1]), version=v2)
+        flipped.set()
+        for t in threads:
+            t.join()
+        assert errors == [], f"swap burst dropped requests: {errors}"
+        v1_served = v2_served = 0
+        for off, got in results:
+            if np.array_equal(got, off1[off:off + 2]):
+                v1_served += 1
+            elif np.array_equal(got, off2[off:off + 2]):
+                v2_served += 1
+            else:
+                raise AssertionError(
+                    "a response through the hot-swap matches NEITHER "
+                    "version's offline transform bit-for-bit")
+        assert v2_served >= 4, (
+            f"only {v2_served} answers from v2 after the flip — the "
+            "swap is not taking traffic")
+        post = sc(server.predict("m", tbl(rows[:2])))
+        assert np.array_equal(post, off2[:2]), "post-swap not on v2"
+        swaps = server.lifecycle_decisions("swap")
+        assert len(swaps) == 1 and swaps[0]["to_version"] == v2
+        programs_v2 = server.compiled_programs("m")
+        if programs_v2 is not None:
+            assert programs_v2 <= len(buckets)
+        result["hot_swap"] = {
+            "responses": len(results),
+            "served_v1": v1_served, "served_v2": v2_served,
+            "programs_v2": programs_v2,
+        }
+
+        # -- phase 3: induced canary fast-burn → auto-rollback --------
+        server.deploy_canary("m", burning_canary(), mode="shadow",
+                             fraction=1.0, version=v2 + 1)
+        first = server.lifecycle_tick("m")
+        assert first["action"] == "hold"
+        for i in range(8):
+            out = sc(server.predict("m", tbl(rows[i:i + 1]), timeout=30))
+            assert np.array_equal(out, off2[i:i + 1]), (
+                "a stable answer changed while the canary burned")
+        time.sleep(0.1)  # past the burn ring's coalescing resolution
+        deadline = time.monotonic() + 10
+        decision = None
+        while time.monotonic() < deadline:
+            decision = server.lifecycle_tick("m")
+            if decision is None or decision["action"] == "rollback":
+                break
+            time.sleep(0.05)
+        assert decision is not None and decision["action"] == "rollback", (
+            f"canary fast-burn did not auto-roll back: {decision}")
+        assert decision["burn_short"] >= 14.0
+        assert server.canary_status("m") is None
+        post = sc(server.predict("m", tbl(rows[:2])))
+        assert np.array_equal(post, off2[:2]), "stable lost after rollback"
+        with open(os.path.join(workdir, "decisions.jsonl")) as f:
+            journaled = [json.loads(ln) for ln in f if ln.strip()]
+        kinds = [e["kind"] for e in journaled]
+        for expected in ("lane_death", "lane_restart", "swap",
+                         "canary_deploy", "rollback"):
+            assert expected in kinds, f"{expected!r} not journaled"
+        result["canary"] = {
+            "burn_short": decision["burn_short"],
+            "ticks": decision["ticks"],
+            "decision_kinds": sorted(set(kinds)),
+        }
+    finally:
+        server.close()
+    leaked = [t.name for t in threading.enumerate()
+              if t.name.startswith(THREAD_PREFIX)]
+    assert leaked == [], f"serve threads leaked: {leaked}"
+    return result
+
+
 def check_serve_lowprec(tolerance: float = 6e-2) -> dict:
     """Serve a model int8w+bf16 (weight-only int8, bf16 activations —
     core/precision.py); raise AssertionError unless its outputs stay
@@ -1382,6 +1631,7 @@ def main() -> int:
         serve = check_serve_batching()
         serve_sharded = check_serve_sharded()
         serve_lowprec = check_serve_lowprec()
+        serve_lifecycle = check_serve_lifecycle()
         obs_overhead = check_obs_overhead()
         obs_tracing = check_obs_request_tracing()
         flight_rec = check_flight_recorder()
@@ -1396,6 +1646,7 @@ def main() -> int:
                       "serve": serve,
                       "serve_sharded": serve_sharded,
                       "serve_lowprec": serve_lowprec,
+                      "serve_lifecycle": serve_lifecycle,
                       "obs_overhead": obs_overhead,
                       "obs_request_tracing": obs_tracing,
                       "flight_recorder": flight_rec, "spmd": spmd}))

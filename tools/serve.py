@@ -14,7 +14,13 @@ Usage::
 * a single ``ModelBundle`` file (``tools/build_model_repo.py`` output) —
   wrapped in a ``JaxModel`` reading column ``input``, writing ``scores``;
 * a model *repository* directory (``MANIFEST.json`` inside) — every
-  manifest entry is loaded and served under its manifest name.
+  manifest entry is loaded and served under its manifest name;
+* with ``--repo``: a **versioned** model repository
+  (``models/repo.py`` layout — per-version dirs with sha256 manifests
+  and a ``CURRENT`` pointer): every model's current version is
+  digest-verified and served, tagged with its version (per-version
+  stats/SLO series, swap decisions journaled under
+  ``ServeConfig.lifecycle_dir``). See docs/serving.md §model lifecycle.
 
 Every model is validated by the pre-flight analyzer at load time (the
 load fails fast — exit 2 with the diagnostics — before any device work),
@@ -47,6 +53,24 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _load_versioned_repo(path: str, name: str | None
+                         ) -> list[tuple[str, object, int]]:
+    """[(serve name, model, version), ...] from a VERSIONED model repo
+    (models/repo.py layout): every model's CURRENT version, digest-
+    verified before deserialization — a torn or corrupt version is a
+    typed refusal at startup, never a silently-wrong served model."""
+    from mmlspark_tpu.models.repo import ModelRepo
+    repo = ModelRepo(path)
+    names = [name] if name else repo.models()
+    if not names:
+        raise SystemExit(f"{path}: no published models in the repo")
+    out = []
+    for n in names:
+        model, info = repo.load(n)
+        out.append((n, model, info.version))
+    return out
 
 
 def _load_models(path: str, name: str | None) -> list[tuple[str, object]]:
@@ -82,7 +106,17 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("model", help="saved stage dir, bundle file, or "
                                   "model-repo dir")
     ap.add_argument("--name", default=None,
-                    help="serve name (default: dir/bundle name)")
+                    help="serve name (default: dir/bundle name); with "
+                         "--repo, serve only this model from the repo")
+    ap.add_argument("--repo", action="store_true",
+                    help="treat <model-path> as a VERSIONED model repo "
+                         "(models/repo.py: per-version dirs with sha256 "
+                         "manifests + a CURRENT pointer): serve every "
+                         "model's current version, digest-verified at "
+                         "load. Publish a new version + re-run (or use "
+                         "the deploy_canary/add_model APIs in-process) "
+                         "to roll forward; see docs/serving.md §model "
+                         "lifecycle")
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=8000)
     ap.add_argument("--buckets", default="1,8,32,128",
@@ -180,9 +214,23 @@ def main(argv: list[str] | None = None) -> int:
         slo=slo,
         precision=precision)
     server = ModelServer(config)
+    versions = None
     try:
-        for model_name, model in _load_models(args.model, args.name):
-            server.add_model(model_name, model, schema=schema)
+        if args.repo:
+            from mmlspark_tpu.models.repo import ModelRepoError
+            try:
+                loaded = _load_versioned_repo(args.model, args.name)
+            except ModelRepoError as e:
+                print(str(e), file=sys.stderr)
+                return 2
+            versions = {}
+            for model_name, model, version in loaded:
+                server.add_model(model_name, model, schema=schema,
+                                 version=version)
+                versions[model_name] = version
+        else:
+            for model_name, model in _load_models(args.model, args.name):
+                server.add_model(model_name, model, schema=schema)
     except ModelLoadError as e:
         print(str(e), file=sys.stderr)
         return 2
@@ -191,6 +239,7 @@ def main(argv: list[str] | None = None) -> int:
                               background=False)
     print(json.dumps({
         "serving": server.models(),
+        "versions": versions,
         "host": httpd.server_address[0],
         "port": httpd.server_address[1],
         "buckets": list(config.buckets),
