@@ -5,6 +5,12 @@ Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 The reference publishes no performance numbers (BASELINE.md), so
 ``vs_baseline`` is reported against the driver-defined north star:
 achieved MFU / 0.60 target MFU on the CIFAR-10 CNN featurize+train path.
+
+``python bench.py --check`` additionally runs the perf-regression
+sentinel (tools/bench_check.py) over this line vs the archived
+``BENCH_r*.json`` trajectory after the obs archiving step: the verdict
+lands in the JSON line (``bench_check_verdict``) and a regression exits
+2 with the named report on stderr.
 """
 
 from __future__ import annotations
@@ -549,7 +555,7 @@ def bench_serve_sharded(jm, rng, n_total: int = 192,
     return out
 
 
-def main() -> None:
+def main() -> int:
     import jax
 
     from mmlspark_tpu.models.zoo import ConvNetCifar
@@ -994,7 +1000,7 @@ def main() -> None:
         except OSError:
             obs_archive = None
 
-    print(json.dumps({
+    line = {
         "metric": METRIC_NAME,
         "value": round(images_per_s_per_chip, 1),
         "unit": METRIC_UNIT,
@@ -1056,7 +1062,30 @@ def main() -> None:
         "obs_counters": (obs_snapshot["counters"]
                          if obs_snapshot else None),
         **extra,
-    }))
+    }
+
+    # --check: the perf-regression sentinel (tools/bench_check.py) runs
+    # over this line vs the archived BENCH_r*.json trajectory AFTER the
+    # obs archiving above, and its verdict rides IN the JSON line so the
+    # trajectory itself records whether each round was regression-free
+    rc = 0
+    import sys
+    if "--check" in sys.argv:
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import bench_check
+        repo = os.path.dirname(os.path.abspath(__file__))
+        report = bench_check.check_line(line,
+                                        bench_check.load_rounds(repo))
+        line["bench_check_verdict"] = report["verdict"]
+        line["bench_check_regressions"] = [
+            r["key"] for r in report["regressions"]]
+        if report["verdict"] == "regressed":
+            rc = 2
+            print(bench_check.format_report(report), file=sys.stderr)
+
+    print(json.dumps(line))
+    return rc
 
 
 def _main_guarded() -> None:
@@ -1064,7 +1093,7 @@ def _main_guarded() -> None:
     or tunnel failure mid-bench must degrade to an error-labeled record,
     not an empty capture."""
     try:
-        main()
+        rc = main()
     except BaseException as e:  # noqa: BLE001 — last-resort driver record
         print(json.dumps({
             "metric": METRIC_NAME,
@@ -1072,6 +1101,8 @@ def _main_guarded() -> None:
             "error": f"{type(e).__name__}: {e}",
         }))
         raise
+    if rc:
+        raise SystemExit(rc)
 
 
 if __name__ == "__main__":

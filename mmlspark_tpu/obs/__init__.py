@@ -44,6 +44,16 @@ timeline:
 * :mod:`~mmlspark_tpu.obs.anomaly` — the **train anomaly plane**:
   non-finite loss sentinel (typed :class:`NonFiniteLossError`) and
   multi-host straggler detection (``train.host_skew``).
+* :mod:`~mmlspark_tpu.obs.fleet` — the **fleet telemetry plane**:
+  per-process atomic snapshot export (``MMLSPARK_TPU_FLEET=<dir>``),
+  cross-process registry merge (counters summed bit-exactly, gauges
+  per host), and the clock-aligned fleet Perfetto timeline stitched
+  at the fenced-collective seams.
+* :mod:`~mmlspark_tpu.obs.timeseries` — **metric history**: a periodic
+  sampler persisting the SLO/autoscale gauges into a bounded ring +
+  append-only JSONL with a small query API (``range``/``rate``/
+  ``last``) — the trend signals the adaptive ladder and autoscalers
+  need.
 
 Everything is CPU-safe and jax-free at import time. See
 docs/observability.md for the architecture and the instrumented seams.
@@ -73,7 +83,9 @@ from mmlspark_tpu.obs.health import (  # noqa: F401
 )
 from mmlspark_tpu.obs import anomaly  # noqa: F401
 from mmlspark_tpu.obs import device  # noqa: F401
+from mmlspark_tpu.obs import fleet  # noqa: F401
 from mmlspark_tpu.obs import flight  # noqa: F401
+from mmlspark_tpu.obs import timeseries  # noqa: F401
 from mmlspark_tpu.obs.anomaly import (  # noqa: F401
     NonFiniteLossError, NonFiniteSentinel, StragglerDetector,
 )
@@ -110,6 +122,7 @@ __all__ = [
     "enable",
     "enabled",
     "event",
+    "fleet",
     "flight",
     "metrics_snapshot",
     "mint",
@@ -119,6 +132,7 @@ __all__ = [
     "request_traces",
     "span",
     "spans",
+    "timeseries",
     "write_chrome_trace",
     "write_snapshot",
 ]

@@ -208,6 +208,68 @@ def summarize_spans(records: list | None = None,
 
 _PROM_NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
 
+# registry-series name → HELP text. Keyed by the ORIGINAL (dotted)
+# name; anything not listed falls back to a generic line, so every
+# family always carries a HELP/TYPE pair (some scrapers and linters —
+# promtool check metrics — warn on HELP-less families). Keep entries
+# one-line: the exposition format ends HELP at the newline.
+METRIC_HELP: dict[str, str] = {
+    "plan.h2d_uploads": "Host-to-device uploads issued by the device "
+                        "plan executor (one per fused-segment entry).",
+    "plan.h2d_bytes": "Bytes shipped host-to-device at the plan's "
+                      "upload seam.",
+    "plan.d2h_fetches": "Async device-to-host fetch rounds issued by "
+                        "the plan executor.",
+    "plan.d2h_bytes": "Bytes fetched device-to-host at the plan's "
+                      "fetch seam.",
+    "plan.segment_compiles": "Fresh XLA compilations observed at the "
+                             "plan dispatch seam.",
+    "serve.queue_depth": "Live admission-queue depth (the replica "
+                         "autoscaling signal).",
+    "serve.slo_burn_short": "Error-budget burn multiple over the SLO's "
+                            "short window (fast-burn page signal).",
+    "serve.slo_burn_long": "Error-budget burn multiple over the SLO's "
+                           "long window (sustained degradation).",
+    "serve.slo_budget_remaining": "Fraction of the SLO error budget "
+                                  "remaining (lifetime).",
+    "serve.occupancy_mean_window": "Mean batch occupancy over the SLO "
+                                   "sample window (adaptive-ladder "
+                                   "signal).",
+    "serve.replica_skew": "DP replica load imbalance: (max-min)/max "
+                          "over per-replica batch counts.",
+    "train.steps": "Optimizer steps completed by the training loop.",
+    "train.step_ms": "Per-step dispatch time of the training loop.",
+    "train.host_step_ms": "Per-host mean step time from the fenced "
+                          "liveness exchange (straggler sensor).",
+    "train.host_skew": "Max/median host step-time skew across the "
+                       "training fleet.",
+    "train.slow_steps": "Steps flagged slower than factor x the "
+                        "rolling median.",
+    "train.fleet.workers": "Live supervised workers reporting a "
+                           "current-generation beacon.",
+    "train.fleet.progress": "Summed progress (heartbeats + steps) "
+                            "across the supervised fleet.",
+    "train.fleet.straggler_windows": "Global straggler verdict windows "
+                                     "this generation (max across "
+                                     "beacons).",
+    "train.fleet.host_step_ms": "Per-host step time as aggregated by "
+                                "the supervisor from worker beacons.",
+    "flight.dumps": "Post-mortem dumps written by the flight recorder.",
+    "obs.traces_dropped": "Request traces evicted by the retention "
+                          "policy.",
+}
+
+
+def _prom_help(original_name: str) -> str:
+    text = METRIC_HELP.get(original_name)
+    if text is None:
+        # generic fallback: every family gets SOME help line, and the
+        # original dotted spelling survives sanitization for operators
+        # grepping the codebase
+        text = f"mmlspark_tpu metric {original_name} (see " \
+               "docs/observability.md)."
+    return text.replace("\\", r"\\").replace("\n", r"\n")
+
 
 def _prom_name(name: str) -> str:
     """Registry series name → a legal Prometheus metric name (dots and
@@ -252,33 +314,38 @@ def prometheus_text(registries: list[MetricsRegistry] | None = None) -> str:
 
     Counters/gauges map directly; histograms expose as summaries —
     ``name{quantile="0.5|0.95|0.99"}`` over the bounded window plus the
-    exact lifetime ``name_count``/``name_sum``. A ``# TYPE`` header is
-    emitted once per metric name across all registries (per-model serve
-    registries contribute the same names under different labels), and
-    unset gauges are skipped (Prometheus has no null). Series within a
-    name are emitted in sorted order so consecutive scrapes of the same
-    state are byte-identical."""
+    exact lifetime ``name_count``/``name_sum``. ONE ``# HELP``/``# TYPE``
+    header pair is emitted per metric name across all registries
+    (per-model serve registries — and the fleet-merged per-host
+    registries — contribute the same names under different labels;
+    repeating a header per registry is an exposition-format violation
+    scrapers reject). HELP text comes from :data:`METRIC_HELP` with a
+    generic fallback, so every family is self-describing. Unset gauges
+    are skipped (Prometheus has no null). Series within a name are
+    emitted in sorted order so consecutive scrapes of the same state
+    are byte-identical."""
     if registries is None:
         registries = [registry()]
-    # name -> (type string, [(sorted label text, sample lines)])
-    by_name: dict[str, tuple[str, list]] = {}
+    # prom name -> [type string, [(series text, value)], original name]
+    by_name: dict[str, list] = {}
 
-    def _add(name: str, kind: str, lines: list[tuple[str, str]]) -> None:
-        slot = by_name.setdefault(name, (kind, []))
+    def _add(name: str, original: str, kind: str,
+             lines: list[tuple[str, str]]) -> None:
+        slot = by_name.setdefault(name, [kind, [], original])
         slot[1].extend(lines)
 
     for reg in registries:
         for m in reg.iter_metrics():
             name = _prom_name(m.name)
             if isinstance(m, Counter):
-                _add(name, "counter",
+                _add(name, m.name, "counter",
                      [(f"{name}{_prom_labels(m.labels)}",
                        _prom_value(m.value))])
             elif isinstance(m, Gauge):
                 v = m.value
                 if v is None:
                     continue
-                _add(name, "gauge",
+                _add(name, m.name, "gauge",
                      [(f"{name}{_prom_labels(m.labels)}",
                        _prom_value(v))])
             elif isinstance(m, Histogram):
@@ -295,10 +362,11 @@ def prometheus_text(registries: list[MetricsRegistry] | None = None) -> str:
                               _prom_value(m.count)))
                 lines.append((f"{name}_sum{_prom_labels(m.labels)}",
                               _prom_value(m.sum)))
-                _add(name, "summary", lines)
+                _add(name, m.name, "summary", lines)
     chunks: list[str] = []
     for name in sorted(by_name):
-        kind, lines = by_name[name]
+        kind, lines, original = by_name[name]
+        chunks.append(f"# HELP {name} {_prom_help(original)}")
         chunks.append(f"# TYPE {name} {kind}")
         chunks.extend(f"{series} {value}" for series, value
                       in sorted(lines))

@@ -1,0 +1,861 @@
+"""Fleet telemetry plane — cross-process aggregation and clock-aligned
+fleet timelines.
+
+Every observability surface before this module is single-process: the
+registry, the span ring, the flight recorder, the SLO tracker all live
+and die inside one interpreter. A supervised training job (PR 11) spans
+N worker processes; a sharded serve fleet (PR 7/13) spans replica lanes
+across hosts — and the signals ROADMAP's actuators want (adaptive
+ladder, supervisor policy, burn-driven autoscaling) are FLEET signals.
+This module is the Dapper/Monarch step: per-process registries and span
+rings become one merged, queryable, clock-aligned plane, with a shared
+filesystem as the transport (the same contract as checkpoints and the
+service beacons — workers and collectors share no memory).
+
+* :class:`TelemetryExporter` — each process writes **atomic delta
+  snapshots** of its metric registries + the span-ring tail to
+  ``<fleet_dir>/proc_<host>_<pid>/snap_NNNNNN.json`` on a watchdog-like
+  cadence and at exit/crash (temp file + ``os.replace``; bounded
+  retention). Counters are cumulative, so the newest snapshot per
+  process is the registry truth and retention loses nothing; the ring
+  tail is the delta part (the collector dedups by span id). Every
+  snapshot carries a paired ``(time.time, perf_counter_ns)`` **stamp**
+  so a collector can place perf-clock span timestamps on the wall
+  clock, per process.
+* :class:`FleetCollector` — merges the snapshots into **fleet
+  registries**: counters summed across processes (bit-equal to the sum
+  of the per-process registries), gauges kept per process under
+  ``host=``/``pid=`` labels (last-written per host wins within one
+  process), windowed histograms merged (windows concatenated,
+  lifetime count/sum summed). Its :meth:`FleetView.chrome_trace`
+  renders one Perfetto timeline for the whole fleet: one process group
+  per host, timestamps **skew-aligned** (stamp pairs put each process
+  on its own wall clock; the fenced-collective seams — the train
+  liveness allgather's ``train/liveness_sync`` span and the serve
+  lockstep ``serve/lockstep_agree`` span, which END at the same real
+  instant on every participating process — correct residual wall-clock
+  skew between hosts), and **cross-process flows stitched** at those
+  fence seams so the barrier structure draws as arrows across process
+  groups.
+
+Enable with ``MMLSPARK_TPU_FLEET=<dir>`` (read once at import through
+``core.config`` — the PR 9 env-sibling precedence: explicit
+``enable()``/``disable()`` calls override the env) or
+``obs.fleet.enable(dir)``. Enabling also starts a
+:mod:`~mmlspark_tpu.obs.timeseries` sampler persisting the SLO/
+autoscale gauges to ``<proc_dir>/timeseries.jsonl``. Disabled (the
+default) the only cost anywhere is one module-attribute check (the
+flight recorder's dump hook reads ``_exp``); there are no per-seam
+calls — the exporter drives itself.
+
+Surfaces: ``tools/fleet.py`` (status / metrics / trace / watch), the
+serve ``/fleet`` endpoint (JSON + Prometheus via the existing
+negotiation), and :class:`~mmlspark_tpu.train.service.TrainSupervisor`
+publishing ``train.fleet.*`` aggregates from the worker beacons. See
+docs/observability.md §fleet telemetry plane.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import re
+import socket
+import threading
+import time
+import weakref
+from typing import Any, Callable, Iterable
+
+import numpy as np
+
+from mmlspark_tpu.core import config
+from mmlspark_tpu.obs import runtime as _rt
+from mmlspark_tpu.obs.metrics import (
+    Counter, Gauge, Histogram, MetricsRegistry, registry as _registry,
+)
+
+FLEET_VERSION = 1
+DEFAULT_INTERVAL_S = 1.0
+DEFAULT_RING_TAIL = 4096
+DEFAULT_RETENTION = 8
+
+EXPORTER_THREAD = "FleetExporter"
+
+#: span names recorded at the fenced cross-process seams — every
+#: participating process exits the underlying collective at the same
+#: real instant, which is what makes these spans both the skew
+#: CORRECTION anchor and the cross-process flow STITCH points
+FENCE_SPAN_NAMES = ("train/liveness_sync", "serve/lockstep_agree")
+
+_PROC_DIR_RE = re.compile(r"^proc_(?P<host>.+)_(?P<pid>\d+)$")
+_SNAP_RE = re.compile(r"^snap_(?P<seq>\d{6})\.json$")
+
+
+# ---------------------------------------------------------------------------
+# registry sources — which registries a process exports (and the
+# timeseries sampler samples) beyond the process-wide default
+# ---------------------------------------------------------------------------
+
+# callables returning a list of MetricsRegistry; the serve ModelServer
+# registers its per-model stats registries here so fleet snapshots (and
+# the timeseries history) carry the serve.* series too. Bound methods
+# are held WEAKLY: a ModelServer abandoned without close() (e.g. after
+# a failed add_model) must not be pinned alive — and kept exporting its
+# dead series — by the module-global source list for the process
+# lifetime. Plain callables are held strongly (they own no big state).
+_sources: list = []  # weakref.WeakMethod | callable
+_sources_lock = threading.Lock()
+
+
+def _resolve_source(entry: Any) -> Callable[[], list] | None:
+    if isinstance(entry, weakref.WeakMethod):
+        return entry()  # None once the bound object was collected
+    return entry
+
+
+def add_registry_source(fn: Callable[[], list]) -> None:
+    """Register a callable returning extra :class:`MetricsRegistry`
+    instances to export/sample alongside the process-wide registry
+    (idempotent; bound methods are referenced weakly — see above)."""
+    with _sources_lock:
+        if any(_resolve_source(e) == fn for e in _sources):
+            return
+        try:
+            _sources.append(weakref.WeakMethod(fn))
+        except TypeError:  # not a bound method
+            _sources.append(fn)
+
+
+def remove_registry_source(fn: Callable[[], list]) -> None:
+    with _sources_lock:
+        _sources[:] = [e for e in _sources
+                       if _resolve_source(e) is not None
+                       and _resolve_source(e) != fn]
+
+
+def all_registries() -> list:
+    """The process-wide registry plus every registered source's
+    registries. Dead entries — a collected bound-method owner, or a
+    source that raises — are dropped/skipped, never fatal: telemetry
+    must not take down the process it reports on."""
+    regs = [_registry()]
+    fns = []
+    with _sources_lock:
+        live = []
+        for e in _sources:
+            f = _resolve_source(e)
+            if f is not None:
+                live.append(e)
+                fns.append(f)
+        _sources[:] = live
+    for fn in fns:
+        try:
+            regs.extend(fn())
+        except Exception:  # pragma: no cover - defensive
+            pass
+    return regs
+
+
+# ---------------------------------------------------------------------------
+# the snapshot format
+# ---------------------------------------------------------------------------
+
+
+def _dump_registries(regs: Iterable) -> list[dict]:
+    """Structured dump of every metric: ``{"kind", "name", "labels",
+    ...}`` rows (NOT the human ``name{k=v}`` snapshot keys — the
+    collector merges by (name, labels) and string keys would need
+    un-parsing). Histograms carry their raw WINDOW so fleet percentiles
+    can be computed over the merged windows, plus the exact lifetime
+    count/sum."""
+    rows: list[dict] = []
+    for reg in regs:
+        for m in reg.iter_metrics():
+            row: dict[str, Any] = {"name": m.name,
+                                   "labels": [list(kv) for kv in m.labels]}
+            if isinstance(m, Counter):
+                row["kind"] = "counter"
+                row["value"] = m.value
+            elif isinstance(m, Gauge):
+                v = m.value
+                if v is None:
+                    continue  # an unset gauge has no fleet value
+                row["kind"] = "gauge"
+                row["value"] = v
+            elif isinstance(m, Histogram):
+                row["kind"] = "histogram"
+                row["count"] = m.count
+                row["sum"] = m.sum
+                row["window"] = m.values()
+            else:  # pragma: no cover - unknown metric kind
+                continue
+            rows.append(row)
+    return rows
+
+
+def _scrub(obj: Any) -> Any:
+    """Non-finite floats → string names (same rule as flight dumps:
+    bare NaN/Infinity tokens are not valid JSON for strict consumers)."""
+    if isinstance(obj, float):
+        if obj != obj:
+            return "NaN"
+        if obj in (float("inf"), float("-inf")):
+            return "Infinity" if obj > 0 else "-Infinity"
+        return obj
+    if isinstance(obj, dict):
+        return {k: _scrub(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_scrub(v) for v in obj]
+    return obj
+
+
+class TelemetryExporter:
+    """One process's fleet publisher: periodic + final atomic snapshots
+    of its registries and span-ring tail into its own
+    ``proc_<host>_<pid>/`` directory."""
+
+    def __init__(self, fleet_dir: str, interval_s: float = DEFAULT_INTERVAL_S,
+                 ring_tail: int = DEFAULT_RING_TAIL,
+                 retention: int = DEFAULT_RETENTION,
+                 host: str | None = None):
+        self.fleet_dir = str(fleet_dir)
+        self.interval_s = float(interval_s)
+        self.ring_tail = int(ring_tail)
+        self.retention = max(int(retention), 1)
+        self.host = host or socket.gethostname()
+        self.pid = os.getpid()
+        self.proc_dir = os.path.join(
+            self.fleet_dir, f"proc_{self.host}_{self.pid}")
+        os.makedirs(self.proc_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        # resume seq past any snapshots already in the proc dir (a
+        # disable()/enable() cycle, or a reconfigure): restarting at 0
+        # would make the name-sorted retention sweep prune the FRESH
+        # snapshots while keeping the stale ones as "newest truth"
+        existing = [int(m.group("seq")) for m in
+                    (_SNAP_RE.match(n) for n in os.listdir(self.proc_dir))
+                    if m]
+        self._seq = max(existing, default=0)
+        self._closed = False
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run,
+                                        name=EXPORTER_THREAD, daemon=True)
+        self._thread.start()
+
+    # -- the snapshot --
+
+    def snapshot(self, reason: str = "interval",
+                 extra: dict | None = None) -> str | None:
+        """Write one snapshot; returns its path (None once closed or on
+        an unwritable directory — telemetry export never raises into
+        the process it observes). Concurrency-safe: the seq counter and
+        the retention sweep run under one lock, so the watchdog-cadence
+        thread and an explicit exit/crash snapshot never tear."""
+        with self._lock:
+            if self._closed and reason == "interval":
+                return None
+            self._seq += 1
+            seq = self._seq
+            payload: dict[str, Any] = {
+                "fleet": FLEET_VERSION,
+                "host": self.host,
+                "pid": self.pid,
+                "seq": seq,
+                "reason": reason,
+                # the paired clock stamp: wall and perf read back to
+                # back, so `wall_s * 1e9 - perf_ns` is this process's
+                # perf→wall offset (the skew model's per-process leg)
+                "stamp": {"wall_s": time.time(),
+                          "perf_ns": time.perf_counter_ns()},
+                "registry": _dump_registries(all_registries()),
+                "ring": [r.to_dict() for r in _rt.spans()[-self.ring_tail:]],
+            }
+            if extra:
+                payload["extra"] = extra
+            path = os.path.join(self.proc_dir, f"snap_{seq:06d}.json")
+            tmp = f"{path}.tmp-{self.pid}"
+            try:
+                with open(tmp, "w", encoding="utf-8") as fh:
+                    json.dump(_scrub(payload), fh)
+                os.replace(tmp, path)
+            except OSError:  # pragma: no cover - fleet dir vanished
+                return None
+            self._prune_locked()
+            return path
+
+    def _prune_locked(self) -> None:
+        """Bounded retention: keep the newest ``retention`` snapshots.
+        Counters/gauges lose nothing (the newest snapshot is cumulative
+        truth); only ring-tail history older than the retained window
+        ages out — the same bounded-forensics tradeoff as the flight
+        recorder's dump budget."""
+        try:
+            snaps = sorted(n for n in os.listdir(self.proc_dir)
+                           if _SNAP_RE.match(n))
+        except OSError:  # pragma: no cover - dir vanished
+            return
+        for name in snaps[:-self.retention]:
+            try:
+                os.remove(os.path.join(self.proc_dir, name))
+            except OSError:  # pragma: no cover - concurrent cleanup
+                pass
+
+    # -- lifecycle --
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.snapshot("interval")
+            except Exception:  # pragma: no cover - exporter never dies
+                pass
+
+    def close(self, reason: str = "exit") -> None:
+        """Stop the cadence thread (joined — no stray threads) and write
+        the final snapshot so a clean exit leaves current truth."""
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+        path = self.snapshot(reason)
+        with self._lock:
+            self._closed = True
+        return path
+
+
+# ---------------------------------------------------------------------------
+# module surface (one attribute `_exp` — the flight hook's only cost)
+# ---------------------------------------------------------------------------
+
+_exp: TelemetryExporter | None = None
+_atexit_installed = False
+
+
+def enable(fleet_dir: str | None = None,
+           **kwargs: Any) -> TelemetryExporter:
+    """Start the fleet exporter (idempotent for the same directory with
+    the same kwargs, like ``obs.flight.enable`` — an ensure-on call must
+    not reset the seq counter or churn the thread). Also enables the obs
+    tracer (the ring it exports is the span buffer) and starts a
+    :mod:`~mmlspark_tpu.obs.timeseries` sampler persisting the SLO/
+    autoscale gauge history to ``<proc_dir>/timeseries.jsonl`` on the
+    same cadence. ``kwargs`` forward to :class:`TelemetryExporter`
+    (``interval_s``, ``ring_tail``, ``retention``, ``host``)."""
+    global _exp, _atexit_installed
+    fleet_dir = fleet_dir or config.get("fleet") or "./fleet"
+    if _exp is not None:
+        if _exp.fleet_dir == str(fleet_dir) and (
+                not kwargs or kwargs == _exp._init_kwargs):
+            return _exp
+        disable()
+    if not _rt._enabled:  # keep a custom buffer_size if already enabled
+        _rt.enable()
+    exp = TelemetryExporter(fleet_dir, **kwargs)
+    exp._init_kwargs = dict(kwargs)
+    _exp = exp
+    if not _atexit_installed:
+        atexit.register(_atexit_close)
+        _atexit_installed = True
+    from mmlspark_tpu.obs import timeseries as _ts
+    _ts.enable(path=os.path.join(exp.proc_dir, "timeseries.jsonl"),
+               interval_s=exp.interval_s)
+    return exp
+
+
+def disable() -> None:
+    """Stop the exporter (writes its final exit snapshot) and the
+    timeseries sampler it started. Does NOT disable the obs tracer."""
+    global _exp
+    if _exp is not None:
+        _exp.close("exit")
+        _exp = None
+        from mmlspark_tpu.obs import timeseries as _ts
+        _ts.disable()
+
+
+def enabled() -> bool:
+    return _exp is not None
+
+
+def exporter() -> TelemetryExporter | None:
+    return _exp
+
+
+def fleet_dir() -> str | None:
+    """The active fleet directory: the live exporter's, else the
+    configured (``MMLSPARK_TPU_FLEET``/``config.set("fleet")``) one,
+    else None — what the serve ``/fleet`` endpoint and the CLI read."""
+    if _exp is not None:
+        return _exp.fleet_dir
+    d = config.get("fleet")
+    return str(d) if d else None
+
+
+def _atexit_close() -> None:  # pragma: no cover - interpreter exit
+    if _exp is not None:
+        try:
+            _exp.close("exit")
+        except Exception:
+            pass
+
+
+def on_flight_dump(reason: str, dump_path: str | None) -> str | None:
+    """The flight recorder's crash/hang/signal hook: AFTER its dump is
+    on disk, flush one fleet snapshot naming it — pinned order, so the
+    fleet plane's last word about a dead process both exists (the
+    watchdog-cadence snapshot may be a full interval stale at a crash)
+    and points at the richer local forensics. One attribute check when
+    the exporter is off."""
+    if _exp is None:
+        return None
+    return _exp.snapshot(reason=f"flight_{reason}",
+                         extra={"flight_dump": dump_path})
+
+
+# ---------------------------------------------------------------------------
+# the collector
+# ---------------------------------------------------------------------------
+
+
+class FleetReadError(Exception):
+    """A fleet directory is missing or holds no readable snapshots."""
+
+
+class ProcessTelemetry:
+    """Everything collected about one process: its newest registry dump,
+    its deduped ring records, and its clock stamp."""
+
+    __slots__ = ("name", "host", "pid", "seq", "reason", "stamp",
+                 "registry_rows", "records", "skew_ms")
+
+    def __init__(self, name: str, host: str, pid: int):
+        self.name = name
+        self.host = host
+        self.pid = pid
+        self.seq = 0
+        self.reason = ""
+        self.stamp: dict | None = None
+        self.registry_rows: list[dict] = []
+        self.records: list[dict] = []
+        self.skew_ms: float = 0.0  # fence-seam correction, filled in merge
+
+    def wall_offset_ns(self) -> float | None:
+        """perf-clock → this process's OWN wall clock, from the stamp
+        pair; None when the process never exported a stamp (a hand-built
+        or truncated snapshot — the mixed-clock case the trace renderer
+        diagnoses)."""
+        if not self.stamp:
+            return None
+        try:
+            return (float(self.stamp["wall_s"]) * 1e9
+                    - float(self.stamp["perf_ns"]))
+        except (KeyError, TypeError, ValueError):
+            return None
+
+    def describe(self) -> dict:
+        return {
+            "process": self.name, "host": self.host, "pid": self.pid,
+            "seq": self.seq, "reason": self.reason,
+            "records": len(self.records),
+            "series": len(self.registry_rows),
+            "stamp_wall_s": (self.stamp or {}).get("wall_s"),
+            "skew_correction_ms": round(self.skew_ms, 3),
+        }
+
+
+class FleetView:
+    """One collected, merged view of the fleet: the merged registry, the
+    per-process telemetry, and the clock-aligned timeline export."""
+
+    def __init__(self, processes: list[ProcessTelemetry]):
+        self.processes = processes
+        self.registry = MetricsRegistry()
+        self._merge_registries()
+        if any(p.records for p in self.processes):
+            self._align_clocks()
+
+    # -- registry merge --
+
+    def _merge_registries(self) -> None:
+        """counters summed; gauges per process under host=/pid= labels
+        (each process contributes its last-written value — within one
+        host the processes stay distinguishable); histogram windows
+        concatenated with exact count/sum summed."""
+        # histograms accumulate FIRST, then intern: the fleet window
+        # must be sized to the whole concatenation — interning with the
+        # default window would truncate N processes' windows to the
+        # last 4096 values in directory order, biasing fleet quantiles
+        # toward whichever process merged last
+        hists: dict[tuple, list] = {}  # (name, lkey) -> [count, sum, values]
+        for p in self.processes:
+            for row in p.registry_rows:
+                labels = {str(k): v for k, v in row.get("labels", ())}
+                kind = row.get("kind")
+                name = row.get("name")
+                if not name:
+                    continue
+                if kind == "counter":
+                    self.registry.counter(name, **labels).add(
+                        float(row.get("value", 0.0)))
+                elif kind == "gauge":
+                    # a series already labeled host= (train.host_step_ms)
+                    # keeps its own attribution; pid= always lands, so
+                    # two processes on one host stay distinguishable
+                    glabels = dict(labels)
+                    glabels.setdefault("host", p.host)
+                    glabels["pid"] = p.pid
+                    self.registry.gauge(
+                        name, **glabels).set(float(row.get("value", 0.0)))
+                elif kind == "histogram":
+                    key = (name, tuple(sorted(labels.items())))
+                    slot = hists.setdefault(key, [0, 0.0, []])
+                    slot[0] += int(row.get("count", 0))
+                    slot[1] += float(row.get("sum", 0.0))
+                    slot[2].extend(float(v)
+                                   for v in row.get("window", ()))
+        for (name, lkey), (count, total, values) in hists.items():
+            h = self.registry.histogram(name, window=max(len(values), 1),
+                                        **dict(lkey))
+            with h._lock:
+                h._count += count
+                h._sum += total
+                h._values.extend(values)
+
+    # -- clock alignment --
+
+    def _fence_ends(self, p: ProcessTelemetry) -> dict[str, list[float]]:
+        """Per fence NAME, this process's fence-span end times on its
+        own wall clock, in time order. Keyed by name because only
+        same-name fences are the same collective — a train worker's
+        liveness allgather must never be matched against a serve
+        process's lockstep exchange."""
+        off = p.wall_offset_ns()
+        if off is None:
+            return {}
+        out: dict[str, list[float]] = {}
+        for r in p.records:
+            name = r.get("name")
+            if name in FENCE_SPAN_NAMES and "start_ns" in r:
+                out.setdefault(name, []).append(
+                    float(r.get("start_ns", 0))
+                    + float(r.get("dur_ns", 0)) + off)
+        for ends in out.values():
+            ends.sort()
+        return out
+
+    def _align_clocks(self) -> None:
+        """Two-leg skew model. Leg 1: each process's stamp pair places
+        its perf-clock span timestamps on its OWN wall clock. Leg 2:
+        wall clocks themselves skew across hosts (NTP drift), so the
+        fence-seam spans — which END at the same real instant on every
+        participating process (the underlying collective is a barrier)
+        — anchor a per-process residual correction. Matching is per
+        fence NAME and aligned from the TAIL: the ring retains the
+        newest records, so a process whose early fences aged out (or a
+        collector that caught one process a beat later) still pairs
+        its last fence with the reference's last fence; the correction
+        is the median end-time difference over all matched pairs.
+        Processes without fence spans (a lone serve process) keep
+        correction 0."""
+        ref: ProcessTelemetry | None = None
+        ref_fences: dict[str, list[float]] = {}
+        fences: dict[str, dict[str, list[float]]] = {}
+        for p in sorted(self.processes, key=lambda p: (p.host, p.pid)):
+            by_name = self._fence_ends(p)
+            if not by_name:
+                continue
+            fences[p.name] = by_name
+            if ref is None:
+                ref, ref_fences = p, by_name
+        if ref is None:
+            return
+        for p in self.processes:
+            by_name = fences.get(p.name)
+            if p is ref or not by_name:
+                continue
+            deltas = []
+            for name, ends in by_name.items():
+                refs = ref_fences.get(name)
+                if not refs:
+                    continue  # a fence type the reference never crossed
+                n = min(len(ends), len(refs))
+                deltas.extend(refs[-n + k] - ends[-n + k]
+                              for k in range(n))
+            if deltas:
+                p.skew_ms = float(np.median(deltas)) / 1e6
+
+    def unaligned(self) -> list[str]:
+        """Processes whose snapshots carry no stamp pair — their records
+        cannot be placed on the fleet wall clock."""
+        return [p.name for p in self.processes
+                if p.records and p.wall_offset_ns() is None]
+
+    # -- reads --
+
+    def snapshot(self) -> dict:
+        """JSON-safe merged view — the ``/fleet`` endpoint body."""
+        return {
+            "fleet": FLEET_VERSION,
+            "hosts": sorted({p.host for p in self.processes}),
+            "processes": [p.describe() for p in self.processes],
+            "metrics": self.registry.snapshot(),
+        }
+
+    def counter_value(self, name: str, **labels: Any) -> float | None:
+        return self.registry.value(name, **labels)
+
+    # -- the fleet timeline --
+
+    def chrome_trace(self) -> dict:
+        """One Chrome-trace/Perfetto JSON for the whole fleet: every
+        process's ring records on the skew-corrected wall clock (µs
+        since the earliest record — Perfetto is happiest with small
+        positive timestamps), one process group per host
+        (``process_name``/``process_sort_index`` metadata), thread
+        lanes preserved per process, and one stitched flow per fence
+        index drawing the barrier across the process groups. A process
+        without a stamp pair is EXCLUDED from the events (its clock is
+        unplaceable) and named in ``fleetMeta.unaligned`` — the
+        renderer turns that into the typed mixed-clock diagnostic."""
+        events: list[dict] = []
+        # (corrected wall ns, record, process) for every span/event
+        placed: list[tuple[float, dict, ProcessTelemetry]] = []
+        for p in self.processes:
+            off = p.wall_offset_ns()
+            if off is None:
+                continue
+            corr = off + p.skew_ms * 1e6
+            for r in p.records:
+                t = r.get("start_ns", r.get("ts_ns"))
+                if not isinstance(t, (int, float)):
+                    continue
+                placed.append((float(t) + corr, r, p))
+        if not placed:
+            return {"traceEvents": [], "displayTimeUnit": "ms",
+                    "fleetMeta": self._meta()}
+        t0 = min(t for t, _r, _p in placed)
+        hosts = sorted({p.host for p in self.processes})
+        thread_names: dict[tuple[int, int], str] = {}
+        # fence name -> pid -> that process's fence spans in time order
+        fence_spans: dict[str, dict[int, list[tuple[float, int]]]] = {}
+        for t, r, p in sorted(placed, key=lambda x: x[0]):
+            tid = int(r.get("tid", 0) or 0)
+            thread_names.setdefault(
+                (p.pid, tid), str(r.get("thread_name", f"thread-{tid}")))
+            args = {k: v for k, v in (r.get("labels") or {}).items()}
+            args["host"] = p.host
+            if "dur_ns" in r:  # a span
+                dur_us = float(r.get("dur_ns", 0)) / 1e3
+                events.append({
+                    "name": r.get("name", "?"), "cat": r.get("cat", "host"),
+                    "ph": "X", "ts": (t - t0) / 1e3, "dur": dur_us,
+                    "pid": p.pid, "tid": tid, "args": args,
+                })
+                name = r.get("name")
+                if name in FENCE_SPAN_NAMES:
+                    fence_spans.setdefault(name, {}).setdefault(
+                        p.pid, []).append(
+                        ((t - t0) / 1e3 + dur_us / 2, tid))
+            else:  # an instant event
+                events.append({
+                    "name": r.get("name", "?"), "cat": r.get("cat", "host"),
+                    "ph": "i", "s": "t", "ts": (t - t0) / 1e3,
+                    "pid": p.pid, "tid": tid, "args": args,
+                })
+        # stitched cross-process flows: one arrow chain per fence
+        # OCCURRENCE that >=2 processes participated in. Matching
+        # mirrors _align_clocks: per fence NAME (only same-name fences
+        # are the same collective), indexed from the TAIL (ring
+        # retention keeps the newest spans, so the last fences of every
+        # process are the ones that correspond)
+        stitched = 0
+        flow_id = 0x66000000
+        for name in sorted(fence_spans):
+            per_pid = fence_spans[name]
+            depth = max(len(v) for v in per_pid.values())
+            for j in range(depth):  # j = distance from the tail
+                touched = sorted(
+                    (spans[len(spans) - 1 - j][0], pid,
+                     spans[len(spans) - 1 - j][1])
+                    for pid, spans in per_pid.items()
+                    if len(spans) > j)
+                flow_id += 1
+                if len({pid for _ts_, pid, _tid in touched}) < 2:
+                    continue
+                stitched += 1
+                last = len(touched) - 1
+                for i, (mid_us, pid, tid) in enumerate(touched):
+                    events.append({
+                        "name": "fleet-fence", "cat": "fleet.fence",
+                        "ph": "s" if i == 0 else
+                              ("f" if i == last else "t"),
+                        "id": flow_id, "bp": "e",
+                        "ts": mid_us, "pid": pid, "tid": tid,
+                    })
+        for p in self.processes:
+            if p.wall_offset_ns() is None:
+                continue
+            events.append({
+                "name": "process_name", "ph": "M", "pid": p.pid,
+                "args": {"name": f"{p.host} pid={p.pid}"},
+            })
+            events.append({
+                "name": "process_sort_index", "ph": "M", "pid": p.pid,
+                "args": {"sort_index": hosts.index(p.host)},
+            })
+        for (pid, tid), tname in thread_names.items():
+            events.append({
+                "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+                "args": {"name": tname},
+            })
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "fleetMeta": self._meta(stitched_flows=stitched)}
+
+    def _meta(self, stitched_flows: int = 0) -> dict:
+        return {
+            "fleet": FLEET_VERSION,
+            "hosts": {h: sorted(p.pid for p in self.processes
+                                if p.host == h)
+                      for h in sorted({p.host for p in self.processes})},
+            "processes": [p.describe() for p in self.processes],
+            "stitched_flows": stitched_flows,
+            "unaligned": self.unaligned(),
+        }
+
+    def write_chrome_trace(self, path: str) -> str:
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(self.chrome_trace(), fh)
+        return path
+
+
+class FleetCollector:
+    """Scan one fleet directory and merge its process snapshots."""
+
+    def __init__(self, fleet_dir: str):
+        self.fleet_dir = str(fleet_dir)
+
+    def _proc_dirs(self) -> list[tuple[str, str, int]]:
+        try:
+            names = sorted(os.listdir(self.fleet_dir))
+        except OSError as e:
+            raise FleetReadError(
+                f"cannot read fleet dir {self.fleet_dir!r}: "
+                f"{e.strerror or e}") from e
+        out = []
+        for name in names:
+            m = _PROC_DIR_RE.match(name)
+            if m and os.path.isdir(os.path.join(self.fleet_dir, name)):
+                out.append((name, m.group("host"), int(m.group("pid"))))
+        return out
+
+    def _load_process(self, name: str, host: str, pid: int,
+                      include_ring: bool = True,
+                      ) -> ProcessTelemetry | None:
+        proc = ProcessTelemetry(name, host, pid)
+        pdir = os.path.join(self.fleet_dir, name)
+        try:
+            snaps = sorted(n for n in os.listdir(pdir)
+                           if _SNAP_RE.match(n))
+        except OSError:
+            return None
+        seen: set = set()
+        loaded_any = False
+        if not include_ring:
+            # registry-only read: counters/gauges are cumulative, so
+            # the NEWEST readable snapshot is the whole truth — walk
+            # backward and stop at the first one instead of paying a
+            # full-JSON parse (ring arrays included) per retained file
+            snaps = list(reversed(snaps))
+        for snap in snaps:  # oldest → newest: the last wins the registry
+            try:
+                with open(os.path.join(pdir, snap),
+                          encoding="utf-8") as fh:
+                    payload = json.load(fh)
+            except (OSError, ValueError):
+                continue  # a torn/garbled snapshot never poisons the rest
+            if not isinstance(payload, dict):
+                continue
+            loaded_any = True
+            proc.seq = int(payload.get("seq", proc.seq) or 0)
+            proc.reason = str(payload.get("reason", ""))
+            stamp = payload.get("stamp")
+            proc.stamp = stamp if isinstance(stamp, dict) else proc.stamp
+            reg = payload.get("registry")
+            if isinstance(reg, list):
+                proc.registry_rows = reg  # cumulative: newest wins
+            if not include_ring:
+                break  # newest readable found — nothing older needed
+            for r in payload.get("ring") or ():
+                if not isinstance(r, dict):
+                    continue
+                # dedup across overlapping ring tails: span_id is
+                # process-unique; instant events key by (tid, ts, name)
+                key = (("s", r["span_id"]) if r.get("span_id") is not None
+                       else ("e", r.get("tid"), r.get("ts_ns"),
+                             r.get("name")))
+                if key in seen:
+                    continue
+                seen.add(key)
+                proc.records.append(r)
+        return proc if loaded_any else None
+
+    def collect(self, include_ring: bool = True) -> FleetView:
+        """Load every process's snapshots and merge. Raises
+        :class:`FleetReadError` when the directory is missing or no
+        process exported anything readable. ``include_ring=False``
+        skips the span-ring parse and the clock alignment entirely —
+        the registry-merge-only read the metrics surfaces want: a
+        scraper polling ``/fleet`` every few seconds must not pay a
+        multi-megabyte ring parse per scrape for a body that only
+        serves the merged registry."""
+        procs = []
+        for name, host, pid in self._proc_dirs():
+            p = self._load_process(name, host, pid,
+                                   include_ring=include_ring)
+            if p is not None:
+                procs.append(p)
+        if not procs:
+            raise FleetReadError(
+                f"fleet dir {self.fleet_dir!r} holds no readable "
+                "process snapshots (is MMLSPARK_TPU_FLEET pointed at "
+                "the right directory, and has any process exported "
+                "yet?)")
+        return FleetView(procs)
+
+    def status(self) -> dict:
+        """Cheap directory-level status (no ring merge): per-process
+        newest snapshot, age, seq — the `tools/fleet.py status` body."""
+        now = time.time()
+        rows = []
+        for name, host, pid in self._proc_dirs():
+            pdir = os.path.join(self.fleet_dir, name)
+            try:
+                snaps = sorted(n for n in os.listdir(pdir)
+                               if _SNAP_RE.match(n))
+            except OSError:
+                continue
+            if not snaps:
+                continue
+            newest = os.path.join(pdir, snaps[-1])
+            row = {"process": name, "host": host, "pid": pid,
+                   "snapshots": len(snaps)}
+            try:
+                with open(newest, encoding="utf-8") as fh:
+                    payload = json.load(fh)
+                row["seq"] = payload.get("seq")
+                row["reason"] = payload.get("reason")
+                stamp = payload.get("stamp") or {}
+                wall = stamp.get("wall_s")
+                if isinstance(wall, (int, float)):
+                    row["age_s"] = round(now - wall, 3)
+            except (OSError, ValueError):
+                row["reason"] = "unreadable"
+            rows.append(row)
+        return {"fleet_dir": self.fleet_dir, "processes": rows}
+
+
+# MMLSPARK_TPU_FLEET=<dir>: headless fleet export without code changes
+# (read once at import; explicit enable()/disable() calls override —
+# the same precedence contract as MMLSPARK_TPU_FLIGHT/OBS)
+_env_dir = config.get("fleet", None)
+if _env_dir:  # pragma: no cover - env-dependent
+    enable(str(_env_dir))

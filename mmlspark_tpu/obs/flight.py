@@ -307,6 +307,15 @@ class FlightRecorder:
             _event("flight/dump", "flight", {"reason": reason,
                                              "path": path})
         _registry().counter("flight.dumps", reason=reason).add()
+        # fleet-plane hook, order PINNED dump-then-snapshot: the local
+        # post-mortem is on disk first, then the fleet exporter (one
+        # attribute check when off) flushes a final snapshot naming it —
+        # so the fleet directory's last word about this process is
+        # current at the failure point, not a full watchdog interval
+        # stale, and points collectors at the richer local dump
+        from mmlspark_tpu.obs import fleet as _fleet
+        if _fleet._exp is not None:
+            _fleet.on_flight_dump(reason, path)
         return path
 
     # ---- crash/signal hooks ----

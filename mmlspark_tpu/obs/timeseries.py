@@ -1,0 +1,318 @@
+"""Metric history — the time dimension the one-shot registry lacks.
+
+Every registry read (``/metrics``, ``/slo``, a supervisor poll) is
+point-in-time: the adaptive bucket ladder wants the request-size
+histogram's TREND, the replica autoscaler wants ``serve.queue_depth``
+over the last minute, the supervisor policy wants ``train.host_step_ms``
+history — and none of them can get it from a registry that only holds
+"now". This module is the history: a periodic sampler persisting the
+SLO/autoscale series into
+
+* a bounded in-memory **ring** per series (the query surface the
+  in-process actuators read — :meth:`MetricHistory.range`,
+  :meth:`~MetricHistory.rate`, :meth:`~MetricHistory.last`), and
+* an append-only **JSONL history file** (one ``{"t", "k", "v"}`` line
+  per observation; load it back with :meth:`MetricHistory.load` for
+  off-process analysis, or ship it with the fleet snapshots — the
+  fleet exporter writes it into its own ``proc_*/`` directory).
+
+What gets sampled is prefix-selected (:data:`DEFAULT_PREFIXES` names
+exactly the signals ROADMAP items 1/3/4 act on: the ``serve.slo_burn_*``
+/ queue-depth / occupancy gauges, ``train.host_step_ms``, and the
+``train.service.*`` / ``train.fleet.*`` supervision series); counters
+are sampled too so :meth:`~MetricHistory.rate` turns them into per-
+second rates. Sampling is registry READS only — the one-substrate rule
+holds, and an unsampled history costs nothing.
+
+Enable standalone with :func:`enable` (module-level :func:`range_`,
+:func:`rate`, :func:`last` delegate to the active sampler's history),
+or implicitly through ``obs.fleet.enable`` / ``MMLSPARK_TPU_FLEET``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Callable
+
+from mmlspark_tpu.obs.metrics import (
+    Counter, Gauge, format_series,
+)
+
+SAMPLER_THREAD = "TimeSeriesSampler"
+
+#: the SLO/autoscale/supervision series the default sampler persists —
+#: the signals the adaptive ladder, the replica autoscaler, and the
+#: supervisor policy consume (docs/observability.md §timeseries)
+DEFAULT_PREFIXES = (
+    "serve.slo_burn_",
+    "serve.slo_budget_remaining",
+    "serve.queue_depth",
+    "serve.occupancy_mean_window",
+    "serve.replica_skew",
+    "serve.lane_",
+    "train.host_step_ms",
+    "train.host_skew",
+    "train.service.",
+    "train.fleet.",
+)
+
+
+def _series_name(key: str) -> str:
+    """``name{labels}`` → ``name`` (the metric-name part of a key)."""
+    brace = key.find("{")
+    return key if brace < 0 else key[:brace]
+
+
+class MetricHistory:
+    """Bounded per-series ring of ``(t, value)`` observations plus an
+    optional append-only JSONL sink. Thread-safe (the sampler thread
+    appends while actuators query)."""
+
+    def __init__(self, maxlen: int = 4096, path: str | None = None):
+        self.maxlen = int(maxlen)
+        self.path = path
+        self._lock = threading.Lock()
+        self._series: dict[str, deque] = {}
+        self._fh = open(path, "a", encoding="utf-8") if path else None
+
+    # -- writes --
+
+    def append(self, t: float, key: str, value: float) -> None:
+        line = None
+        with self._lock:
+            ring = self._series.get(key)
+            if ring is None:
+                ring = self._series[key] = deque(maxlen=self.maxlen)
+            ring.append((float(t), float(value)))
+            if self._fh is not None:
+                line = json.dumps({"t": round(float(t), 6), "k": key,
+                                   "v": float(value)})
+                self._fh.write(line + "\n")
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    # -- queries (the actuator surface) --
+
+    def keys(self) -> list[str]:
+        with self._lock:
+            return sorted(self._series)
+
+    def range(self, name: str, t0: float | None = None,
+              t1: float | None = None) -> dict[str, list[tuple]]:
+        """Observations for every series whose metric NAME equals
+        ``name`` (or whose full ``name{labels}`` key equals it),
+        bounded to ``[t0, t1]`` when given: ``{series_key: [(t, v),
+        ...]}``, oldest first. The shape downstream consumers want —
+        one fleet often holds the same gauge under several label sets
+        (per model, per host)."""
+        with self._lock:
+            items = [(k, list(ring)) for k, ring in self._series.items()
+                     if k == name or _series_name(k) == name]
+        out: dict[str, list[tuple]] = {}
+        for k, samples in items:
+            kept = [(t, v) for t, v in samples
+                    if (t0 is None or t >= t0)
+                    and (t1 is None or t <= t1)]
+            if kept:
+                out[k] = kept
+        return out
+
+    def last(self, name: str, n: int = 1) -> dict[str, list[tuple]]:
+        """The newest ``n`` observations per matching series."""
+        return {k: samples[-n:]
+                for k, samples in self.range(name).items()}
+
+    def rate(self, name: str,
+             window_s: float | None = None) -> dict[str, float]:
+        """Per-second first-difference rate over the window (or the
+        whole ring): ``(v_last - v_first) / (t_last - t_first)`` —
+        turns a sampled cumulative counter into a rate; series with
+        fewer than two samples (or zero elapsed time) are omitted.
+        The window is anchored at each series' NEWEST sample, not at
+        ``time.time()`` — sample timestamps come from the sampler's
+        (possibly injected) clock, and a history loaded from an
+        archived JSONL would otherwise fall entirely outside a
+        wall-clock window and silently rate to nothing."""
+        out: dict[str, float] = {}
+        for k, samples in self.range(name).items():
+            if window_s is not None and samples:
+                t_last = samples[-1][0]
+                samples = [(t, v) for t, v in samples
+                           if t >= t_last - float(window_s)]
+            if len(samples) < 2:
+                continue
+            (ta, va), (tb, vb) = samples[0], samples[-1]
+            if tb <= ta:
+                continue
+            out[k] = (vb - va) / (tb - ta)
+        return out
+
+    # -- persistence --
+
+    @classmethod
+    def load(cls, path: str, maxlen: int = 4096) -> "MetricHistory":
+        """Rebuild a history from its JSONL file (unparseable lines —
+        a torn tail write — are skipped, never fatal)."""
+        hist = cls(maxlen=maxlen)
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                try:
+                    row = json.loads(line)
+                    hist.append(float(row["t"]), str(row["k"]),
+                                float(row["v"]))
+                except (ValueError, KeyError, TypeError):
+                    continue
+        return hist
+
+
+class TimeSeriesSampler:
+    """Periodic (or on-demand) sampler: reads the prefix-selected
+    gauges/counters of its registries into a :class:`MetricHistory`.
+
+    ``registries`` is a zero-arg callable returning the registries to
+    sample each tick (default: the process-wide registry plus every
+    ``obs.fleet`` registry source — so per-model serve registries ride
+    along); resolving per tick means models added after the sampler
+    started are picked up. ``sample()`` may also be called explicitly
+    (each ``/slo`` poll can be one history sample, the same on-demand
+    discipline as the SLO tracker).
+    """
+
+    def __init__(self, registries: Callable[[], list] | None = None,
+                 prefixes: tuple = DEFAULT_PREFIXES,
+                 interval_s: float = 1.0,
+                 path: str | None = None,
+                 maxlen: int = 4096,
+                 clock: Callable[[], float] = time.time):
+        from mmlspark_tpu.obs import fleet as _fleet
+        self.registries = registries or _fleet.all_registries
+        self.prefixes = tuple(prefixes)
+        self.interval_s = float(interval_s)
+        self._clock = clock
+        self.history = MetricHistory(maxlen=maxlen, path=path)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def _match(self, name: str) -> bool:
+        return name.startswith(self.prefixes)
+
+    def sample(self, now: float | None = None) -> int:
+        """Take one sample of every matching series; returns how many
+        observations were recorded."""
+        now = self._clock() if now is None else float(now)
+        n = 0
+        for reg in self.registries():
+            for m in reg.iter_metrics():
+                if not self._match(m.name):
+                    continue
+                if isinstance(m, (Gauge, Counter)):
+                    v = m.value
+                    if v is None:
+                        continue
+                    self.history.append(
+                        now, format_series(m.name, m.labels), float(v))
+                    n += 1
+        self.history.flush()
+        return n
+
+    # -- lifecycle --
+
+    def start(self) -> "TimeSeriesSampler":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name=SAMPLER_THREAD, daemon=True)
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.sample()
+            except Exception:  # pragma: no cover - sampler never dies
+                pass
+
+    def close(self) -> None:
+        """Stop the cadence thread (joined — no stray threads), take
+        one final sample, and close the JSONL sink."""
+        self._stop.set()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+        self._thread = None
+        try:
+            self.sample()
+        except Exception:  # pragma: no cover - defensive final sample
+            pass
+        self.history.close()
+
+
+# ---------------------------------------------------------------------------
+# module surface
+# ---------------------------------------------------------------------------
+
+_sampler: TimeSeriesSampler | None = None
+
+
+def enable(path: str | None = None, **kwargs: Any) -> TimeSeriesSampler:
+    """Start the process-wide sampler (replacing a previous one — its
+    history is closed first). ``kwargs`` forward to
+    :class:`TimeSeriesSampler`."""
+    global _sampler
+    if _sampler is not None:
+        _sampler.close()
+    _sampler = TimeSeriesSampler(path=path, **kwargs).start()
+    return _sampler
+
+
+def disable() -> None:
+    global _sampler
+    if _sampler is not None:
+        _sampler.close()
+        _sampler = None
+
+
+def enabled() -> bool:
+    return _sampler is not None
+
+
+def sampler() -> TimeSeriesSampler | None:
+    return _sampler
+
+
+def history() -> MetricHistory | None:
+    return _sampler.history if _sampler is not None else None
+
+
+def range_(name: str, t0: float | None = None,
+           t1: float | None = None) -> dict[str, list[tuple]]:
+    """Module-level delegate to the active sampler's history (empty
+    when no sampler is enabled)."""
+    h = history()
+    return {} if h is None else h.range(name, t0=t0, t1=t1)
+
+
+def rate(name: str, window_s: float | None = None) -> dict[str, float]:
+    h = history()
+    return {} if h is None else h.rate(name, window_s=window_s)
+
+
+def last(name: str, n: int = 1) -> dict[str, list[tuple]]:
+    h = history()
+    return {} if h is None else h.last(name, n=n)
+
+
+# `range` is a builtin; export the query API under the natural name too
+# for the documented `timeseries.range()` spelling
+range = range_  # noqa: A001 - deliberate module-namespace alias

@@ -911,7 +911,13 @@ class DynamicBatcher:
             if lane is None:  # aborted at the fence: nothing was agreed
                 raise ServerClosed(f"model {self.name!r} closed")
             try:
-                self._lockstep.agree((bucket, batch[0]._compat))
+                # fenced cross-process seam: every lockstep process
+                # exits agree() together — the fleet plane's serve-side
+                # skew/stitch anchor (obs/fleet.FENCE_SPAN_NAMES)
+                with _obs_span("serve/lockstep_agree", "serve",
+                               {"model": self.name, "bucket": bucket}
+                               if _obs_rt._enabled else None):
+                    self._lockstep.agree((bucket, batch[0]._compat))
             except BaseException:
                 # nothing dispatched: free the claimed slot or the next
                 # drain_barrier spins on this lane's load forever

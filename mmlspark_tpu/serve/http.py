@@ -34,7 +34,12 @@ Protocol (details + examples in docs/serving.md):
   ``GET /trace`` — the captured span buffer as Chrome-trace
   ``trace_event`` JSON, request flows included (empty unless
   ``obs.enable()`` was called, e.g. ``tools/serve.py --obs`` or
-  ``MMLSPARK_TPU_OBS=1``).
+  ``MMLSPARK_TPU_OBS=1``);
+  ``GET /fleet`` — the FLEET-merged metrics view (``obs/fleet.py``:
+  every process exporting under ``MMLSPARK_TPU_FLEET``, counters
+  summed / gauges per host), JSON by default and the Prometheus text
+  exposition of the merged registry under the same ``Accept``
+  negotiation as ``/metrics``; 404 without a configured fleet dir.
 
 Typed serving errors map to status codes: ``Overloaded`` → 429,
 ``DeadlineExceeded`` → 504, ``ModelNotFound`` → 404, ``BadRequest`` (and
@@ -210,6 +215,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, self._ms.snapshot())
             elif self.path == "/metrics":
                 self._send_metrics()
+            elif self.path == "/fleet":
+                self._send_fleet()
             elif self.path == "/trace":
                 from mmlspark_tpu.obs import export as obs_export
                 self._send_json(200, obs_export.chrome_trace())
@@ -219,24 +226,66 @@ class _Handler(BaseHTTPRequestHandler):
         except BaseException as e:  # noqa: BLE001 — typed mapping
             self._send_error_typed(e)
 
+    def _wants_prometheus(self) -> bool:
+        """The /metrics-family content negotiation, in ONE place:
+        ``Accept: text/plain`` (what Prometheus sends,
+        ``text/plain;version=0.0.4``) or OpenMetrics asks for the text
+        exposition; everything else gets JSON."""
+        accept = (self.headers.get("Accept") or "").lower()
+        return "text/plain" in accept or "openmetrics" in accept
+
+    def _send_prometheus(self, registries: list) -> None:
+        from mmlspark_tpu.obs import export as obs_export
+        body = obs_export.prometheus_text(registries)
+        self._send(200, body.encode("utf-8"),
+                   "text/plain; version=0.0.4; charset=utf-8")
+
     def _send_metrics(self) -> None:
         """The /metrics body under content negotiation: JSON snapshot by
         default (unchanged), Prometheus text exposition when the Accept
-        header asks for text/plain or OpenMetrics — the standard scraper
-        handshake (Prometheus sends ``text/plain;version=0.0.4``)."""
+        header asks for it."""
         from mmlspark_tpu.obs import export as obs_export
         from mmlspark_tpu.obs.metrics import registry
-        accept = (self.headers.get("Accept") or "").lower()
-        if "text/plain" in accept or "openmetrics" in accept:
-            body = obs_export.prometheus_text(
+        if self._wants_prometheus():
+            self._send_prometheus(
                 [registry()] + self._ms.metric_registries())
-            self._send(200, body.encode("utf-8"),
-                       "text/plain; version=0.0.4; charset=utf-8")
             return
         self._send_json(200, {
             **obs_export.metrics_snapshot(),
             "models": self._ms.snapshot(),
         })
+
+    def _send_fleet(self) -> None:
+        """The fleet-merged metrics view (obs/fleet.py): every process
+        exporting under the configured ``MMLSPARK_TPU_FLEET`` directory,
+        counters summed / gauges per host. Content-negotiated like
+        ``/metrics``: JSON snapshot by default, the Prometheus text
+        exposition of the MERGED registry for ``text/plain`` — one
+        scrape endpoint for the whole fleet. 404 when no fleet dir is
+        configured; 503 when the directory holds no readable snapshots
+        yet (come back after the first export interval)."""
+        from mmlspark_tpu.obs import fleet as obs_fleet
+        fleet_dir = obs_fleet.fleet_dir()
+        if fleet_dir is None:
+            self._send_json(404, {
+                "error": "FleetNotConfigured",
+                "message": "no fleet directory: set MMLSPARK_TPU_FLEET "
+                           "or call obs.fleet.enable(dir)"})
+            return
+        try:
+            # registry-only merge: the metrics bodies never read the
+            # span rings, and a scraper polls this on a tight cadence
+            view = obs_fleet.FleetCollector(fleet_dir).collect(
+                include_ring=False)
+        except obs_fleet.FleetReadError as e:
+            self._send_json(503, {"error": "FleetUnreadable",
+                                  "message": str(e)},
+                            headers=self._retry_after())
+            return
+        if self._wants_prometheus():
+            self._send_prometheus([view.registry])
+            return
+        self._send_json(200, view.snapshot())
 
     def do_POST(self) -> None:  # noqa: N802 - http.server contract
         try:

@@ -157,6 +157,12 @@ class ModelServer:
         # death/restart decisions — decisions.jsonl on disk when
         # ServeConfig.lifecycle_dir is set, always the in-memory tail
         self.journal = DecisionJournal(self.config.lifecycle_dir)
+        # fleet plane: per-model stats registries ride the process's
+        # telemetry snapshots (and the timeseries sampler) so the
+        # serve.* series aggregate across the fleet; unregistered on
+        # close — a dead server's registries must not keep exporting
+        from mmlspark_tpu.obs import fleet as _obs_fleet
+        _obs_fleet.add_registry_source(self.metric_registries)
 
     # -- loading --
 
@@ -840,6 +846,8 @@ class ModelServer:
     def close(self, drain: bool = True) -> None:
         """Shut down every model's batcher. ``drain=True`` (default)
         answers all admitted requests first; no threads survive."""
+        from mmlspark_tpu.obs import fleet as _obs_fleet
+        _obs_fleet.remove_registry_source(self.metric_registries)
         with self._lock:
             self._closed = True
             entries = list(self._models.values())

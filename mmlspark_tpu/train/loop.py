@@ -976,14 +976,19 @@ class Trainer:
                         # the schedule is identical on every process
                         # whether or not its tracer is enabled
                         block = list(_itertools.islice(it, sync_n))
-                        fence()
-                        from jax.experimental import multihost_utils
-                        payload = np.asarray(
-                            [float(len(block)),
-                             straggler.local_mean_ms()], np.float64)
-                        gathered = np.asarray(
-                            multihost_utils.process_allgather(
-                                payload)).reshape(-1, 2)
+                        # the fence + allgather is the one seam every
+                        # process crosses at the same real instant — the
+                        # span is the fleet plane's skew-correction and
+                        # flow-stitch anchor (obs/fleet.FENCE_SPAN_NAMES)
+                        with _obs_span("train/liveness_sync", "train"):
+                            fence()
+                            from jax.experimental import multihost_utils
+                            payload = np.asarray(
+                                [float(len(block)),
+                                 straggler.local_mean_ms()], np.float64)
+                            gathered = np.asarray(
+                                multihost_utils.process_allgather(
+                                    payload)).reshape(-1, 2)
                         block_steps = int(gathered[:, 0].max())
                         if _obs_rt._enabled:
                             straggler.ingest(gathered[:, 1],

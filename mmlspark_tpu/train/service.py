@@ -62,8 +62,10 @@ import numpy as np
 
 from mmlspark_tpu.core.logging_utils import get_logger
 from mmlspark_tpu.core.retry import RetryPolicy
+from mmlspark_tpu.obs import fleet as _obs_fleet
 from mmlspark_tpu.obs import flight as _obs_flight
 from mmlspark_tpu.obs import runtime as _obs_rt
+from mmlspark_tpu.obs.metrics import Counter as _ObsCounter
 from mmlspark_tpu.obs.metrics import registry as _obs_registry
 from mmlspark_tpu.obs.spans import event as _obs_event
 
@@ -230,6 +232,7 @@ class ServiceBeacon:
             "ts": time.time(), "status": status,
             "progress": 0, "busy": False,
             "stragglers": 0, "host_step_ms": {},
+            "counters": [],
         }
         rec = _obs_flight._rec
         if rec is not None:
@@ -240,7 +243,10 @@ class ServiceBeacon:
             sample["busy"] = any(hb["busy"] for hb in beats.values())
         # straggler sensors ride the registry (obs/anomaly.py publishes
         # them on the fenced liveness exchange); iterate the interned
-        # metric objects — no string key parsing
+        # metric objects — no string key parsing. The train.* counter
+        # EXCERPT is the supervisor's fleet-aggregation feed: it reads
+        # per-worker deltas off the beacons and publishes
+        # `train.fleet.*` series (docs/training_service.md)
         for m in _obs_registry().iter_metrics():
             labels = dict(m.labels)
             if m.name == "train.steps":
@@ -249,6 +255,9 @@ class ServiceBeacon:
                 sample["stragglers"] += int(m.value)
             elif m.name == "train.host_step_ms":
                 sample["host_step_ms"][str(labels.get("host"))] = m.value
+            if isinstance(m, _ObsCounter) \
+                    and m.name.startswith("train."):
+                sample["counters"].append([m.name, labels, m.value])
         return sample
 
     def _run(self) -> None:
@@ -475,6 +484,10 @@ class ServiceConfig:
     worker_flight: bool = True   # flight recorder dir per worker under
     #                              service_dir/flight/ (post-mortems land
     #                              where the supervisor can find them)
+    worker_fleet: bool = True    # propagate this process's fleet dir
+    #                              (obs/fleet.py, MMLSPARK_TPU_FLEET) so
+    #                              workers export telemetry snapshots
+    #                              into the same fleet plane
     snapshot_recovery: bool = True  # archive the checkpoint dir at each
     #                                 re-scale (the exact recovery point,
     #                                 for audit/bit-compat verification)
@@ -541,6 +554,10 @@ class _Worker:
         #                                      deadline baseline
         self.straggler_hits = 0
         self.exit_recorded = False
+        # last-seen beacon counter values, keyed (name, labels): the
+        # fleet-aggregation delta baseline (a value that went BACKWARD
+        # means the worker restarted and its registry reset)
+        self.counter_last: dict[tuple, float] = {}
 
     def _pump(self) -> None:
         for line in self.proc.stdout:
@@ -634,6 +651,10 @@ class TrainSupervisor:
                 env.setdefault("MMLSPARK_TPU_FLIGHT", os.path.join(
                     self.cfg.service_dir, "flight",
                     f"gen{generation}_rank{rank}"))
+            if self.cfg.worker_fleet:
+                fdir = _obs_fleet.fleet_dir()
+                if fdir:
+                    env.setdefault("MMLSPARK_TPU_FLEET", fdir)
             proc = subprocess.Popen(
                 list(self.cfg.cmd), env=env, stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT, text=True, errors="replace")
@@ -733,21 +754,20 @@ class TrainSupervisor:
                 stalled = time.monotonic() - w.progress_ts
                 if stalled > policy.hang_timeout_s:
                     return WorkerHang(w.rank, stalled)
+        # fleet aggregation: ONE read of the beacon set produces both
+        # the published `train.fleet.*` series and the inputs the
+        # straggler verdict below consumes — policy and telemetry see
+        # the same numbers by construction, never two derivations
+        agg = self._fleet_aggregates(beacons)
+        self._publish_fleet(workers, beacons, agg)
         # straggler verdicts are GLOBAL: the fenced exchange increments
         # train.stragglers identically in EVERY process, so the window
         # count is the MAX across beacons — summing per-beacon increments
         # would count each verdict world× and evict world× too early
-        live = [b for b in beacons.values() if b]
-        total = max((int(b.get("stragglers", 0)) for b in live),
-                    default=0)
+        total = agg["straggler_windows"]
         if total > self._straggler_total:
             delta = total - self._straggler_total
-            hosts: dict = {}
-            for b in sorted(live, key=lambda b: int(b.get(
-                    "stragglers", 0)), reverse=True):
-                if b.get("host_step_ms"):
-                    hosts = b["host_step_ms"]
-                    break
+            hosts = agg["host_step_ms"]
             if hosts:
                 slow = max(hosts, key=lambda h: hosts[h] or 0.0)
                 for target in workers:
@@ -761,6 +781,85 @@ class TrainSupervisor:
                         return WorkerStraggling(
                             target.rank, target.straggler_hits)
         return None
+
+    def _fleet_aggregates(self, beacons: dict[int, dict | None]) -> dict:
+        """Merge one poll's beacons into the fleet view: live worker
+        count, summed progress, the GLOBAL straggler verdict-window
+        count (max across beacons — every process counts each fenced
+        verdict identically), and the per-host step-time table (from
+        the beacon that has witnessed the most verdicts — the most
+        current attribution). ``workers`` counts only RUNNING-status
+        beacons: the final terminal-beacon read after a clean
+        completion folds in the last counter deltas, and an
+        exited/crashed beacon must not leave the liveness gauge
+        reporting dead workers as live on an idle supervisor.
+        Progress/straggler/step-time reads stay cumulative truth
+        whatever the status."""
+        live = [b for b in beacons.values() if b]
+        host_step_ms: dict = {}
+        for b in sorted(live, key=lambda b: int(b.get("stragglers", 0)),
+                        reverse=True):
+            if b.get("host_step_ms"):
+                host_step_ms = b["host_step_ms"]
+                break
+        return {
+            "workers": sum(1 for b in live
+                           if b.get("status", "running") == "running"),
+            "progress": sum(int(b.get("progress", 0)) for b in live),
+            "straggler_windows": max(
+                (int(b.get("stragglers", 0)) for b in live), default=0),
+            "host_step_ms": host_step_ms,
+        }
+
+    def _publish_fleet(self, workers: list[_Worker],
+                       beacons: dict[int, dict | None],
+                       agg: dict) -> None:
+        """Publish the beacon-derived fleet aggregates as first-class
+        `train.fleet.*` series in the SUPERVISOR's registry (tracer-
+        gated, like every supervisor series): liveness/progress/skew
+        gauges, plus per-worker DELTAS of the beacon registry excerpts
+        re-accumulated as `train.fleet.<counter>{rank=…}` counters — so
+        downstream consumers (the timeseries sampler, a fleet exporter
+        on the supervisor, /metrics scrapes) read one aggregated
+        surface instead of re-deriving from raw beacon files."""
+        if not _obs_rt._enabled:
+            return
+        reg = _obs_registry()
+        reg.gauge("train.fleet.workers").set(agg["workers"])
+        reg.gauge("train.fleet.progress").set(agg["progress"])
+        reg.gauge("train.fleet.straggler_windows").set(
+            agg["straggler_windows"])
+        for host, ms in agg["host_step_ms"].items():
+            if isinstance(ms, (int, float)):
+                reg.gauge("train.fleet.host_step_ms",
+                          host=str(host)).set(float(ms))
+        for w in workers:
+            b = beacons.get(w.rank)
+            if not b:
+                continue
+            for row in b.get("counters") or ():
+                try:
+                    name, labels, value = row
+                    value = float(value)
+                    labels = {str(k): v for k, v in dict(labels).items()}
+                except (TypeError, ValueError):
+                    continue
+                key = (name, tuple(sorted(labels.items())))
+                last = w.counter_last.get(key)
+                # a backward value is a restarted worker's fresh
+                # registry: the new total is all new progress
+                delta = value if (last is None or value < last) \
+                    else value - last
+                w.counter_last[key] = value
+                if delta > 0:
+                    # rank= is the fleet dimension: a worker counter
+                    # that already carries its own rank label (worker
+                    # code is arbitrary) is overridden, never a
+                    # duplicate-keyword TypeError killing the watch loop
+                    flabels = {**labels, "rank": w.rank}
+                    reg.counter(
+                        "train.fleet." + name[len("train."):],
+                        **flabels).add(delta)
 
     def _watch(self, generation: int,
                workers: list[_Worker]) -> Signal | None:
@@ -835,6 +934,18 @@ class TrainSupervisor:
                     action=action)
                 report.generations.append(gen_report)
                 if sig is None:
+                    # one final fleet publication off the TERMINAL
+                    # beacons: the watch loop returns the moment every
+                    # worker exits, which can precede its last
+                    # mid-run sensor poll — without this read the
+                    # train.fleet.* aggregates would understate the
+                    # completed generation by up to one beacon interval
+                    beacons = {w.rank:
+                               self._read_beacon(generation, w.rank)
+                               for w in workers}
+                    self._publish_fleet(
+                        workers, beacons,
+                        self._fleet_aggregates(beacons))
                     self._forget(workers)
                     workers = []
                     report.ok = True

@@ -1,0 +1,696 @@
+"""Fleet telemetry plane (obs/fleet.py + obs/timeseries.py): cross-
+process snapshot export/merge must be BIT-exact for counters, the fleet
+timeline must correct injected wall-clock skew at the fence seams, the
+exporter must leave no stray threads and flush a final snapshot on crash
+through the flight-recorder hook (order pinned), and the timeseries
+sampler must persist a queryable gauge history."""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from mmlspark_tpu import obs
+from mmlspark_tpu.obs import fleet as obs_fleet
+from mmlspark_tpu.obs import flight as obs_flight
+from mmlspark_tpu.obs import timeseries as obs_ts
+from mmlspark_tpu.obs.fleet import (
+    FleetCollector, FleetReadError, TelemetryExporter,
+)
+from mmlspark_tpu.obs.metrics import (
+    Counter, MetricsRegistry, format_series,
+)
+from mmlspark_tpu.obs.timeseries import MetricHistory, TimeSeriesSampler
+
+
+@pytest.fixture(autouse=True)
+def obs_isolated():
+    obs_fleet.disable()
+    obs_ts.disable()
+    obs.disable()
+    obs.clear()
+    obs.registry().reset()
+    yield
+    obs_fleet.disable()
+    obs_ts.disable()
+    obs_flight.disable()
+    obs.disable()
+    obs.clear()
+    obs.registry().reset()
+
+
+def _counter_truth(regs) -> dict:
+    out: dict = {}
+    for reg in regs:
+        for m in reg.iter_metrics():
+            if isinstance(m, Counter):
+                key = format_series(m.name, m.labels)
+                out[key] = out.get(key, 0.0) + m.value
+    return out
+
+
+# ---------------------------------------------------------------------------
+# two jax-free supervisor workers -> bit-equal merge
+# ---------------------------------------------------------------------------
+
+FLEET_TEST_WORKER = """
+import json, os, time
+from mmlspark_tpu import obs
+from mmlspark_tpu.obs import fleet
+from mmlspark_tpu.obs.metrics import Counter, format_series
+from mmlspark_tpu.train.service import service_context
+
+with service_context(beacon_interval_s=0.05) as info:
+    reg = obs.registry()
+    # distinct per-rank totals so the merged sums are non-trivial
+    for k in range(10 + info.rank * 5):
+        with obs.span("train/step", "train"):
+            pass
+        reg.counter("train.steps").add()
+        reg.counter("serve.test_bytes").add(3.5)
+    reg.gauge("train.host_step_ms", host=str(info.rank)).set(
+        10.0 + info.rank)
+    reg.gauge("train.input.wait_fraction").set(0.25 * (info.rank + 1))
+    truth = {format_series(m.name, m.labels): m.value
+             for m in reg.iter_metrics() if isinstance(m, Counter)}
+    with open(os.path.join(info.service_dir,
+                           "truth_%d.json" % info.rank), "w") as f:
+        json.dump(truth, f)
+    fleet.disable()  # final snapshot after the truth capture
+"""
+
+
+def test_two_worker_snapshots_merge_bit_equal(tmp_path):
+    """Two supervised jax-free workers exporting under one fleet dir:
+    the collector's merged counters equal the sum of the per-process
+    registry truths bit-for-bit, and per-process gauges stay
+    distinguishable (pid label) even on one host."""
+    from mmlspark_tpu.train.service import (
+        RecoveryPolicy, ServiceConfig, Topology, TrainSupervisor,
+    )
+    fleet_dir = str(tmp_path / "fleet")
+    svc_dir = str(tmp_path / "svc")
+    report = TrainSupervisor(ServiceConfig(
+        cmd=(sys.executable, "-c", FLEET_TEST_WORKER),
+        service_dir=svc_dir, topologies=(Topology(world=2),),
+        policy=RecoveryPolicy(), poll_s=0.05, grace_seconds=10.0,
+        worker_obs=True, worker_flight=False,
+        extra_env={"MMLSPARK_TPU_FLEET": fleet_dir})).run()
+    assert report.ok, report.reason
+
+    expected: dict = {}
+    for rank in (0, 1):
+        with open(os.path.join(svc_dir, f"truth_{rank}.json")) as fh:
+            for k, v in json.load(fh).items():
+                expected[k] = expected.get(k, 0.0) + v
+    view = FleetCollector(fleet_dir).collect()
+    merged = _counter_truth([view.registry])
+    assert merged == expected  # bit-for-bit: sums of exact increments
+    assert merged["train.steps"] == 25  # 10 + 15
+    assert merged["serve.test_bytes"] == 3.5 * 25
+
+    # per-process gauges distinguishable: same metric, same host label,
+    # two pid labels
+    gauges = view.registry.snapshot()["gauges"]
+    wait = {k: v for k, v in gauges.items()
+            if k.startswith("train.input.wait_fraction")}
+    assert len(wait) == 2
+    assert sorted(wait.values()) == [0.25, 0.5]
+    # a series that already carries host= keeps its own attribution
+    step_ms = {k: v for k, v in gauges.items()
+               if k.startswith("train.host_step_ms")}
+    assert sorted(step_ms.values()) == [10.0, 11.0]
+    assert any("host=0" in k for k in step_ms)
+    assert any("host=1" in k for k in step_ms)
+
+
+# ---------------------------------------------------------------------------
+# clock skew: injected ±50 ms corrected at the fence seam
+# ---------------------------------------------------------------------------
+
+
+def _write_snapshot(fleet_dir, host, pid, wall_s, records, seq=1):
+    pdir = os.path.join(fleet_dir, f"proc_{host}_{pid}")
+    os.makedirs(pdir, exist_ok=True)
+    payload = {
+        "fleet": 1, "host": host, "pid": pid, "seq": seq,
+        "reason": "interval",
+        "stamp": {"wall_s": wall_s, "perf_ns": 0},
+        "registry": [],
+        "ring": records,
+    }
+    with open(os.path.join(pdir, f"snap_{seq:06d}.json"), "w") as fh:
+        json.dump(payload, fh)
+
+
+def _span(name, start_ms, dur_ms, span_id, tid=1):
+    return {"name": name, "cat": "train",
+            "start_ns": int(start_ms * 1e6), "dur_ns": int(dur_ms * 1e6),
+            "tid": tid, "thread_name": "T", "span_id": span_id,
+            "parent_id": None, "depth": 0, "labels": {}}
+
+
+def test_injected_50ms_skew_corrected_at_fence_seam(tmp_path):
+    """Host B's wall clock reads +50 ms ahead of host A's. The fenced
+    span (train/liveness_sync) ends at the same REAL instant on both —
+    after correction the fleet export must order B's pre-fence span
+    BEFORE A's post-fence span (naive wall ordering has it after), and
+    the two fence midpoints must land within ~2 ms of each other."""
+    d = str(tmp_path / "fleet")
+    # host A (reference): fence spans ending at perf 100 ms and 200 ms,
+    # a post-fence span at 101 ms
+    _write_snapshot(d, "hostA", 11, 1000.0, [
+        _span("train/liveness_sync", 95.0, 5.0, 1),
+        _span("train/liveness_sync", 195.0, 5.0, 2),
+        _span("after_fence", 101.0, 1.0, 3),
+    ])
+    # host B: SAME perf timeline (its fences end at the same real
+    # instants), but its wall stamp is +50 ms skewed
+    _write_snapshot(d, "hostB", 22, 1000.050, [
+        _span("train/liveness_sync", 95.0, 5.0, 1),
+        _span("train/liveness_sync", 195.0, 5.0, 2),
+        _span("before_second_fence", 150.0, 1.0, 3),
+    ])
+    view = FleetCollector(d).collect()
+    by_name = {p.host: p for p in view.processes}
+    assert by_name["hostA"].skew_ms == 0.0
+    assert by_name["hostB"].skew_ms == pytest.approx(-50.0, abs=0.5)
+
+    trace = view.chrome_trace()
+    meta = trace["fleetMeta"]
+    assert meta["unaligned"] == []
+    assert meta["stitched_flows"] == 2  # both fences cross 2 processes
+    spans = {(ev["args"]["host"], ev["name"]): ev
+             for ev in trace["traceEvents"] if ev.get("ph") == "X"}
+    fence_a = spans[("hostA", "train/liveness_sync")]
+    fence_b = spans[("hostB", "train/liveness_sync")]
+    assert abs((fence_a["ts"] + fence_a["dur"])
+               - (fence_b["ts"] + fence_b["dur"])) < 2e3  # < 2 ms (µs)
+    # ordering across hosts is REAL-time: B's 150 ms span precedes A's
+    # second fence (naive wall clock would put it 50 ms later)
+    assert spans[("hostB", "before_second_fence")]["ts"] \
+        < spans[("hostA", "train/liveness_sync")]["ts"] + 100e3
+
+
+def test_fence_matching_is_per_name_and_tail_aligned(tmp_path):
+    """Ring retention drops the OLDEST records: a process that lost its
+    early fence spans must still pair its surviving fences with the
+    reference's corresponding ones (tail alignment — head alignment
+    would compute a correction of the wrong SIGN here), and a process
+    whose only fences are a different collective (serve lockstep vs
+    train liveness) must not be matched against it at all."""
+    d = str(tmp_path / "fleet")
+    # host A (reference): lost its first fence to ring eviction — keeps
+    # the fences ending at real 200 ms and 300 ms
+    _write_snapshot(d, "hostA", 11, 1000.0, [
+        _span("train/liveness_sync", 195.0, 5.0, 1),
+        _span("train/liveness_sync", 295.0, 5.0, 2),
+    ])
+    # host B: +50 ms wall skew, all three fences retained
+    _write_snapshot(d, "hostB", 22, 1000.050, [
+        _span("train/liveness_sync", 95.0, 5.0, 1),
+        _span("train/liveness_sync", 195.0, 5.0, 2),
+        _span("train/liveness_sync", 295.0, 5.0, 3),
+    ])
+    # host C: a serve process whose fences are a DIFFERENT collective
+    # at unrelated times — no shared fence name with the reference, so
+    # it must keep correction 0, never a bogus median
+    _write_snapshot(d, "hostC", 33, 1000.0, [
+        _span("serve/lockstep_agree", 40.0, 2.0, 1),
+        _span("serve/lockstep_agree", 70.0, 2.0, 2),
+    ])
+    view = FleetCollector(d).collect()
+    skew = {p.host: p.skew_ms for p in view.processes}
+    assert skew["hostA"] == 0.0
+    assert skew["hostB"] == pytest.approx(-50.0, abs=0.5)
+    assert skew["hostC"] == 0.0
+    # stitching pairs from the tail too: B's LAST two fences join A's,
+    # its orphaned earliest fence stitches nothing
+    assert view.chrome_trace()["fleetMeta"]["stitched_flows"] == 2
+
+
+def test_missing_stamp_pair_reported_unaligned(tmp_path):
+    d = str(tmp_path / "fleet")
+    _write_snapshot(d, "hostA", 11, 1000.0, [_span("s", 1.0, 1.0, 1)])
+    pdir = os.path.join(d, "proc_hostB_22")
+    os.makedirs(pdir)
+    with open(os.path.join(pdir, "snap_000001.json"), "w") as fh:
+        json.dump({"fleet": 1, "host": "hostB", "pid": 22, "seq": 1,
+                   "reason": "interval", "registry": [],
+                   "ring": [_span("s", 1.0, 1.0, 1)]}, fh)
+    view = FleetCollector(d).collect()
+    assert view.unaligned() == ["proc_hostB_22"]
+    meta = view.chrome_trace()["fleetMeta"]
+    assert meta["unaligned"] == ["proc_hostB_22"]
+    # the unplaceable process's records are excluded from the timeline
+    pids = {ev.get("pid") for ev in view.chrome_trace()["traceEvents"]
+            if ev.get("ph") == "X"}
+    assert pids == {11}
+
+
+# ---------------------------------------------------------------------------
+# exporter hygiene: threads, retention, crash snapshot via flight hook
+# ---------------------------------------------------------------------------
+
+
+def test_exporter_no_stray_threads_and_retention(tmp_path):
+    d = str(tmp_path / "fleet")
+    exp = obs_fleet.enable(d, interval_s=30.0, retention=3)
+    assert obs_ts.enabled()  # the sampler rides the exporter
+    for _ in range(6):
+        exp.snapshot("interval")
+    snaps = [n for n in os.listdir(exp.proc_dir)
+             if n.startswith("snap_")]
+    assert len(snaps) == 3  # bounded retention, newest kept
+    obs_fleet.disable()
+    assert not obs_ts.enabled()
+    names = [t.name for t in threading.enumerate()]
+    assert "FleetExporter" not in names
+    assert "TimeSeriesSampler" not in names
+    # the exit snapshot is the final word
+    view = FleetCollector(d).collect()
+    assert view.processes[0].reason == "exit"
+
+
+def test_exporter_seq_resumes_past_existing_snapshots(tmp_path):
+    """A disable()/enable() cycle (or reconfigure) in one process must
+    resume seq past the snapshots already on disk — restarting at 0
+    would make the name-sorted retention sweep prune the FRESH
+    snapshots while keeping stale ones as 'newest truth'."""
+    d = str(tmp_path / "fleet")
+    exp = obs_fleet.enable(d, interval_s=30.0, retention=3)
+    obs.registry().counter("serve.gen").add(1)
+    for _ in range(4):
+        exp.snapshot("interval")
+    obs_fleet.disable()  # exit snapshot; highest seq on disk
+    obs.registry().counter("serve.gen").add(1)  # now 2
+    exp2 = obs_fleet.enable(d, interval_s=30.0, retention=3)
+    path = exp2.snapshot("interval")
+    assert path is not None and os.path.exists(path)  # not self-pruned
+    obs_fleet.disable()
+    view = FleetCollector(d).collect()
+    assert view.counter_value("serve.gen") == 2  # the NEW truth won
+    assert view.processes[0].seq > 5
+
+
+def test_publish_fleet_survives_rank_labeled_worker_counter(tmp_path):
+    """Worker code is arbitrary: a train.* counter already labeled
+    rank= must not TypeError the supervisor's watch loop — the fleet
+    rank dimension overrides it."""
+    from mmlspark_tpu.train.service import (
+        RecoveryPolicy, ServiceConfig, TrainSupervisor, _Worker,
+    )
+    obs.enable()
+    sup = TrainSupervisor(ServiceConfig(
+        cmd=("true",), service_dir=str(tmp_path),
+        policy=RecoveryPolicy()))
+    w = _Worker.__new__(_Worker)
+    w.rank, w.counter_last, w.straggler_hits = 0, {}, 0
+    beacons = {0: {"progress": 1, "stragglers": 0, "host_step_ms": {},
+                   "counters": [["train.custom", {"rank": "9"}, 5.0]]}}
+    sup._publish_fleet([w], beacons, sup._fleet_aggregates(beacons))
+    assert obs.registry().value("train.fleet.custom", rank=0) == 5
+
+
+def test_enable_idempotent_same_dir(tmp_path):
+    d = str(tmp_path / "fleet")
+    exp1 = obs_fleet.enable(d, interval_s=30.0)
+    exp2 = obs_fleet.enable(d, interval_s=30.0)
+    assert exp1 is exp2  # no teardown/rebuild on an ensure-on call
+
+
+def test_flight_crash_dump_flushes_fleet_snapshot_order_pinned(tmp_path):
+    """The pinned hook order: the flight post-mortem lands on disk
+    FIRST, then the fleet exporter flushes a snapshot whose extra
+    names that dump path — so the fleet plane's last word about a
+    crashed process both exists and points at the local forensics."""
+    obs.enable()
+    obs_flight.enable(str(tmp_path / "flight"), poll_s=30.0)
+    obs_fleet.enable(str(tmp_path / "fleet"), interval_s=30.0)
+    try:
+        exc = ValueError("induced crash")
+        dump_path = obs_flight.on_crash(exc, context="test")
+        assert dump_path is not None and os.path.exists(dump_path)
+        proc_dir = obs_fleet.exporter().proc_dir
+        snaps = sorted(n for n in os.listdir(proc_dir)
+                       if n.startswith("snap_"))
+        with open(os.path.join(proc_dir, snaps[-1])) as fh:
+            snap = json.load(fh)
+        assert snap["reason"] == "flight_crash"
+        assert snap["extra"]["flight_dump"] == dump_path
+        # order pinned: the snapshot's registry already carries the
+        # flight.dumps counter bump — proof the dump completed first
+        dumps = [r for r in snap["registry"]
+                 if r["name"] == "flight.dumps"]
+        assert dumps and dumps[0]["value"] == 1
+    finally:
+        obs_fleet.disable()
+        obs_flight.disable()
+    names = [t.name for t in threading.enumerate()]
+    assert "FleetExporter" not in names
+    assert "FlightWatchdog" not in names
+
+
+def test_collector_missing_dir_typed(tmp_path):
+    with pytest.raises(FleetReadError):
+        FleetCollector(str(tmp_path / "nope")).collect()
+    os.makedirs(str(tmp_path / "empty"))
+    with pytest.raises(FleetReadError):
+        FleetCollector(str(tmp_path / "empty")).collect()
+
+
+def test_histogram_merge_window_holds_every_process(tmp_path):
+    """The fleet histogram's window is sized to the whole merged
+    concatenation — interning at the default window would evict the
+    first processes' samples in directory order and bias the fleet
+    quantiles toward whichever process merged last."""
+    d = str(tmp_path / "fleet")
+    # two processes each exporting a FULL default-sized window: the
+    # naive merge would keep only the last 4096 of the 8192 values
+    for pid, base in ((11, 0.0), (22, 10000.0)):
+        pdir = os.path.join(d, f"proc_h_{pid}")
+        os.makedirs(pdir)
+        values = [base + k for k in range(4096)]
+        with open(os.path.join(pdir, "snap_000001.json"), "w") as fh:
+            json.dump({
+                "fleet": 1, "host": "h", "pid": pid, "seq": 1,
+                "reason": "exit",
+                "stamp": {"wall_s": 1.0, "perf_ns": 0},
+                "registry": [{"kind": "histogram", "name": "serve.e2e_ms",
+                              "labels": [["model", "m"]],
+                              "count": len(values), "sum": sum(values),
+                              "window": values}],
+                "ring": []}, fh)
+    view = FleetCollector(d).collect()
+    h = view.registry.histogram("serve.e2e_ms", model="m")
+    assert h.count == 8192
+    assert len(h.values()) == 8192  # both processes' windows retained
+    pct = h.percentiles()
+    assert 2000.0 < pct["p50"] < 10000.0  # spans BOTH processes
+
+
+def test_registry_only_collect_reads_newest_snapshot(tmp_path):
+    d = str(tmp_path / "fleet")
+    pdir = os.path.join(d, "proc_h_11")
+    os.makedirs(pdir)
+    for seq, total in ((1, 5.0), (2, 9.0)):
+        with open(os.path.join(pdir, f"snap_{seq:06d}.json"), "w") as fh:
+            json.dump({
+                "fleet": 1, "host": "h", "pid": 11, "seq": seq,
+                "reason": "interval",
+                "stamp": {"wall_s": 1.0, "perf_ns": 0},
+                "registry": [{"kind": "counter", "name": "serve.total",
+                              "labels": [], "value": total}],
+                "ring": [_span("s", 1.0, 1.0, seq)]}, fh)
+    view = FleetCollector(d).collect(include_ring=False)
+    assert view.counter_value("serve.total") == 9.0  # newest wins
+    assert view.processes[0].records == []  # ring skipped entirely
+    # a torn newest snapshot falls back to the previous one
+    with open(os.path.join(pdir, "snap_000003.json"), "w") as fh:
+        fh.write("{torn")
+    view = FleetCollector(d).collect(include_ring=False)
+    assert view.counter_value("serve.total") == 9.0
+
+
+def test_abandoned_server_source_is_not_pinned(tmp_path):
+    """A ModelServer discarded WITHOUT close() (e.g. after a failed
+    load) must not be kept alive — and kept exporting its dead series
+    — by the module-global registry-source list."""
+    import gc
+    import weakref as _weakref
+
+    from mmlspark_tpu.serve import ModelServer, ServeConfig
+
+    server = ModelServer(ServeConfig(buckets=(1,)))
+    n_before = len(obs_fleet.all_registries())
+    ref = _weakref.ref(server)
+    del server
+    gc.collect()
+    assert ref() is None  # the source list held it only weakly
+    assert len(obs_fleet.all_registries()) == n_before  # no dead entry
+
+
+def test_histograms_merge_windows_and_counts(tmp_path):
+    d = str(tmp_path / "fleet")
+    for pid, values in ((11, [1.0, 2.0]), (22, [3.0, 4.0, 5.0])):
+        pdir = os.path.join(d, f"proc_h_{pid}")
+        os.makedirs(pdir)
+        with open(os.path.join(pdir, "snap_000001.json"), "w") as fh:
+            json.dump({
+                "fleet": 1, "host": "h", "pid": pid, "seq": 1,
+                "reason": "exit",
+                "stamp": {"wall_s": 1.0, "perf_ns": 0},
+                "registry": [{"kind": "histogram", "name": "serve.e2e_ms",
+                              "labels": [["model", "m"]],
+                              "count": len(values), "sum": sum(values),
+                              "window": values}],
+                "ring": []}, fh)
+    view = FleetCollector(d).collect()
+    h = view.registry.histogram("serve.e2e_ms", model="m")
+    assert h.count == 5 and h.sum == 15.0
+    assert sorted(h.values()) == [1.0, 2.0, 3.0, 4.0, 5.0]
+
+
+# ---------------------------------------------------------------------------
+# timeseries: ring + JSONL + query API
+# ---------------------------------------------------------------------------
+
+
+def test_metric_history_ring_query_and_rate(tmp_path):
+    hist = MetricHistory(maxlen=4)
+    for k in range(6):
+        hist.append(100.0 + k, "serve.queue_depth{model=m}", 2.0 * k)
+    got = hist.range("serve.queue_depth")
+    assert list(got) == ["serve.queue_depth{model=m}"]
+    samples = got["serve.queue_depth{model=m}"]
+    assert len(samples) == 4  # ring bound: oldest evicted
+    assert samples[0] == (102.0, 4.0) and samples[-1] == (105.0, 10.0)
+    # time-bounded range
+    got = hist.range("serve.queue_depth", t0=104.0)
+    assert len(got["serve.queue_depth{model=m}"]) == 2
+    # last-N
+    assert hist.last("serve.queue_depth", n=1)[
+        "serve.queue_depth{model=m}"] == [(105.0, 10.0)]
+    # rate over the full ring: dv/dt = 6/3
+    rates = hist.rate("serve.queue_depth")
+    assert rates["serve.queue_depth{model=m}"] == pytest.approx(2.0)
+
+
+def test_sampler_selects_prefixes_and_persists_jsonl(tmp_path):
+    path = str(tmp_path / "ts.jsonl")
+    reg = MetricsRegistry()
+    reg.gauge("serve.slo_burn_short", model="m").set(3.0)
+    reg.counter("train.service.restarts").add(2)
+    reg.counter("plan.h2d_uploads").add(9)  # not a sampled prefix
+    sampler = TimeSeriesSampler(registries=lambda: [reg], path=path,
+                                interval_s=30.0)
+    n = sampler.sample(now=100.0)
+    reg.gauge("serve.slo_burn_short", model="m").set(4.0)
+    n2 = sampler.sample(now=101.0)
+    assert n == 2 and n2 == 2
+    burn = sampler.history.range("serve.slo_burn_short")
+    assert burn["serve.slo_burn_short{model=m}"] == [(100.0, 3.0),
+                                                     (101.0, 4.0)]
+    assert not sampler.history.range("plan.h2d_uploads")
+    sampler.close()
+    # the JSONL round-trips to the same observations
+    loaded = MetricHistory.load(path)
+    assert loaded.range("serve.slo_burn_short")[
+        "serve.slo_burn_short{model=m}"][:2] == [(100.0, 3.0),
+                                                 (101.0, 4.0)]
+    assert "train.service.restarts" in {
+        k.split("{")[0] for k in loaded.keys()}
+
+
+def test_timeseries_module_enable_disable_threads():
+    obs_ts.enable(interval_s=30.0)
+    assert obs_ts.enabled()
+    assert any(t.name == "TimeSeriesSampler"
+               for t in threading.enumerate())
+    obs_ts.disable()
+    assert not any(t.name == "TimeSeriesSampler"
+                   for t in threading.enumerate())
+    assert obs_ts.range_("serve.slo_burn_short") == {}
+
+
+# ---------------------------------------------------------------------------
+# supervisor fleet aggregation (unit: beacons in, train.fleet.* out)
+# ---------------------------------------------------------------------------
+
+
+def test_supervisor_publishes_fleet_aggregates_from_beacons(tmp_path):
+    from mmlspark_tpu.train.service import (
+        RecoveryPolicy, ServiceConfig, TrainSupervisor, _Worker,
+    )
+
+    class _P:  # a poll-able stand-in for subprocess.Popen
+        pid = 1
+
+        def poll(self):
+            return None
+
+    obs.enable()
+    sup = TrainSupervisor(ServiceConfig(
+        cmd=("true",), service_dir=str(tmp_path),
+        policy=RecoveryPolicy()))
+    w0, w1 = _Worker.__new__(_Worker), _Worker.__new__(_Worker)
+    for i, w in enumerate((w0, w1)):
+        w.rank, w.proc, w.counter_last = i, _P(), {}
+        w.straggler_hits = 0
+    beacons = {
+        0: {"progress": 7, "stragglers": 2,
+            "host_step_ms": {"0": 5.0, "1": 40.0},
+            "counters": [["train.steps", {}, 7.0]]},
+        1: {"progress": 9, "stragglers": 2, "host_step_ms": {},
+            "counters": [["train.steps", {}, 9.0]]},
+    }
+    agg = sup._fleet_aggregates(beacons)
+    assert agg == {"workers": 2, "progress": 16,
+                   "straggler_windows": 2,
+                   "host_step_ms": {"0": 5.0, "1": 40.0}}
+    sup._publish_fleet([w0, w1], beacons, agg)
+    reg = obs.registry()
+    assert reg.value("train.fleet.workers") == 2
+    assert reg.value("train.fleet.progress") == 16
+    assert reg.value("train.fleet.straggler_windows") == 2
+    assert reg.value("train.fleet.host_step_ms", host="1") == 40.0
+    assert reg.value("train.fleet.steps", rank=0) == 7
+    assert reg.value("train.fleet.steps", rank=1) == 9
+    # second poll: only the DELTA accumulates
+    beacons[0]["counters"] = [["train.steps", {}, 12.0]]
+    sup._publish_fleet([w0, w1], beacons,
+                       sup._fleet_aggregates(beacons))
+    assert reg.value("train.fleet.steps", rank=0) == 12
+    # a backward value (worker restart, fresh registry) re-accumulates
+    beacons[0]["counters"] = [["train.steps", {}, 3.0]]
+    sup._publish_fleet([w0, w1], beacons,
+                       sup._fleet_aggregates(beacons))
+    assert reg.value("train.fleet.steps", rank=0) == 15
+    # terminal beacons (the final read after a clean completion) fold
+    # in counter deltas but are NOT live workers — the liveness gauge
+    # must not report dead workers on an idle supervisor
+    for b in beacons.values():
+        b["status"] = "exited"
+    agg = sup._fleet_aggregates(beacons)
+    assert agg["workers"] == 0 and agg["progress"] == 16
+    sup._publish_fleet([w0, w1], beacons, agg)
+    assert reg.value("train.fleet.workers") == 0
+
+
+# ---------------------------------------------------------------------------
+# the serve /fleet endpoint
+# ---------------------------------------------------------------------------
+
+
+def test_http_fleet_endpoint_json_prometheus_and_404(tmp_path):
+    import urllib.error
+    import urllib.request
+
+    from mmlspark_tpu.serve import ModelServer, ServeConfig
+    from mmlspark_tpu.serve.http import start_http_server
+
+    server = ModelServer(ServeConfig(buckets=(1,)))
+    httpd = start_http_server(server, host="127.0.0.1", port=0)
+    port = httpd.server_address[1]
+    try:
+        # no fleet dir configured -> typed 404
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/fleet", timeout=10)
+        assert ei.value.code == 404
+        assert json.loads(ei.value.read())["error"] == \
+            "FleetNotConfigured"
+
+        obs.enable()
+        obs.registry().counter("serve.test_total").add(4)
+        exp = obs_fleet.enable(str(tmp_path / "fleet"), interval_s=30.0)
+        exp.snapshot("manual")
+        body = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/fleet", timeout=10).read())
+        assert body["fleet"] == 1
+        assert len(body["processes"]) == 1
+        assert body["metrics"]["counters"]["serve.test_total"] == 4
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/fleet",
+            headers={"Accept": "text/plain"})
+        text = urllib.request.urlopen(req, timeout=10).read().decode()
+        assert "# HELP serve_test_total" in text
+        assert "# TYPE serve_test_total counter" in text
+        assert "serve_test_total 4" in text
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# tools/fleet.py CLI
+# ---------------------------------------------------------------------------
+
+
+def _load_fleet_cli():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "mmlspark_tools_fleet",
+        os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "fleet.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_fleet_cli_status_metrics_trace_watch(tmp_path, capsys):
+    cli = _load_fleet_cli()
+    d = str(tmp_path / "fleet")
+    obs.enable()
+    obs.registry().counter("serve.cli_total").add(2)
+    with obs.span("train/step", "train"):
+        time.sleep(0.001)
+    exp = obs_fleet.enable(d, interval_s=30.0)
+    exp.snapshot("manual")
+
+    assert cli.main(["status", d]) == 0
+    out = capsys.readouterr().out
+    assert "1 process(es)" in out and "manual" in out
+
+    assert cli.main(["metrics", d]) == 0
+    body = json.loads(capsys.readouterr().out)
+    assert body["metrics"]["counters"]["serve.cli_total"] == 2
+
+    assert cli.main(["metrics", d, "--prom"]) == 0
+    assert "# TYPE serve_cli_total counter" in capsys.readouterr().out
+
+    trace_out = str(tmp_path / "fleet_trace.json")
+    assert cli.main(["trace", d, "--out", trace_out]) == 0
+    line = json.loads(capsys.readouterr().out)
+    assert line["trace"] == trace_out and line["unaligned"] == []
+    assert os.path.exists(trace_out)
+
+    assert cli.main(["watch", d, "--interval", "0.01",
+                     "--iterations", "2"]) == 0
+    assert capsys.readouterr().out.count("1 process(es)") == 2
+
+    # missing dir: one typed line, exit 2
+    assert cli.main(["metrics", str(tmp_path / "nope")]) == 2
+    assert "fleet:" in capsys.readouterr().err
+    # an existing-but-empty dir: status fails typed too (a deploy gate
+    # scripting `status && ...` must not pass on an empty fleet);
+    # watch stays tolerant — waiting for the first export is its job
+    empty = str(tmp_path / "empty")
+    os.makedirs(empty)
+    assert cli.main(["status", empty]) == 2
+    assert "no process snapshot" in capsys.readouterr().err
+    assert cli.main(["watch", empty, "--interval", "0.01",
+                     "--iterations", "1"]) == 0
+    assert "0 process(es)" in capsys.readouterr().out
+    # watch also tolerates a NOT-YET-CREATED dir (exporters create it
+    # lazily on enable — waiting for the first process is watch's job)
+    assert cli.main(["watch", str(tmp_path / "later"), "--interval",
+                     "0.01", "--iterations", "2"]) == 0
+    assert capsys.readouterr().out.count("not created yet") == 2

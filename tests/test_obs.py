@@ -1054,3 +1054,49 @@ def test_prometheus_text_merges_registries_and_escapes_labels():
     assert text.count("# TYPE serve_admitted counter") == 1
     assert 'serve_admitted{model="a"} 1' in text
     assert 'serve_admitted{model="b\\"\\\\q"} 2' in text
+
+
+def test_prometheus_help_lines_per_family():
+    """# HELP rides next to every # TYPE header: curated text for the
+    known metric families, the generic fallback (naming the original
+    dotted spelling) for the rest — and ONE pair per name across
+    merged registries (the fleet-merged path hands several per-host
+    registries to one exposition)."""
+    from mmlspark_tpu.obs.export import prometheus_text
+    from mmlspark_tpu.obs.metrics import MetricsRegistry
+    r1, r2 = MetricsRegistry(), MetricsRegistry()
+    r1.gauge("serve.queue_depth", model="a").set(2)
+    r2.gauge("serve.queue_depth", model="b").set(3)
+    r1.counter("totally.custom_metric").add(1)
+    lines = prometheus_text([r1, r2]).splitlines()
+    # curated help, once, immediately before its TYPE header
+    assert lines.count("# HELP serve_queue_depth Live admission-queue "
+                       "depth (the replica autoscaling signal).") == 1
+    i = lines.index("# TYPE serve_queue_depth gauge")
+    assert lines[i - 1].startswith("# HELP serve_queue_depth ")
+    # generic fallback keeps the original dotted name greppable
+    fallback = [ln for ln in lines
+                if ln.startswith("# HELP totally_custom_metric ")]
+    assert len(fallback) == 1
+    assert "totally.custom_metric" in fallback[0]
+    # every TYPE header has a HELP partner
+    types = [ln.split()[2] for ln in lines if ln.startswith("# TYPE")]
+    helps = [ln.split()[2] for ln in lines if ln.startswith("# HELP")]
+    assert types == helps
+
+
+def test_prometheus_text_byte_stable():
+    """The non-fleet path is byte-stable: two expositions of the same
+    registry state are identical bytes (scrape diffing, content
+    hashing, and the docs' determinism claim all rely on it)."""
+    from mmlspark_tpu.obs.export import prometheus_text
+    reg = obs.registry()
+    reg.counter("serve.admitted", model="m").add(3)
+    reg.gauge("serve.queue_depth", model="m").set(2)
+    h = reg.histogram("serve.e2e_ms", window=16, model="m")
+    for v in (1.0, 2.0, 3.0):
+        h.observe(v)
+    first = prometheus_text()
+    second = prometheus_text()
+    assert first == second
+    assert first.encode("utf-8") == second.encode("utf-8")

@@ -13,8 +13,8 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
 
 from perf_smoke import (  # noqa: E402
-    check_fused_crossings, check_flight_recorder, check_obs_overhead,
-    check_obs_request_tracing, check_serve_batching,
+    check_fleet_obs, check_fused_crossings, check_flight_recorder,
+    check_obs_overhead, check_obs_request_tracing, check_serve_batching,
     check_serve_lifecycle, check_serve_lowprec, check_serve_sharded,
     check_spmd_clean, check_train_device_preprocess,
     check_train_elastic, check_train_prefetch,
@@ -88,6 +88,26 @@ def test_obs_request_tracing_links_intact_across_replica_lanes():
     assert result["replicas_used"] == [0, 1, 2, 3]
     assert result["max_pack_fan_in"] > 1
     assert result["flow_ids_exported"] == result["requests"]
+
+
+def test_fleet_obs_merges_bit_equal_and_renders_aligned_timeline():
+    """Fleet telemetry plane (round 17): a dp=4 serve burst plus a
+    2-worker supervised run under one MMLSPARK_TPU_FLEET dir merge into
+    fleet counters bit-equal to the summed per-process registries, the
+    clock-aligned fleet Perfetto trace renders exit-0 through
+    tools/trace.py with >= 1 flow stitched at the fence seams, the
+    supervisor aggregates worker beacon deltas into train.fleet.*, and
+    every serve.slo_burn_* gauge has >= 3 timeseries history samples;
+    no exporter/sampler threads survive teardown."""
+    result = check_fleet_obs()
+    assert result["processes"] == 3  # this process + 2 workers
+    assert result["serve_counters"] > 0 and result["train_counters"] > 0
+    assert result["stitched_flows"] >= 1
+    assert result["trace_render_rc"] == 0
+    assert result["fleet_steps_rank0"] == 24
+    for gauge, series in result["burn_gauge_history"].items():
+        assert series and all(n >= 3 for n in series.values()), (
+            f"{gauge}: {series}")
 
 
 def test_flight_recorder_dumps_on_crash_and_hang():

@@ -166,3 +166,93 @@ class TestPostmortem:
         assert "train/step" in out
         assert "train.steps" in out
         assert "serve/m#0" in out
+
+
+class TestRenderFleet:
+    """Fleet-merged traces (obs/fleet.py): multi-pid traceEvents with
+    process-group metadata render with per-host lane counts and the
+    stitched cross-process flow count; a mixed-clock trace (a process
+    without the stamp pair) is the typed exit-2 diagnostic."""
+
+    def _fleet_payload(self, unaligned=()):
+        def span(pid, tid, name, ts, dur):
+            return {"name": name, "cat": "train", "ph": "X", "ts": ts,
+                    "dur": dur, "pid": pid, "tid": tid, "args": {}}
+        events = [
+            span(11, 1, "train/step", 0.0, 50.0),
+            span(11, 1, "train/liveness_sync", 100.0, 5.0),
+            span(11, 2, "plan/dispatch", 10.0, 5.0),
+            span(22, 1, "train/liveness_sync", 101.0, 5.0),
+            # the stitched fence flow crossing both pids
+            {"name": "fleet-fence", "cat": "fleet.fence", "ph": "s",
+             "id": 7, "bp": "e", "ts": 102.5, "pid": 11, "tid": 1},
+            {"name": "fleet-fence", "cat": "fleet.fence", "ph": "f",
+             "id": 7, "bp": "e", "ts": 103.5, "pid": 22, "tid": 1},
+            {"name": "process_name", "ph": "M", "pid": 11,
+             "args": {"name": "hostA pid=11"}},
+            {"name": "process_name", "ph": "M", "pid": 22,
+             "args": {"name": "hostB pid=22"}},
+        ]
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "fleetMeta": {
+                    "fleet": 1,
+                    "hosts": {"hostA": [11], "hostB": [22]},
+                    "processes": [{"process": "proc_hostA_11"},
+                                  {"process": "proc_hostB_22"}],
+                    "stitched_flows": 1,
+                    "unaligned": list(unaligned)}}
+
+    def test_render_fleet_trace_reports_hosts_lanes_flows(
+            self, tmp_path, capsys):
+        path = str(tmp_path / "fleet_trace.json")
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(self._fleet_payload(), fh)
+        rc = trace_cli.main(["render", path])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "fleet trace: 2 host(s), 2 process(es)" in out
+        assert "hostA: 2 lane(s)" in out and "hostB: 1 lane(s)" in out
+        assert "1 stitched cross-process flow(s)" in out
+        assert "train/step" in out  # the span table still aggregates
+        # fence-stitch arrows are barrier structure, not requests: a
+        # capture with zero request traces reports zero request flows
+        assert "request flow(s)" not in out
+
+    def test_render_mixed_clock_fleet_trace_typed_exit_2(
+            self, tmp_path, capsys):
+        path = str(tmp_path / "mixed.json")
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(self._fleet_payload(
+                unaligned=["proc_hostB_22"]), fh)
+        rc = trace_cli.main(["render", path])
+        err = capsys.readouterr().err
+        assert rc == 2
+        assert err.startswith("trace:")
+        assert "mixed-clock" in err and "proc_hostB_22" in err
+        assert "stamp pair" in err
+
+    def test_render_real_fleet_export_round_trips(self, tmp_path,
+                                                  capsys):
+        """What obs/fleet.py actually writes renders exit-0 — the CLI
+        contract is pinned against the real exporter, not a hand-built
+        fixture."""
+        import time as _time
+
+        from mmlspark_tpu.obs import fleet as obs_fleet
+
+        d = str(tmp_path / "fleet")
+        obs.enable()
+        with obs.span("train/step", "train"):
+            _time.sleep(0.001)
+        exp = obs_fleet.enable(d, interval_s=30.0)
+        exp.snapshot("manual")
+        try:
+            view = obs_fleet.FleetCollector(d).collect()
+            path = view.write_chrome_trace(
+                str(tmp_path / "real_fleet.json"))
+        finally:
+            obs_fleet.disable()
+        rc = trace_cli.main(["render", path])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "fleet trace: 1 host(s), 1 process(es)" in out

@@ -1,0 +1,170 @@
+"""fleet — read one fleet telemetry directory from the CLI.
+
+Subcommands (all read-only over ``<fleet-dir>`` — the directory every
+process exports into under ``MMLSPARK_TPU_FLEET``; see
+docs/observability.md §fleet telemetry plane)::
+
+    python tools/fleet.py status <fleet-dir>
+        One row per exporting process: host, pid, snapshot count,
+        newest seq/reason, and the age of its last snapshot (a stale
+        age on a busy process is the first sign of a wedged exporter
+        or a dead worker).
+
+    python tools/fleet.py metrics <fleet-dir> [--prom]
+        The fleet-MERGED registry (counters summed across processes,
+        gauges per host/pid, histogram windows merged) as the JSON
+        snapshot, or as the Prometheus text exposition with --prom —
+        the same bodies the serve ``/fleet`` endpoint negotiates.
+
+    python tools/fleet.py trace <fleet-dir> --out fleet_trace.json
+        Write the clock-aligned fleet Perfetto timeline (one process
+        group per host, skew corrected at the fenced-collective seams,
+        cross-process flows stitched there). Render the file with
+        ``python tools/trace.py render`` or open it in
+        https://ui.perfetto.dev.
+
+    python tools/fleet.py watch <fleet-dir> [--interval 2]
+        [--iterations N]
+        Re-print status on an interval (Ctrl-C to stop; --iterations
+        bounds the loop for scripting).
+
+A missing or empty fleet directory is a typed error: one diagnostic
+line on stderr and exit code 2 (the tools/trace.py discipline) —
+except ``watch``, whose purpose includes waiting for the first process
+to appear, so it keeps printing an empty status instead of failing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _print_status(status: dict) -> None:
+    rows = status["processes"]
+    print(f"fleet dir: {status['fleet_dir']} — {len(rows)} process(es)")
+    if not rows:
+        return
+    width = max(len(str(r["process"])) for r in rows)
+    print(f"{'process':<{width}}  {'snaps':>5}  {'seq':>5}  "
+          f"{'age s':>8}  reason")
+    for r in rows:
+        age = r.get("age_s")
+        print(f"{r['process']:<{width}}  {r['snapshots']:>5}  "
+              f"{str(r.get('seq', '?')):>5}  "
+              f"{age if age is not None else '?':>8}  "
+              f"{r.get('reason', '?')}")
+
+
+def cmd_status(args: argparse.Namespace) -> int:
+    from mmlspark_tpu.obs.fleet import FleetCollector, FleetReadError
+    status = FleetCollector(args.fleet_dir).status()
+    if not status["processes"]:
+        # an operator gating on `status && deploy` must not pass on a
+        # directory nothing has exported into — same typed exit-2 as
+        # metrics/trace on the same input
+        raise FleetReadError(
+            f"fleet dir {args.fleet_dir!r} holds no process snapshot "
+            "directories (has any process exported yet?)")
+    _print_status(status)
+    return 0
+
+
+def cmd_metrics(args: argparse.Namespace) -> int:
+    from mmlspark_tpu.obs.fleet import FleetCollector
+    # registry-only merge — the metrics bodies never read the rings
+    view = FleetCollector(args.fleet_dir).collect(include_ring=False)
+    if args.prom:
+        from mmlspark_tpu.obs.export import prometheus_text
+        sys.stdout.write(prometheus_text([view.registry]))
+        return 0
+    print(json.dumps(view.snapshot(), indent=2, default=str))
+    return 0
+
+
+def cmd_trace(args: argparse.Namespace) -> int:
+    from mmlspark_tpu.obs.fleet import FleetCollector
+    view = FleetCollector(args.fleet_dir).collect()
+    payload = view.chrome_trace()  # built once: the file AND the
+    with open(args.out, "w", encoding="utf-8") as fh:  # summary line
+        json.dump(payload, fh)
+    meta = payload["fleetMeta"]
+    print(json.dumps({
+        "trace": args.out,
+        "hosts": len(meta["hosts"]),
+        "processes": len(meta["processes"]),
+        "stitched_flows": meta["stitched_flows"],
+        "unaligned": meta["unaligned"],
+    }))
+    return 0
+
+
+def cmd_watch(args: argparse.Namespace) -> int:
+    from mmlspark_tpu.obs.fleet import FleetCollector, FleetReadError
+    collector = FleetCollector(args.fleet_dir)
+    k = 0
+    try:
+        while True:
+            try:
+                _print_status(collector.status())
+            except FleetReadError:
+                # exporters create the directory lazily on enable():
+                # waiting for the first process to appear — including
+                # before the dir itself exists — is watch's whole job
+                print(f"fleet dir: {args.fleet_dir} — not created yet, "
+                      "waiting")
+            k += 1
+            if args.iterations is not None and k >= args.iterations:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:  # pragma: no cover - interactive stop
+        return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fleet", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    for name, helptext in (
+            ("status", "per-process snapshot ages"),
+            ("metrics", "fleet-merged registry"),
+            ("trace", "write the clock-aligned fleet timeline"),
+            ("watch", "status on an interval")):
+        p = sub.add_parser(name, help=helptext)
+        p.add_argument("fleet_dir",
+                       help="the MMLSPARK_TPU_FLEET directory")
+        if name == "metrics":
+            p.add_argument("--prom", action="store_true",
+                           help="Prometheus text exposition instead of "
+                                "the JSON snapshot")
+        if name == "trace":
+            p.add_argument("--out", default="fleet_trace.json",
+                           help="output Chrome-trace path")
+        if name == "watch":
+            p.add_argument("--interval", type=float, default=2.0)
+            p.add_argument("--iterations", type=int, default=None,
+                           help="stop after N prints (default: forever)")
+
+    args = ap.parse_args(argv if argv is not None else sys.argv[1:])
+    from mmlspark_tpu.obs.fleet import FleetReadError
+    try:
+        if args.cmd == "status":
+            return cmd_status(args)
+        if args.cmd == "metrics":
+            return cmd_metrics(args)
+        if args.cmd == "trace":
+            return cmd_trace(args)
+        return cmd_watch(args)
+    except FleetReadError as e:
+        print(f"fleet: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

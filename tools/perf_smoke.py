@@ -82,6 +82,17 @@ flake on a loaded CI box):
   was coalesced into, every flow exports as Perfetto flow events, and
   all four replica lanes participate (the latency-bound model makes the
   fan-out deterministic, as in the sharded gate).
+* **fleet observability** — a dp=4 serve burst plus a 2-worker
+  supervised training run exporting telemetry snapshots under ONE
+  ``MMLSPARK_TPU_FLEET`` directory (obs/fleet.py) must merge into
+  fleet counters BIT-EQUAL to the summed per-process registries, a
+  clock-aligned fleet Perfetto trace (``tools/trace.py render`` exit 0,
+  cross-process flows stitched at the fenced-collective seams),
+  supervisor-published ``train.fleet.*`` aggregates from the worker
+  beacons, and a non-empty timeseries history (>= 3 samples) for every
+  ``serve.slo_burn_*`` gauge — with no exporter/sampler threads
+  surviving teardown (``check_obs_overhead`` keeps gating the
+  disabled path: exporter off = one attribute check).
 * **flight recorder** — an induced mid-run crash (a NaN'd batch dying
   on the typed ``NonFiniteLossError``) and an induced hang (a serve-lane
   dispatch held inside its compiled program past the recorder's
@@ -1455,6 +1466,224 @@ def check_flight_recorder() -> dict:
     return out
 
 
+# the gate's jax-free supervised worker: records train spans + counters
+# through the obs substrate (tracer on via the supervisor's
+# MMLSPARK_TPU_OBS, fleet exporter on via the propagated
+# MMLSPARK_TPU_FLEET), writes its registry-counter TRUTH file for the
+# bit-equality assertion, then flushes its final fleet snapshot
+_FLEET_WORKER_SRC = """
+import json, os, time
+from mmlspark_tpu import obs
+from mmlspark_tpu.obs import fleet
+from mmlspark_tpu.obs.metrics import Counter, format_series
+from mmlspark_tpu.train.service import service_context
+
+with service_context(beacon_interval_s=0.05) as info:
+    assert info is not None
+    assert obs.enabled()        # MMLSPARK_TPU_OBS=1 from the supervisor
+    assert fleet.enabled()      # MMLSPARK_TPU_FLEET propagated
+    reg = obs.registry()
+    for k in range(24):
+        with obs.span("train/step", "train"):
+            time.sleep(0.0005)
+        reg.counter("train.steps").add()
+        reg.counter("train.commits", loader="w%d" % info.rank).add(2)
+        if k % 8 == 0:
+            # the fenced-collective seam the fleet trace stitches at
+            with obs.span("train/liveness_sync", "train"):
+                time.sleep(0.002)
+    reg.gauge("train.host_step_ms", host=str(info.rank)).set(
+        1.0 + info.rank)
+    time.sleep(0.2)  # >= one beacon interval with the final counters
+    truth = {format_series(m.name, m.labels): m.value
+             for m in reg.iter_metrics() if isinstance(m, Counter)}
+    with open(os.path.join(info.service_dir,
+                           "truth_%d.json" % info.rank), "w") as f:
+        json.dump(truth, f)
+    fleet.disable()  # final exit snapshot AFTER the truth capture
+"""
+
+
+def check_fleet_obs() -> dict:
+    """The fleet telemetry plane (obs/fleet.py + obs/timeseries.py): a
+    dp=4 serve burst plus a 2-worker supervised run exporting under ONE
+    ``MMLSPARK_TPU_FLEET`` directory must merge into a fleet view whose
+    summed ``serve.*``/``train.*`` counters are BIT-EQUAL to the sum of
+    the per-process registries (this process's + both workers' truth
+    files), render a clock-aligned fleet Perfetto trace that
+    ``tools/trace.py render`` accepts exit-0 (with >= 1 stitched
+    cross-process flow at the workers' fence seams), and leave a
+    non-empty timeseries history (>= 3 samples) for every
+    ``serve.slo_burn_*`` gauge — the metric HISTORY the adaptive-ladder
+    and autoscaling actuators consume. Teardown is pinned: no
+    FleetExporter/TimeSeriesSampler threads survive, and the tracer is
+    left disabled so ``check_obs_overhead`` stays honest."""
+    import json as _json
+    import shutil
+    import sys as _sys
+    import tempfile
+    import threading
+    import time
+
+    import jax
+
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.data.table import DataTable
+    from mmlspark_tpu.models.jax_model import JaxModel
+    from mmlspark_tpu.obs import fleet as obs_fleet
+    from mmlspark_tpu.obs import timeseries as obs_ts
+    from mmlspark_tpu.obs.metrics import Counter, format_series, registry
+    from mmlspark_tpu.serve import ModelServer, ServeConfig
+    from mmlspark_tpu.train.service import (
+        RecoveryPolicy, ServiceConfig, Topology, TrainSupervisor,
+    )
+
+    if len(jax.devices()) < 4:
+        raise AssertionError(
+            "check_fleet_obs needs >= 4 dryrun devices for the dp=4 "
+            f"serve mesh; got {len(jax.devices())}")
+    fleet_dir = tempfile.mkdtemp(prefix="fleet_obs_")
+    svc_dir = os.path.join(fleet_dir, "service")
+    obs.enable()
+    obs.clear()
+    registry().reset()
+    obs_fleet.enable(fleet_dir, interval_s=0.2)
+    server = None
+    try:
+        # -- 1. the dp=4 serve burst (latency-bound model, as in the
+        #       sharded/tracing gates) + 3 SLO polls, each followed by
+        #       one timeseries sample --
+        bundle, _probe = _latency_bundle(0.004)
+        jm = JaxModel(model=bundle, input_col="x", output_col="scores")
+        server = ModelServer(ServeConfig(
+            buckets=(8,), max_queue=64, deadline_ms=None, mesh="dp=4",
+            slo={"window_s": 2.0, "long_window_s": 4.0,
+                 "min_requests": 1}))
+        rng = np.random.default_rng(0)
+        reqs = [DataTable({"x": list(
+            rng.normal(size=(8, 24)).astype(np.float32))})
+            for _ in range(24)]
+        server.add_model("m", jm, example=reqs[0].take(np.arange(1)))
+        handles = [server.submit("m", r) for r in reqs]
+        outs = [h.result(timeout=120) for h in handles]
+        assert len(outs) == len(reqs)
+        sampler = obs_ts.sampler()
+        assert sampler is not None, (
+            "obs.fleet.enable must start the timeseries sampler")
+        for _ in range(3):
+            server.slo_snapshot()   # publishes the serve.slo_burn_* /
+            sampler.sample()        # queue-depth gauges; one history
+            time.sleep(0.01)        # sample per poll
+        burn_history = {}
+        for gname in ("serve.slo_burn_short", "serve.slo_burn_long"):
+            got = obs_ts.range_(gname)
+            assert got, f"no timeseries history for {gname}"
+            for key, samples in got.items():
+                assert len(samples) >= 3, (
+                    f"timeseries {key} holds {len(samples)} sample(s); "
+                    "the SLO-gauge history needs >= 3")
+            burn_history[gname] = {k: len(v) for k, v in got.items()}
+        assert obs_ts.range_("serve.queue_depth"), (
+            "no serve.queue_depth history")
+
+        # -- 2. the 2-worker supervised run (jax-free workers; the
+        #       supervisor propagates MMLSPARK_TPU_FLEET and publishes
+        #       train.fleet.* aggregates from the beacon excerpts) --
+        report = TrainSupervisor(ServiceConfig(
+            cmd=(_sys.executable, "-c", _FLEET_WORKER_SRC),
+            service_dir=svc_dir, topologies=(Topology(world=2),),
+            policy=RecoveryPolicy(), poll_s=0.05, grace_seconds=15.0,
+            worker_obs=True, worker_flight=False)).run()
+        assert report.ok, f"fleet worker generation failed: {report.reason}"
+        truths = []
+        for rank in (0, 1):
+            with open(os.path.join(svc_dir, f"truth_{rank}.json"),
+                      encoding="utf-8") as fh:
+                truths.append(_json.load(fh))
+        fleet_steps = registry().value("train.fleet.steps", rank=0)
+        assert fleet_steps == 24, (
+            "supervisor did not aggregate worker beacon deltas into "
+            f"train.fleet.steps{{rank=0}} (got {fleet_steps})")
+        assert (registry().value("train.fleet.steps", rank=0) or 0) \
+            + (registry().value("train.fleet.steps", rank=1) or 0) == 48
+
+        # -- 3. expected fleet sum: THIS process's counters (default +
+        #       per-model serve registries) + both workers' truths —
+        #       captured immediately before the final snapshot --
+        expected: dict[str, float] = {}
+
+        def _acc(items):
+            for key, value in items:
+                expected[key] = expected.get(key, 0.0) + float(value)
+
+        for reg in [registry()] + server.metric_registries():
+            _acc((format_series(m.name, m.labels), m.value)
+                 for m in reg.iter_metrics() if isinstance(m, Counter))
+        for truth in truths:
+            _acc(truth.items())
+        obs_fleet.disable()   # writes the final exit snapshot
+        server.close()
+
+        # -- 4. merge + bit-equality --
+        view = obs_fleet.FleetCollector(fleet_dir).collect()
+        merged = {format_series(m.name, m.labels): m.value
+                  for m in view.registry.iter_metrics()
+                  if isinstance(m, Counter)}
+        missing = {k: v for k, v in expected.items()
+                   if merged.get(k) != v}
+        extra = sorted(set(merged) - set(expected))
+        assert not missing and not extra, (
+            "fleet-merged counters are not bit-equal to the summed "
+            f"per-process registries: mismatched={missing} "
+            f"extra={extra}")
+        n_serve = sum(1 for k in merged if k.startswith("serve."))
+        n_train = sum(1 for k in merged if k.startswith("train."))
+        assert n_serve > 0 and n_train > 0
+
+        # -- 5. the fleet timeline renders exit-0 through the CLI --
+        trace_path = os.path.join(fleet_dir, "fleet_trace.json")
+        fleet_payload = view.chrome_trace()
+        with open(trace_path, "w", encoding="utf-8") as fh:
+            _json.dump(fleet_payload, fh)
+        meta = fleet_payload["fleetMeta"]
+        assert meta["unaligned"] == []
+        assert meta["stitched_flows"] >= 1, (
+            "no cross-process flow stitched at the workers' "
+            "train/liveness_sync fence seams")
+        import importlib.util as _ilu
+        spec = _ilu.spec_from_file_location(
+            "mmlspark_tools_trace",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "trace.py"))
+        trace_cli = _ilu.module_from_spec(spec)
+        spec.loader.exec_module(trace_cli)
+        rc = trace_cli.main(["render", trace_path, "--top", "5"])
+        assert rc == 0, f"tools/trace.py render exited {rc} on the " \
+                        "fleet trace"
+        return {
+            "processes": len(view.processes),
+            "counters_merged": len(merged),
+            "serve_counters": n_serve,
+            "train_counters": n_train,
+            "stitched_flows": meta["stitched_flows"],
+            "trace_render_rc": rc,
+            "burn_gauge_history": burn_history,
+            "fleet_steps_rank0": int(fleet_steps),
+            "supervisor_ok": report.ok,
+        }
+    finally:
+        obs_fleet.disable()
+        if server is not None:
+            server.close()
+        obs.disable()
+        obs.clear()
+        registry().reset()
+        leaked = [t.name for t in threading.enumerate()
+                  if t.name in ("FleetExporter", "TimeSeriesSampler")]
+        assert not leaked, f"fleet threads leaked: {leaked}"
+        shutil.rmtree(fleet_dir, ignore_errors=True)
+
+
 def check_obs_overhead(max_fraction: float = 0.02) -> dict:
     """The obs seams' disabled-path cost on the fused-pipeline microbench
     must stay under ``max_fraction`` (2%) of the transform itself.
@@ -1634,6 +1863,7 @@ def main() -> int:
         serve_lifecycle = check_serve_lifecycle()
         obs_overhead = check_obs_overhead()
         obs_tracing = check_obs_request_tracing()
+        fleet_obs = check_fleet_obs()
         flight_rec = check_flight_recorder()
         spmd = check_spmd_clean()
     except AssertionError as e:
@@ -1649,6 +1879,7 @@ def main() -> int:
                       "serve_lifecycle": serve_lifecycle,
                       "obs_overhead": obs_overhead,
                       "obs_request_tracing": obs_tracing,
+                      "fleet_obs": fleet_obs,
                       "flight_recorder": flight_rec, "spmd": spmd}))
     return 0
 

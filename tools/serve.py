@@ -5,7 +5,8 @@ Usage::
     python tools/serve.py <model-path> [--name NAME] [--host H] [--port P]
         [--buckets 1,8,32,128] [--max-queue N] [--deadline-ms D]
         [--mesh dp=N[,tp=M][,pp=K]] [--schema schema.json] [--no-warmup]
-        [--obs] [--slo-objective 0.999] [--slo-latency-ms P99_MS]
+        [--obs] [--fleet DIR] [--slo-objective 0.999]
+        [--slo-latency-ms P99_MS]
 
 ``<model-path>`` is any of
 
@@ -38,7 +39,10 @@ verdict, queue-depth/occupancy/replica-skew signals) — tune the
 objective with ``--slo-objective``/``--slo-latency-ms``. ``--obs``
 additionally enables the span tracer so ``/metrics`` (JSON, or
 Prometheus text under ``Accept: text/plain``) and ``/trace``
-(Chrome-trace JSON with per-request flows) carry a live timeline. See
+(Chrome-trace JSON with per-request flows) carry a live timeline.
+``--fleet DIR`` exports this process's telemetry snapshots into the
+fleet plane (obs/fleet.py; equivalent to ``MMLSPARK_TPU_FLEET=DIR``)
+and serves the fleet-merged cross-process view on ``/fleet``. See
 docs/observability.md.
 
 Prints one JSON line when serving starts; Ctrl-C drains in-flight
@@ -152,6 +156,11 @@ def main(argv: list[str] | None = None) -> int:
                          "snapshot (JSON, or Prometheus text under "
                          "content negotiation) and the Chrome-trace "
                          "span timeline with per-request flows")
+    ap.add_argument("--fleet", default=None, metavar="DIR",
+                    help="export fleet telemetry snapshots into DIR "
+                         "(obs/fleet.py; same as MMLSPARK_TPU_FLEET=DIR) "
+                         "and serve the fleet-merged view on GET /fleet; "
+                         "implies --obs")
     ap.add_argument("--slo-objective", type=float, default=0.999,
                     help="SLO success-ratio objective; its complement "
                          "is the error budget the /healthz burn-rate "
@@ -168,6 +177,9 @@ def main(argv: list[str] | None = None) -> int:
     if args.obs:
         from mmlspark_tpu import obs
         obs.enable()
+    if args.fleet:
+        from mmlspark_tpu.obs import fleet as obs_fleet
+        obs_fleet.enable(args.fleet)  # enables the tracer too
 
     schema = None
     if args.schema:

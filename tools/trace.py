@@ -20,9 +20,15 @@ Subcommands::
         Aggregate a previously written trace file into a per-span-name
         table (calls, total/mean ms), longest first, plus a one-line
         flow summary (request traces, if the capture carried any).
-        A missing, unreadable, or malformed trace file is a typed
-        :class:`TraceInputError` — one diagnostic line on stderr and
-        exit code 2, never a traceback.
+        FLEET-merged traces (obs/fleet.py, ``tools/fleet.py trace``)
+        render too: multi-pid traceEvents with process-group metadata
+        are admitted, and the summary adds per-host lane counts plus
+        the stitched cross-process flow count. A missing, unreadable,
+        or malformed trace file is a typed :class:`TraceInputError` —
+        one diagnostic line on stderr and exit code 2, never a
+        traceback; a MIXED-CLOCK fleet trace (a process without the
+        paired ``(time.time, perf_counter)`` stamp) gets the same
+        typed exit-2 diagnostic.
 
     python tools/trace.py postmortem <dump.json> [--top N] [--frames N]
         Render a flight-recorder dump (obs/flight.py,
@@ -291,20 +297,50 @@ def cmd_pipeline(args: argparse.Namespace) -> int:
     return 0
 
 
+def _check_fleet_clocks(path: str, meta: Any) -> dict | None:
+    """Validate a fleet-merged trace's ``fleetMeta`` (obs/fleet.py adds
+    it). A process that exported no ``(time.time, perf_counter)`` stamp
+    pair has records on a bare perf clock that CANNOT be placed on the
+    fleet wall-clock timeline — rendering them as comparable would
+    silently misorder the fleet; that is the typed mixed-clock error."""
+    if not isinstance(meta, dict):
+        return None
+    unaligned = meta.get("unaligned") or []
+    if unaligned:
+        raise TraceInputError(
+            f"{path!r} is a mixed-clock fleet trace: process(es) "
+            f"{', '.join(str(p) for p in unaligned)} exported no "
+            "(time.time, perf_counter) stamp pair, so their records "
+            "cannot be placed on the fleet wall clock — re-export with "
+            "obs.fleet.TelemetryExporter (its snapshots always carry "
+            "the stamp) or remove the hand-built snapshot directories")
+    return meta
+
+
 def cmd_render(args: argparse.Namespace) -> int:
     payload = _load_trace(args.trace)
     events = payload["traceEvents"]
+    fleet_meta = _check_fleet_clocks(args.trace, payload.get("fleetMeta"))
     agg: dict[str, dict] = {}
     flow_ids: set = set()
+    flow_pids: dict = {}       # flow id -> pids it touches
+    lanes_by_pid: dict = {}    # pid -> distinct tids of complete events
     for i, ev in enumerate(events):
         if not isinstance(ev, dict):
             raise TraceInputError(
                 f"{args.trace!r}: traceEvents[{i}] is "
                 f"not an object (got {type(ev).__name__})")
         if ev.get("ph") in ("s", "t", "f"):
-            flow_ids.add(ev.get("id"))
+            # fleet fence-stitch arrows (cat fleet.fence) are barrier
+            # structure, not request journeys — counting them as
+            # "request flows" would report phantom traces in a capture
+            # that carries none
+            if ev.get("cat") != "fleet.fence":
+                flow_ids.add(ev.get("id"))
+            flow_pids.setdefault(ev.get("id"), set()).add(ev.get("pid"))
         if ev.get("ph") != "X":
             continue
+        lanes_by_pid.setdefault(ev.get("pid"), set()).add(ev.get("tid"))
         try:
             name = ev["name"]
             dur = float(ev.get("dur", 0.0))
@@ -322,6 +358,21 @@ def cmd_render(args: argparse.Namespace) -> int:
         row["total_ms"] = round(row["total_ms"], 3)
         row["mean_ms"] = round(row["total_ms"] / row["calls"], 3)
     _print_summary(rows)
+    if fleet_meta is not None:
+        hosts = fleet_meta.get("hosts") or {}
+        stitched = sum(1 for pids in flow_pids.values()
+                       if len(pids) >= 2)
+        per_host = {
+            str(h): sum(len(lanes_by_pid.get(pid, ()))
+                        for pid in pids or ())
+            for h, pids in hosts.items()}
+        lane_txt = ", ".join(f"{h}: {n} lane(s)"
+                             for h, n in sorted(per_host.items()))
+        print(f"fleet trace: {len(hosts)} host(s), "
+              f"{len(fleet_meta.get('processes') or [])} process(es) — "
+              f"{lane_txt}")
+        print(f"({stitched} stitched cross-process flow(s) at the "
+              "fence seams)")
     if flow_ids:
         print(f"({len(flow_ids)} request flow(s) in the capture — open "
               "in ui.perfetto.dev to see the arrows)")
