@@ -555,6 +555,62 @@ def bench_serve_sharded(jm, rng, n_total: int = 192,
     return out
 
 
+def bench_serve_load_wall(rng) -> dict:
+    """Model-load wall A/B through the persistent AOT compile cache
+    (core/compile_cache.py, docs/serving.md §compile cache): the same
+    ConvNet loaded twice against one cache dir — cold (empty cache:
+    every bucket program XLA-compiles and publishes) vs warm (every
+    program deserializes). Fresh bundle/model objects per load, so the
+    warm pass cannot ride the in-process plan cache; the cross-PROCESS
+    version of this claim is gated in perf_smoke check_compile_cache.
+    Walls include analyzer validation + full-ladder warmup — the number
+    a fleet restart actually waits on."""
+    import shutil
+    import tempfile
+
+    from mmlspark_tpu.core import compile_cache as cc
+    from mmlspark_tpu.data.table import DataTable
+    from mmlspark_tpu.models.jax_model import JaxModel
+    from mmlspark_tpu.models.zoo import get_model
+    from mmlspark_tpu.serve import ModelServer, ServeConfig
+
+    img = rng.integers(0, 255, size=(32 * 32 * 3,)).astype(np.uint8)
+    tmp = tempfile.mkdtemp(prefix="bench-compile-cache-")
+    out: dict = {}
+    try:
+        for label in ("cold", "warm"):
+            cc.reset()
+            bundle = get_model("ConvNet_CIFAR10")
+            jm = JaxModel(model=bundle, input_col="image",
+                          output_col="scores")
+            server = ModelServer(ServeConfig(
+                buckets=(1, 8, 32, 128), deadline_ms=None,
+                compile_cache=tmp))
+            t0 = time.perf_counter()
+            server.add_model("m", jm,
+                             example=DataTable({"image": [img]}))
+            wall = time.perf_counter() - t0
+            stats = dict(cc.active().stats)
+            server.close()
+            out[label] = {
+                "load_wall_s": round(wall, 3),
+                "hits": stats["hits"],
+                "misses": stats["misses"],
+                "puts": stats["puts"],
+                "xla_compiles": stats["compiles"],
+                "deserialize_ms": round(stats["load_ms"], 1),
+            }
+        out["cache_bytes"] = stats["bytes"]
+        cold_w = out["cold"]["load_wall_s"]
+        if cold_w:
+            out["speedup"] = round(cold_w / max(
+                out["warm"]["load_wall_s"], 1e-9), 2)
+    finally:
+        cc.reset()
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def main() -> int:
     import jax
 
@@ -965,6 +1021,17 @@ def main() -> int:
     except Exception as e:  # best-effort metric; label failures accurately
         serve_swap = {"error": f"{type(e).__name__}: {e}"}
 
+    # compile-cache load-wall A/B (round 18): cold (compile + publish)
+    # vs warm (deserialize) model load against one cache dir — the
+    # restart wall a fleet actually pays (docs/serving.md §compile
+    # cache); bench_check gates warm <= cold WITHIN this line, never
+    # across rounds (absolute load walls are box weather)
+    serve_load_wall: dict | None = None
+    try:
+        serve_load_wall = bench_serve_load_wall(rng)
+    except Exception as e:  # best-effort metric; label failures accurately
+        serve_load_wall = {"error": f"{type(e).__name__}: {e}"}
+
     # BASELINE configs 3-5 (flagship models); skip with BENCH_FAST=1
     import os
     extra: dict = {}
@@ -1046,6 +1113,11 @@ def main() -> int:
             "swap", {}).get("p99_ms"),
         "serve_swap_dropped": (serve_swap or {}).get(
             "swap", {}).get("dropped"),
+        "serve_load_wall_cold_s": (serve_load_wall or {}).get(
+            "cold", {}).get("load_wall_s"),
+        "serve_load_wall_warm_s": (serve_load_wall or {}).get(
+            "warm", {}).get("load_wall_s"),
+        "serve_load_wall": serve_load_wall,
         "serve_precision_ab": serve_precision,
         **{f"serve_rows_per_s_{p}": (serve_precision or {}).get(
             p, {}).get("serve_rows_per_s") for p in ("f32", "bf16",

@@ -27,6 +27,9 @@ from mmlspark_tpu.analysis.collectives import (  # noqa: F401
     CollectiveOp, CollectiveSchedule, SpmdFinding, compare_schedules,
     extract_schedule,
 )
+from mmlspark_tpu.analysis.fingerprint import (  # noqa: F401
+    plan_fingerprints,
+)
 from mmlspark_tpu.analysis.info import (  # noqa: F401
     ColumnInfo, SchemaError, TableSchema,
 )
@@ -56,6 +59,7 @@ __all__ = [
     "check_stage_kinds",
     "compare_schedules",
     "extract_schedule",
+    "plan_fingerprints",
     "verify_function",
     "verify_parallel_layer",
     "verify_repo",

@@ -1,0 +1,56 @@
+"""Static compile-cache fingerprints — know the key without compiling.
+
+The persistent AOT compile cache (core/compile_cache.py) keys programs
+by a plan fingerprint. That fingerprint is *statically derivable*: it
+needs only the stages' content identities and the schema's entry
+layout — no data, no device dispatch, no XLA. This module exposes that
+derivation at the analysis layer, so pre-flight tooling can answer
+"which cache entries will this pipeline want?" (and ops tooling can
+pre-seed or audit a fleet cache dir) by replaying the SAME segment
+planning the executor uses — :func:`core.plan.collect_segment` over an
+abstract :class:`~mmlspark_tpu.analysis.info.TableSchema` — exactly
+the way the SPMD auditor replays it.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def plan_fingerprints(stages: Any, schema: Any, mesh: Any = None,
+                      precision: Any = None) -> list[str | None]:
+    """The compile-cache fingerprint of every device segment the plan
+    would form over ``schema``, in segment order. ``None`` entries mark
+    segments that cannot be fingerprinted (a stage without a stable
+    content identity — those compile in memory). ``stages`` is a stage
+    list or anything with ``.stages``; ``mesh`` and ``precision``
+    match the serving configuration being asked about.
+
+    Purely static: nothing compiles, uploads, or touches devices
+    beyond jax's backend enumeration for the version/platform fields.
+    """
+    from mmlspark_tpu.core import compile_cache as _cc
+    from mmlspark_tpu.core.plan import _segment_mesh, collect_segment
+    from mmlspark_tpu.core.precision import PrecisionPolicy
+    inner = getattr(stages, "stages", None)
+    if inner is not None and not callable(inner):
+        stages = list(inner)
+    policy = PrecisionPolicy.parse(precision)
+    if policy is not None and not policy.active:
+        policy = None
+    out: list[str | None] = []
+    i = 0
+    while i < len(stages):
+        seg = collect_segment(stages, i, schema.entry_meta, min_stages=1,
+                              mesh=mesh, precision=policy)
+        if seg is None:
+            i += 1
+            continue
+        # resolve the mesh the way the executor will (stage-declared /
+        # default when no override) so the static fingerprint IS the
+        # runtime cache key, not an approximation of it
+        out.append(_cc.plan_fingerprint(seg.stages, seg.entry_meta,
+                                        mesh=_segment_mesh(seg),
+                                        precision=seg.precision))
+        i = seg.end
+    return out

@@ -22,6 +22,8 @@ _DEFAULTS: dict[str, Any] = {
     "image_threads": 8,            # host-side image-op parallelism
     "log_level": "INFO",
     "timings": True,               # per-stage timing logs (Timer analog)
+    "compile_cache": "",           # AOT compile-cache dir ("" = off)
+    "compile_cache_bytes": 1 << 30,  # compile-cache LRU byte budget
 }
 
 _overrides: dict[str, Any] = {}

@@ -647,6 +647,24 @@ def segment_composite(seg: "_Segment", mesh: Any) -> tuple:
     return composite, stored
 
 
+def _maybe_cache_jit(jitted: Any, seg: "_Segment", mesh: Any) -> Any:
+    """Wrap a segment's jitted composite in the persistent AOT compile
+    cache (core/compile_cache.py) when a cache is installed and every
+    stage in the segment fingerprints stably. Programs then load from
+    disk per concrete dispatch shape instead of re-compiling; an
+    unfingerprintable segment (or no cache) compiles exactly as
+    before."""
+    from mmlspark_tpu.core import compile_cache as _cc
+    cache = _cc.active()
+    if cache is None:
+        return jitted
+    fp = _cc.plan_fingerprint(seg.stages, seg.entry_meta, mesh=mesh,
+                              precision=seg.precision)
+    if fp is None:
+        return jitted
+    return _cc.CachedJit(jitted, fp, cache)
+
+
 def _compile_segment_inner(seg: "_Segment") -> tuple:
     import jax
 
@@ -657,7 +675,8 @@ def _compile_segment_inner(seg: "_Segment") -> tuple:
     if mesh.devices.size == 1:
         target = mesh.devices.reshape(-1)[0]
         dev_params = jax.device_put(params_tuple, target)
-        return jax.jit(composite), dev_params, target, 1
+        fn = _maybe_cache_jit(jax.jit(composite), seg, mesh)
+        return fn, dev_params, target, 1
 
     data = mesh_lib.batch_sharding(mesh)
     # params place by the sharding rules (replicated on a pure-dp mesh —
@@ -666,6 +685,7 @@ def _compile_segment_inner(seg: "_Segment") -> tuple:
     dev_params = jax.device_put(params_tuple, param_shards)
     fn = jax.jit(composite, in_shardings=(param_shards, data),
                  out_shardings=data)
+    fn = _maybe_cache_jit(fn, seg, mesh)
     return fn, dev_params, data, mesh_dp(mesh)
 
 

@@ -279,6 +279,27 @@ class DeviceStage:
             self, "_simple_param_values") else {}
         return tuple(sorted((k, repr(v)) for k, v in vals.items()))
 
+    def device_fingerprint(self) -> Any:
+        """A STABLE content identity for the persistent AOT compile
+        cache (core/compile_cache.py), or ``None`` to opt the segment
+        out of cross-process caching. Unlike ``device_cache_token`` —
+        which may (and for model stages does) lean on ``id()`` because
+        it only guards the in-process compiled-segment cache — a
+        fingerprint must hash *content*: two processes loading the same
+        artifact must produce the same fingerprint, and any change that
+        could alter the compiled program must change it. The default
+        covers stages fully described by their simple params; stages
+        with complex params (models) must override with a weights
+        digest or return ``None``."""
+        if hasattr(self, "_complex_param_values") and \
+                any(v is not None
+                    for v in self._complex_param_values().values()):
+            return None  # complex params: content unknown by default
+        vals = self._simple_param_values() if hasattr(
+            self, "_simple_param_values") else {}
+        return (f"{type(self).__module__}.{type(self).__qualname__}",
+                tuple(sorted((k, repr(v)) for k, v in vals.items())))
+
     def device_fn(self, meta: ArrayMeta) -> DeviceOp | None:
         """Describe this stage's computation on a column of ``meta`` layout,
         or ``None`` to decline (host fallback)."""

@@ -143,6 +143,17 @@ class ImageFeaturizer(Transformer, DeviceStage, HasInputCol, HasOutputCol):
                 self.input_col, self.output_col,
                 self.cut_output_layers, self.minibatch_size)
 
+    def device_fingerprint(self):
+        """Stable content identity for the persistent AOT compile cache
+        (the weights-digest counterpart of ``device_cache_token``)."""
+        bundle = self.model
+        if bundle is None:
+            return None
+        from mmlspark_tpu.core.compile_cache import bundle_digest
+        return ("ImageFeaturizer", bundle_digest(bundle),
+                self.input_col, self.output_col,
+                self.cut_output_layers, self.minibatch_size)
+
     def device_fn(self, meta: ArrayMeta) -> DeviceOp | None:
         bundle: ModelBundle = self.model
         if bundle is None or not meta.is_image or len(meta.shape) != 3:

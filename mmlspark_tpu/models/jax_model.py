@@ -330,6 +330,20 @@ class JaxModel(Transformer, DeviceStage, HasInputCol, HasOutputCol):
                 self.output_node, self.output_node_index,
                 self.minibatch_size, repr(self.mesh_spec))
 
+    def device_fingerprint(self) -> Any:
+        """Stable content identity for the persistent AOT compile cache
+        (core/compile_cache.py): the bundle's weights digest replaces
+        the ``id()`` triple of :meth:`device_cache_token`, so two
+        processes loading the same artifact key the same programs."""
+        bundle = self.model
+        if bundle is None:
+            return None
+        from mmlspark_tpu.core.compile_cache import bundle_digest
+        return ("JaxModel", bundle_digest(bundle),
+                self.input_col, self.output_col,
+                self.output_node, self.output_node_index,
+                self.minibatch_size, repr(self.mesh_spec))
+
     def device_fn(self, meta: ArrayMeta) -> DeviceOp | None:
         """The same forward ``JaxModel.transform`` compiles (uint8 ships
         thin and upcasts on device, then the bundle's preprocess and the

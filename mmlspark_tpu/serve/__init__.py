@@ -37,6 +37,9 @@ from mmlspark_tpu.serve.errors import (  # noqa: F401
 from mmlspark_tpu.serve.faults import (  # noqa: F401
     FaultPlan, FaultSpec, InjectedFault,
 )
+from mmlspark_tpu.serve.ladder import (  # noqa: F401
+    LadderAdvisor, expected_padded_rows, fit_ladder, validate_ladder,
+)
 from mmlspark_tpu.serve.lifecycle import (  # noqa: F401
     CanarySignal, DecisionJournal, Hold, Promote, PromotionLedger,
     PromotionPolicy, Rollback,
@@ -62,6 +65,7 @@ __all__ = [
     "FaultSpec",
     "Hold",
     "InjectedFault",
+    "LadderAdvisor",
     "LaneFailed",
     "ModelLoadError",
     "LockstepCoordinator",
@@ -82,4 +86,7 @@ __all__ = [
     "ServerClosed",
     "ServerStats",
     "THREAD_PREFIX",
+    "expected_padded_rows",
+    "fit_ladder",
+    "validate_ladder",
 ]

@@ -592,7 +592,7 @@ class DynamicBatcher:
                 raise Overloaded(self.name, len(self._queue),
                                  self.config.max_queue)
             self._queue.append(req)
-            self.stats.record_admitted()
+            self.stats.record_admitted(req.n_rows)
             self._cv.notify()
 
     @property

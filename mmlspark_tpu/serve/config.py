@@ -75,11 +75,28 @@ class ServeConfig:
     #                             model; parity vs the f32 offline
     #                             transform is calibrated at load
     #                             (docs/quantization.md)
+    compile_cache: str | None = None  # persistent AOT compile-cache dir
+    #                             (core/compile_cache.py): compiled
+    #                             bucket programs serialize to disk and
+    #                             cold processes warm-start by
+    #                             DESERIALIZING the ladder instead of
+    #                             re-compiling it. None honors
+    #                             MMLSPARK_TPU_COMPILE_CACHE; an
+    #                             unwritable dir degrades to a warning +
+    #                             in-memory compiles (docs/serving.md
+    #                             §compile cache)
 
     def __post_init__(self):
-        buckets = tuple(sorted({int(b) for b in self.buckets}))
-        if not buckets or buckets[0] < 1:
-            raise ValueError(f"buckets must be positive ints: {self.buckets}")
+        # a misordered/duplicated ladder used to be silently repaired
+        # here; it now refuses at load with the typed error the serve
+        # plane uses for every bad-model-config refusal (a mis-sorted
+        # ladder in a config file is a deploy bug, not an intent)
+        from mmlspark_tpu.serve.errors import ModelLoadError
+        from mmlspark_tpu.serve.ladder import validate_ladder
+        try:
+            buckets = validate_ladder(self.buckets)
+        except ValueError as e:
+            raise ModelLoadError("<config>", message=str(e))
         object.__setattr__(self, "buckets", buckets)
         if self.max_queue < 1:
             raise ValueError(f"max_queue must be >= 1: {self.max_queue}")

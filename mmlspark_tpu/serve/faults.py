@@ -27,6 +27,11 @@ point                  seam
                        rename — a crash mid-publish
 ``load_failure``       ``models/repo.ModelRepo.load`` before
                        deserialization — a model that cannot come up
+``compile_cache_torn_put``  ``core/compile_cache.CompileCache.put``
+                       after the entry files are staged, before the
+                       atomic rename — a crash mid-publish of an AOT
+                       program (the staging dir is inert; loads miss
+                       and fall back to in-memory compiles)
 =====================  ====================================================
 
 The seams pay ONE module-attribute check when no plan is installed

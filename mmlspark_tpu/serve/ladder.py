@@ -1,0 +1,223 @@
+"""Traffic-learned bucket ladders — fit the ladder to observed load.
+
+The static 1/8/32/128 default ladder ignores the request-size
+distribution the server actually sees: traffic concentrated at 24 rows
+pads every request to 32 (25% wasted device work), while the Round 8
+occupancy data says the ladder is the main p99-vs-throughput lever.
+This module learns a better ladder from the observed request-size
+histogram under an explicit **program budget** (each rung is one
+compiled XLA program per model per precision — the
+``programs <= len(buckets)`` discipline), and the serve plane rolls a
+change out per model through the existing hot-swap path. With the
+persistent compile cache on, the flip's new programs load from disk —
+a ladder change costs a deserialize, not an XLA compile.
+
+Three layers:
+
+* :func:`validate_ladder` — the ONE ladder validation (``ServeConfig``
+  and fitted ladders both pass through it): positive ints, strictly
+  ascending. A misordered ladder used to be silently re-sorted; it is
+  now a typed refusal at load.
+* :func:`fit_ladder` — exact DP over the distinct observed sizes
+  minimizing expected padded rows dispatched, with the top rung PINNED
+  to ``max_bucket`` so the admission contract (``bucket_for`` accepts
+  any request ≤ the max bucket) never shrinks mid-flight — a rollout
+  drops zero requests by construction. Deterministic: ties prefer
+  fewer rungs, then the earlier split.
+* :class:`LadderAdvisor` — the re-fit policy: only on SLO-clean
+  windows, only with enough traffic, only when the fitted ladder beats
+  the current one by a real margin. ``ModelServer.ladder_tick`` feeds
+  it the ``ServerStats`` request-size histogram and applies accepted
+  proposals via ``apply_ladder`` (the hot-swap path).
+
+See docs/serving.md §adaptive bucket ladder.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any, Iterable, Mapping
+
+#: cap on distinct histogram sizes fed to the DP (O(budget·m²)); denser
+#: histograms are coarsened to quantile boundaries — merging a size into
+#: the next retained boundary only ever over-pads, never mis-packs
+MAX_CANDIDATES = 256
+
+
+def validate_ladder(buckets: Iterable[Any]) -> tuple[int, ...]:
+    """Normalize + validate one bucket ladder: every rung a positive
+    int, strictly ascending (no duplicates). Returns the tuple;
+    raises ``ValueError`` naming the offending rung. The ONE ladder
+    gate — ``ServeConfig`` wraps the error into a typed
+    ``ModelLoadError`` at load."""
+    try:
+        out = tuple(int(b) for b in buckets)
+    except (TypeError, ValueError) as e:
+        raise ValueError(f"bucket ladder {buckets!r}: not ints ({e})")
+    if not out:
+        raise ValueError("bucket ladder is empty")
+    for i, b in enumerate(out):
+        if b < 1:
+            raise ValueError(
+                f"bucket ladder {out!r}: rung {b} at index {i} is not "
+                f"a positive row count")
+    for i in range(1, len(out)):
+        if out[i] == out[i - 1]:
+            raise ValueError(
+                f"bucket ladder {out!r}: duplicate rung {out[i]}")
+        if out[i] < out[i - 1]:
+            raise ValueError(
+                f"bucket ladder {out!r}: rung {out[i]} after "
+                f"{out[i - 1]} — rungs must be strictly ascending")
+    return out
+
+
+def _histogram(sizes: Any) -> Counter:
+    """Request sizes → ``{size: count}``. Accepts a mapping (already a
+    histogram) or an iterable of observed row counts."""
+    if isinstance(sizes, Mapping):
+        return Counter({int(s): int(c) for s, c in sizes.items()
+                        if int(c) > 0})
+    return Counter(int(s) for s in sizes)
+
+
+def expected_padded_rows(sizes: Any, buckets: Iterable[int]) -> int:
+    """Total rows *dispatched* (after bucket padding) serving the
+    histogram on ``buckets`` — the cost the fit minimizes. Raises when
+    a size exceeds the top rung (such a request would be refused at
+    admission; a candidate ladder must cover the observed traffic)."""
+    hist = _histogram(sizes)
+    ladder = validate_ladder(buckets)
+    total = 0
+    for size, count in hist.items():
+        for b in ladder:
+            if b >= size:
+                total += count * b
+                break
+        else:
+            raise ValueError(
+                f"size {size} exceeds top rung {ladder[-1]}")
+    return total
+
+
+def _coarsen(sizes: list[int], limit: int) -> list[int]:
+    """Keep at most ``limit`` boundary sizes (quantile-spaced, always
+    keeping the largest): merged sizes round up to the next retained
+    boundary, which over-pads slightly but stays admissible."""
+    if len(sizes) <= limit:
+        return sizes
+    step = len(sizes) / limit
+    picked = sorted({sizes[min(len(sizes) - 1, int((i + 1) * step) - 1)]
+                     for i in range(limit)} | {sizes[-1]})
+    return picked
+
+
+def fit_ladder(sizes: Any, budget: int, max_bucket: int
+               ) -> tuple[int, ...]:
+    """Fit a ladder of at most ``budget`` rungs over row sizes
+    ``1..max_bucket`` minimizing :func:`expected_padded_rows` on the
+    observed histogram. The top rung is always ``max_bucket`` (the
+    admission contract is immutable: whatever was servable stays
+    servable). Deterministic for a given histogram: exact DP with
+    stable tie-breaks (fewer rungs win a cost tie, then the earlier
+    split). Sizes above ``max_bucket`` are ignored defensively — the
+    server never admits them, so they cannot appear in honest stats."""
+    budget = int(budget)
+    max_bucket = int(max_bucket)
+    if budget < 1:
+        raise ValueError(f"program budget {budget} < 1")
+    if max_bucket < 1:
+        raise ValueError(f"max_bucket {max_bucket} < 1")
+    hist = _histogram(sizes)
+    hist = Counter({s: c for s, c in hist.items()
+                    if 1 <= s <= max_bucket})
+    if not hist:
+        return (max_bucket,)
+    cands = _coarsen(sorted(set(hist) | {max_bucket}), MAX_CANDIDATES)
+    m = len(cands)
+    # cnt[j] = requests of size in (cands[j-1], cands[j]] — after
+    # coarsening every observed size rounds up to its boundary
+    cnt = [0] * m
+    for s, c in hist.items():
+        for j, b in enumerate(cands):
+            if b >= s:
+                cnt[j] += c
+                break
+    pc = [0] * (m + 1)  # prefix counts: pc[j] = sum(cnt[:j])
+    for j in range(m):
+        pc[j + 1] = pc[j] + cnt[j]
+
+    def seg_cost(i: int, j: int) -> int:
+        # requests with size in (cands[i], cands[j]] dispatch at rung
+        # cands[j]; i == -1 means "everything up to cands[j]"
+        return (pc[j + 1] - pc[i + 1]) * cands[j]
+
+    budget = min(budget, m)
+    inf = float("inf")
+    # dp[j] after k rungs = min cost covering sizes ≤ cands[j] with the
+    # k-th (largest) rung exactly cands[j]
+    dp = [seg_cost(-1, j) for j in range(m)]
+    parent: list[list[int | None]] = [[None] * m]
+    best_cost, best_k = dp[m - 1], 1
+    for _k in range(2, budget + 1):
+        ndp = [inf] * m
+        npar: list[int | None] = [None] * m
+        for j in range(m):
+            for i in range(j):
+                c = dp[i] + seg_cost(i, j)
+                if c < ndp[j]:
+                    ndp[j], npar[j] = c, i
+        dp = ndp
+        parent.append(npar)
+        if dp[m - 1] < best_cost:  # strict: cost ties keep fewer rungs
+            best_cost, best_k = dp[m - 1], _k
+    rungs = []
+    j: int | None = m - 1
+    for k in range(best_k - 1, -1, -1):
+        rungs.append(cands[j])
+        j = parent[k][j]
+    return tuple(reversed(rungs))
+
+
+class LadderAdvisor:
+    """The re-fit policy around :func:`fit_ladder`.
+
+    A ladder change is a per-model hot-swap (recompile/reload + atomic
+    flip), so it must be *worth it* and *safe*: :meth:`propose` returns
+    a new ladder only when the observation window is SLO-clean (never
+    reshape the fleet while burning error budget — the canary
+    discipline), carries at least ``min_requests`` observations, and
+    the fitted ladder cuts expected padded work by at least
+    ``min_improvement`` (fractional). Anything else returns ``None``.
+    """
+
+    def __init__(self, budget: int | None = None,
+                 min_requests: int = 256,
+                 min_improvement: float = 0.05):
+        self.budget = budget
+        self.min_requests = int(min_requests)
+        self.min_improvement = float(min_improvement)
+
+    def propose(self, sizes: Any, current: Iterable[int], *,
+                slo_clean: bool = True,
+                budget: int | None = None) -> tuple[int, ...] | None:
+        current = validate_ladder(current)
+        if not slo_clean:
+            return None
+        hist = _histogram(sizes)
+        max_bucket = current[-1]
+        hist = Counter({s: c for s, c in hist.items()
+                        if 1 <= s <= max_bucket})
+        n = sum(hist.values())
+        if n < self.min_requests:
+            return None
+        budget = budget or self.budget or len(current)
+        fitted = fit_ladder(hist, budget, max_bucket)
+        if fitted == current:
+            return None
+        cur_cost = expected_padded_rows(hist, current)
+        new_cost = expected_padded_rows(hist, fitted)
+        if cur_cost <= 0 or \
+                new_cost > (1.0 - self.min_improvement) * cur_cost:
+            return None
+        return fitted

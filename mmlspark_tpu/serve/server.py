@@ -138,6 +138,12 @@ class _ModelEntry:
         self.parity = parity    # measured max-abs vs f32 offline at load
         self.version = version  # model-repo version (or caller tag)
         self.canary: Any = None  # serve.lifecycle.CanaryState | None
+        # the load call's kwargs, kept so a ladder rollout
+        # (ModelServer.apply_ladder) can rebuild this entry identically
+        # except for the bucket ladder
+        self.load_kwargs: dict = {}
+        # adaptive-ladder re-fit policy (lazy; ModelServer.ladder_tick)
+        self.ladder_advisor: Any = None
 
 
 class ModelServer:
@@ -150,6 +156,14 @@ class ModelServer:
     def __init__(self, config: ServeConfig | None = None):
         from mmlspark_tpu.serve.lifecycle import DecisionJournal
         self.config = config or ServeConfig()
+        if self.config.compile_cache:
+            # persistent AOT compile cache (process-wide, like the obs
+            # pillars): every model this server loads serializes its
+            # compiled bucket programs to disk, and a later cold
+            # process deserializes them instead of re-compiling. An
+            # unwritable dir degrades to a warning inside configure()
+            from mmlspark_tpu.core import compile_cache as _cc
+            _cc.configure(self.config.compile_cache)
         self._models: dict[str, _ModelEntry] = {}
         self._lock = threading.Lock()
         self._closed = False
@@ -171,7 +185,7 @@ class ModelServer:
                      example: DataTable | None = None,
                      mesh: Any = None, shard_params: Any = None,
                      precision: Any = None, version: Any = None,
-                     ) -> _ModelEntry:
+                     buckets: Any = None) -> _ModelEntry:
         """Validate, shard, warm, and calibrate one servable — the
         whole load path SHORT of registration, shared by
         :meth:`add_model` (stable loads and hot-swaps) and
@@ -209,10 +223,23 @@ class ModelServer:
            offline transform; drift past the policy's pinned tolerance
            is a typed :class:`ModelLoadError` (docs/quantization.md).
         6. **Start** the model's dispatch loop (one lane per replica).
+
+        ``buckets`` overrides the server-wide ladder for THIS entry (a
+        per-model learned ladder — :meth:`apply_ladder`); the entry's
+        batcher, warmup, and calibration all run on the override.
         """
         from mmlspark_tpu.analysis import TableSchema, analyze
         from mmlspark_tpu.core.precision import PrecisionPolicy
 
+        cfg = self.config
+        if buckets is not None:
+            from mmlspark_tpu.serve.ladder import validate_ladder
+            try:
+                ladder = validate_ladder(buckets)
+            except ValueError as e:
+                raise ModelLoadError(name, message=(
+                    f"model {name!r}: {e}")) from e
+            cfg = dataclasses.replace(self.config, buckets=ladder)
         stages, cache_host, model = _as_stages(model)
         try:
             policy = PrecisionPolicy.parse(
@@ -272,15 +299,15 @@ class ModelServer:
         from mmlspark_tpu.obs.health import HealthMonitor
         from mmlspark_tpu.obs.slo import SLOSpec, SLOTracker
         try:
-            spec = SLOSpec.parse(self.config.slo)
+            spec = SLOSpec.parse(cfg.slo)
         except (TypeError, ValueError) as e:
             raise ModelLoadError(name, message=(
                 f"model {name!r}: invalid SLO spec: {e}")) from e
         stats = ServerStats(
-            self.config.stats_window, model=name,
+            cfg.stats_window, model=name,
             extra_labels=None if version is None
             else {"version": version})
-        batcher = DynamicBatcher(name, stages, cache_host, self.config,
+        batcher = DynamicBatcher(name, stages, cache_host, cfg,
                                  stats, replicas=replicas,
                                  lockstep=lockstep, precision=policy)
         # lane supervision lands in the lifecycle journal: a death or
@@ -291,12 +318,19 @@ class ModelServer:
         monitor = HealthMonitor.for_spec(spec)
         parity = None
         try:
-            if self.config.warmup:
+            if cfg.warmup:
                 warm = example
                 if warm is None and schema is not None:
                     warm = _example_rows(schema, 1)
                 if warm is not None and len(warm):
+                    import time as _time
+                    t0 = _time.perf_counter()
                     self._warm(batcher, warm)
+                    # the warm-start observable: wall seconds to bring
+                    # the whole ladder up (XLA compiles when cold,
+                    # compile-cache deserializes when warm) — the
+                    # serve.warm_wall_s gauge bench A/Bs
+                    stats.record_warm_wall(_time.perf_counter() - t0)
                 else:
                     _log.info("serve[%s]: no concrete input layout — "
                               "skipping warmup (first request per bucket "
@@ -314,7 +348,8 @@ class ModelServer:
                   schema: Any | None = None,
                   example: DataTable | None = None,
                   mesh: Any = None, shard_params: Any = None,
-                  precision: Any = None, version: Any = None) -> None:
+                  precision: Any = None, version: Any = None,
+                  buckets: Any = None) -> None:
         """Register ``model`` under ``name`` (see :meth:`_build_entry`
         for the validate → shard → warm → calibrate load path).
 
@@ -328,11 +363,17 @@ class ModelServer:
         dropped by a swap (``check_serve_lifecycle`` pins this).
         ``version`` tags the entry (the model-repo version, or any
         caller label): it labels the per-version stats registry and the
-        journal's swap records."""
+        journal's swap records. ``buckets`` pins a per-model ladder
+        (:meth:`apply_ladder` rolls a learned one out through this same
+        path)."""
         entry = self._build_entry(name, model, schema=schema,
                                   example=example, mesh=mesh,
                                   shard_params=shard_params,
-                                  precision=precision, version=version)
+                                  precision=precision, version=version,
+                                  buckets=buckets)
+        entry.load_kwargs = dict(schema=schema, example=example,
+                                 mesh=mesh, shard_params=shard_params,
+                                 precision=precision, version=version)
         with self._lock:
             if self._closed:
                 entry.batcher.close(drain=False)
@@ -352,7 +393,8 @@ class ModelServer:
                 "to_version": version,
                 "canary_superseded": canary is not None})
         _log.info("serve[%s]: loaded (buckets=%s, mesh=%s, "
-                  "precision=%s, version=%s)", name, self.config.buckets,
+                  "precision=%s, version=%s)", name,
+                  entry.batcher.config.buckets,
                   entry.mesh_spec.describe() if entry.mesh_spec
                   else "default",
                   entry.precision.describe() if entry.precision
@@ -376,6 +418,65 @@ class ModelServer:
         self.add_model(name, model, schema=schema, example=example,
                        version=info.version, **kwargs)
         return info
+
+    # -- adaptive bucket ladder (serve/ladder.py) --
+
+    def apply_ladder(self, name: str, buckets: Any) -> None:
+        """Roll a new bucket ladder out for ``name`` through the
+        hot-swap path: the entry rebuilds with the new ladder (warming
+        it — with the persistent compile cache live, the new rungs
+        deserialize from disk instead of paying XLA compiles), then the
+        name flips atomically and the old batcher drains. Zero requests
+        dropped, by the same contract as a version swap; the top rung
+        must equal the current max bucket so nothing admissible becomes
+        inadmissible mid-flight. Journaled as a ``"ladder"`` decision."""
+        from mmlspark_tpu.serve.ladder import validate_ladder
+        entry = self._entry(name)
+        old = entry.batcher.config.buckets
+        new = validate_ladder(buckets)
+        if new[-1] != old[-1]:
+            raise ValueError(
+                f"model {name!r}: ladder rollout must keep the top rung "
+                f"{old[-1]} (got {new[-1]}) — shrinking it would refuse "
+                f"requests the server admitted a moment ago")
+        advisor = entry.ladder_advisor
+        self.add_model(name, entry.model, buckets=new,
+                       **entry.load_kwargs)
+        cur = self._entry(name)
+        cur.ladder_advisor = advisor  # policy state survives the flip
+        self.journal.record("ladder", {
+            "model": name, "from_buckets": list(old),
+            "to_buckets": list(new)})
+
+    def ladder_tick(self, name: str, budget: int | None = None,
+                    advisor: Any = None) -> dict | None:
+        """One adaptive-ladder evaluation for ``name``: fit a ladder to
+        the observed request-size histogram (``serve.request_rows``)
+        under the program budget (default: the current rung count — the
+        ``programs <= len(buckets)`` discipline) and, when the window
+        is SLO-clean and the fit beats the current ladder by the
+        advisor's margin, roll it out via :meth:`apply_ladder`.
+        On-demand like ``lifecycle_tick``: polling this is the re-fit
+        cadence. Returns the decision dict, or None (no change)."""
+        from mmlspark_tpu.obs.health import OK
+        from mmlspark_tpu.serve.ladder import LadderAdvisor
+        entry = self._entry(name)
+        if advisor is not None:
+            entry.ladder_advisor = advisor
+        elif entry.ladder_advisor is None:
+            entry.ladder_advisor = LadderAdvisor()
+        _status, health = self._sample_model_health(entry)
+        current = entry.batcher.config.buckets
+        fitted = entry.ladder_advisor.propose(
+            entry.batcher.stats.request_sizes(), current,
+            slo_clean=(health["state"] == OK and not health["draining"]),
+            budget=budget)
+        if fitted is None:
+            return None
+        self.apply_ladder(name, fitted)
+        return {"action": "ladder", "model": name,
+                "from_buckets": list(current),
+                "to_buckets": list(fitted)}
 
     def _audit_sharded(self, name: str, stages: list, schema: Any,
                        mesh_spec: Any, replicas: Any,
@@ -416,9 +517,11 @@ class ModelServer:
 
     def _warm(self, batcher: DynamicBatcher, example: DataTable) -> None:
         """Compile every bucket by running one padded batch per rung
-        through the SAME dispatch path requests take."""
+        through the SAME dispatch path requests take. The rungs come
+        from the BATCHER's config — a per-model ladder override warms
+        its own ladder, not the server-wide default."""
         row = example.take(np.arange(1))
-        for bucket in self.config.buckets:
+        for bucket in batcher.config.buckets:
             padded = row if bucket == 1 else row.concat(
                 row.take(np.zeros(bucket - 1, dtype=np.int64)))
             batcher.warm(padded)
@@ -446,9 +549,9 @@ class ModelServer:
                       "unverified at load (first requests trust the "
                       "pinned tolerance)", name, policy.describe())
             return None
-        n = min(len(calib), self.config.max_bucket)
+        n = min(len(calib), batcher.config.max_bucket)
         calib = calib.take(np.arange(n))
-        bucket = self.config.bucket_for(n, name)
+        bucket = batcher.config.bucket_for(n, name)
         padded = calib if bucket == n else calib.take(
             np.arange(bucket) % n)
         try:

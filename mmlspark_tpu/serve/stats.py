@@ -68,6 +68,16 @@ class ServerStats:
                                         window=window, **lbl)
         self._occupancy = reg.histogram("serve.batch_occupancy",
                                         window=window, **lbl)
+        # request SIZES as admitted (pre-padding, per request — not the
+        # per-batch occupancy): the adaptive-ladder fit input
+        # (serve/ladder.py) needs the raw size distribution, which
+        # occupancy hides behind packing
+        self._request_rows = reg.histogram("serve.request_rows",
+                                           window=window, **lbl)
+        # wall seconds the load spent warming the bucket ladder (gauge:
+        # one value per load/swap) — with the persistent compile cache
+        # this is the warm-start observable bench A/Bs
+        self._warm_wall = reg.gauge("serve.warm_wall_s", **lbl)
         # distinct batch shapes OBSERVED entering the device (reported by
         # the dispatch handle, one per uploaded chunk — not the intended
         # bucket label): for a fixed program each new shape is one XLA
@@ -151,10 +161,23 @@ class ServerStats:
         return {int(dict(c.labels)["replica"]): int(c.value)
                 for c in self.registry.series("serve.replica_batches")}
 
+    def request_sizes(self) -> list[int]:
+        """Admitted request row counts over the window — the
+        adaptive-ladder fit input (``LadderAdvisor.propose``)."""
+        return [int(v) for v in self._request_rows.values()]
+
+    def record_warm_wall(self, seconds: float) -> None:
+        self._warm_wall.set(seconds)
+
+    @property
+    def warm_wall_s(self) -> float | None:
+        return self._warm_wall.value
+
     # -- request side --
 
-    def record_admitted(self) -> None:
+    def record_admitted(self, rows: int = 1) -> None:
         self._admitted.add()
+        self._request_rows.observe(rows)
 
     def record_rejected(self) -> None:
         self._rejected.add()
@@ -256,6 +279,8 @@ class ServerStats:
             "lane_restarts": self.lane_restarts,
             "requeued_batches": self.requeued_batches,
             "batch_occupancy_mean": self._occupancy.mean(),
+            "request_rows_mean": self._request_rows.mean(),
+            "warm_wall_s": self._warm_wall.value,
             "occupancy_by_bucket": dict(sorted(buckets.items())),
             "e2e_ms": self._e2e_ms.percentiles(),
             "queue_wait_ms": self._queue_ms.percentiles(),

@@ -59,6 +59,28 @@ def test_throughput_and_p99_bands():
     assert p99["class"] == "p99" and p99["ratio"] == 2.0
 
 
+def test_load_wall_warm_gated_within_line_not_across_rounds():
+    """The compile-cache load walls gate warm <= cold WITHIN one line
+    (same box, same minute); absolute walls never gate across rounds
+    (box weather), so a prior round with faster walls is irrelevant."""
+    prior = {"serve_load_wall_cold_s": 0.1, "serve_load_wall_warm_s": 0.05}
+    ok = bench_check.check_line(
+        {"serve_load_wall_cold_s": 6.0, "serve_load_wall_warm_s": 0.4},
+        _rounds(prior))
+    assert ok["verdict"] == "ok" and not ok["regressions"]
+    bad = bench_check.check_line(
+        {"serve_load_wall_cold_s": 1.0, "serve_load_wall_warm_s": 1.5},
+        _rounds(prior))
+    assert bad["verdict"] == "regressed"
+    row = bad["regressions"][0]
+    assert row["key"] == "serve_load_wall_warm_s"
+    assert row["class"] == "within-line" and row["best"] == 1.0
+    # the within-line gate holds even with no archived rounds at all
+    empty = bench_check.check_line(
+        {"serve_load_wall_cold_s": 1.0, "serve_load_wall_warm_s": 1.5}, [])
+    assert empty["verdict"] == "regressed"
+
+
 def test_best_prior_round_is_per_metric():
     # throughput compares against the per-metric MAX across priors
     # (r2's 120), p99 against the per-metric MIN (r1's 8.0) — the best

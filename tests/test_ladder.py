@@ -1,0 +1,239 @@
+"""serve/ladder.py — traffic-learned bucket ladders: the single strict
+ladder validation (ServeConfig's typed refusal), the exact padded-work
+DP (deterministic, budget-respecting, top rung pinned), the SLO-gated
+re-fit policy, and the zero-drop bit-identical mid-burst rollout
+through the hot-swap path."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.models.jax_model import JaxModel
+from mmlspark_tpu.models.zoo import get_model
+from mmlspark_tpu.serve import (
+    LadderAdvisor, ModelLoadError, ModelServer, ServeConfig,
+    expected_padded_rows, fit_ladder, validate_ladder,
+)
+
+
+def _jm():
+    bundle = get_model("ConvNet_CIFAR10", widths=(4, 8), dense_width=16)
+    return JaxModel(model=bundle, input_col="image", output_col="scores")
+
+
+# ---- validation (the ONE ladder gate) ----
+
+
+def test_validate_ladder_accepts_and_normalizes():
+    assert validate_ladder([1, 8, 32]) == (1, 8, 32)
+    assert validate_ladder((7,)) == (7,)
+    assert validate_ladder([np.int64(2), np.int64(4)]) == (2, 4)
+
+
+@pytest.mark.parametrize("bad,needle", [
+    ((), "empty"),
+    ((0, 8), "not a positive row count"),
+    ((-1,), "not a positive row count"),
+    ((1, 8, 8), "duplicate rung 8"),
+    ((8, 1), "strictly ascending"),
+    (("x", 2), "not ints"),
+])
+def test_validate_ladder_refuses(bad, needle):
+    with pytest.raises(ValueError, match=needle):
+        validate_ladder(bad)
+
+
+def test_serveconfig_misordered_ladder_is_typed_refusal():
+    """A misordered/duplicate ladder used to be silently re-sorted; it
+    is now a ModelLoadError at config time, before any model loads."""
+    for bad in ((8, 1), (1, 1, 8), (0, 4), ()):
+        with pytest.raises(ModelLoadError):
+            ServeConfig(buckets=bad)
+    assert ServeConfig(buckets=(1, 4, 16)).buckets == (1, 4, 16)
+
+
+def test_serve_cli_rejects_bad_ladder(capsys):
+    """tools/serve.py --buckets 8,1 exits 2 with the ladder diagnostic
+    before touching the model path."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "mmlspark_tools_serve",
+        os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "serve.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rc = mod.main(["/nonexistent/model", "--buckets", "8,1"])
+    assert rc == 2
+    assert "ascending" in capsys.readouterr().err
+
+
+def test_add_model_bad_ladder_override_names_the_model():
+    server = ModelServer(ServeConfig(buckets=(1, 4), deadline_ms=None))
+    try:
+        with pytest.raises(ModelLoadError, match="'m'"):
+            server.add_model("m", _jm(), buckets=(4, 2))
+    finally:
+        server.close()
+
+
+# ---- cost + fit ----
+
+
+def test_expected_padded_rows():
+    assert expected_padded_rows({3: 2, 10: 1}, (4, 16)) == 2 * 4 + 16
+    assert expected_padded_rows([1, 1, 4], (4,)) == 12
+    with pytest.raises(ValueError, match="exceeds top rung"):
+        expected_padded_rows({32: 1}, (4, 16))
+
+
+def test_fit_ladder_deterministic_budget_and_top_rung(rng):
+    sizes = rng.integers(1, 129, size=2000).tolist()
+    a = fit_ladder(sizes, budget=4, max_bucket=128)
+    b = fit_ladder(list(sizes), budget=4, max_bucket=128)
+    assert a == b  # pure function of the histogram
+    assert 1 <= len(a) <= 4
+    assert a[-1] == 128  # admission contract pinned
+    assert list(a) == sorted(set(a))
+    # the fit never loses to the default ladder it replaces
+    assert expected_padded_rows(sizes, a) \
+        <= expected_padded_rows(sizes, (1, 8, 32, 128))
+
+
+def test_fit_ladder_degenerate_traffic():
+    assert fit_ladder([], budget=4, max_bucket=128) == (128,)
+    assert fit_ladder({}, budget=2, max_bucket=16) == (16,)
+    # single observed size: one rung there, plus the pinned top
+    assert fit_ladder({24: 100}, budget=4, max_bucket=128) == (24, 128)
+    assert fit_ladder({24: 100}, budget=1, max_bucket=128) == (128,)
+    # traffic at the max bucket needs exactly one rung
+    assert fit_ladder({128: 50}, budget=4, max_bucket=128) == (128,)
+    # sizes the server would never admit are ignored, not fitted
+    assert fit_ladder({500: 99, 4: 1}, budget=2, max_bucket=8) == (4, 8)
+    with pytest.raises(ValueError, match="budget"):
+        fit_ladder({4: 1}, budget=0, max_bucket=8)
+
+
+def test_fit_ladder_heavy_tail_cuts_padded_work():
+    hist = {1: 500, 2: 300, 24: 1000, 100: 5}
+    fitted = fit_ladder(hist, budget=4, max_bucket=128)
+    assert fitted == (1, 2, 24, 128)
+    cur = expected_padded_rows(hist, (1, 8, 32, 128))
+    new = expected_padded_rows(hist, fitted)
+    assert new < cur
+
+
+# ---- the re-fit policy ----
+
+
+def test_advisor_gates():
+    adv = LadderAdvisor(min_requests=100, min_improvement=0.05)
+    hist = {24: 1000}
+    cur = (1, 8, 32, 128)
+    # burning error budget: never reshape the fleet
+    assert adv.propose(hist, cur, slo_clean=False) is None
+    # thin window: not enough evidence
+    assert adv.propose({24: 10}, cur) is None
+    # real traffic, real win
+    assert adv.propose(hist, cur) == (24, 128)
+    # already optimal: no churn
+    assert adv.propose(hist, (24, 128)) is None
+    # marginal win under the improvement floor: no churn
+    strict = LadderAdvisor(min_requests=1, min_improvement=0.9)
+    assert strict.propose(hist, cur) is None
+
+
+# ---- rollout through the hot-swap path ----
+
+
+def test_apply_ladder_refuses_shrinking_the_top_rung(rng):
+    img = rng.integers(0, 255, (32 * 32 * 3,)).astype(np.uint8)
+    server = ModelServer(ServeConfig(buckets=(1, 4), deadline_ms=None))
+    try:
+        server.add_model("m", _jm(),
+                         example=DataTable({"image": [img]}))
+        with pytest.raises(ValueError, match="top rung"):
+            server.apply_ladder("m", (1, 2))
+    finally:
+        server.close()
+
+
+def test_mid_burst_ladder_flip_drops_nothing_bit_identical(rng):
+    """The acceptance gate: a ladder rollout mid-burst answers every
+    in-flight and following request, every answer bit-identical to the
+    offline transform, and the flip is journaled."""
+    jm = _jm()
+    imgs = [rng.integers(0, 255, (2, 32 * 32 * 3)).astype(np.uint8)
+            for _ in range(24)]
+    tables = [DataTable({"image": list(a)}) for a in imgs]
+    offline = [np.stack(list(jm.transform(t)["scores"])) for t in tables]
+
+    server = ModelServer(ServeConfig(buckets=(1, 4), deadline_ms=None,
+                                     max_queue=64))
+    try:
+        server.add_model("m", jm,
+                         example=DataTable({"image": [imgs[0][0]]}))
+        results: list = [None] * len(tables)
+        errors: list = []
+
+        def worker(i):
+            try:
+                results[i] = server.submit(
+                    "m", tables[i]).result(timeout=300)
+            except BaseException as e:  # noqa: BLE001 — asserted below
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(len(tables))]
+        for t in threads[:12]:
+            t.start()
+        server.apply_ladder("m", (2, 4))  # flip mid-burst
+        for t in threads[12:]:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert server._entry("m").batcher.config.buckets == (2, 4)
+        ladder_decisions = server.lifecycle_decisions("ladder")
+    finally:
+        server.close()
+
+    for i, out in enumerate(results):  # zero drops, zero wrong answers
+        got = np.stack(list(out["scores"]))
+        np.testing.assert_array_equal(got, offline[i])
+    assert ladder_decisions and ladder_decisions[-1]["to_buckets"] \
+        == [2, 4]
+
+
+def test_ladder_tick_learns_from_traffic_and_journals(rng):
+    """ladder_tick: the observed request-size histogram (6-row
+    requests on a 1/8/32 ladder) re-fits to (6, 32) through the
+    hot-swap path on an SLO-clean window; an unclean or thin window
+    changes nothing."""
+    img = rng.integers(0, 255, (32 * 32 * 3,)).astype(np.uint8)
+    server = ModelServer(ServeConfig(buckets=(1, 8, 32),
+                                     deadline_ms=None))
+    try:
+        server.add_model("m", _jm(),
+                         example=DataTable({"image": [img]}))
+        adv = LadderAdvisor(min_requests=32)
+        # thin window: no decision
+        assert server.ladder_tick("m", advisor=adv) is None
+        stats = server.stats("m")
+        for _ in range(64):
+            stats.record_admitted(6)
+        decision = server.ladder_tick("m")  # advisor persists on entry
+        assert decision == {"action": "ladder", "model": "m",
+                            "from_buckets": [1, 8, 32],
+                            "to_buckets": [6, 32]}
+        assert server._entry("m").batcher.config.buckets == (6, 32)
+        assert server.lifecycle_decisions("ladder")
+        # the flipped entry serves
+        out = server.submit(
+            "m", DataTable({"image": [img]})).result(timeout=300)
+        assert len(out) == 1 and "scores" in out
+    finally:
+        server.close()

@@ -13,10 +13,10 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
 
 from perf_smoke import (  # noqa: E402
-    check_fleet_obs, check_fused_crossings, check_flight_recorder,
-    check_obs_overhead, check_obs_request_tracing, check_serve_batching,
-    check_serve_lifecycle, check_serve_lowprec, check_serve_sharded,
-    check_spmd_clean, check_train_device_preprocess,
+    check_compile_cache, check_fleet_obs, check_fused_crossings,
+    check_flight_recorder, check_obs_overhead, check_obs_request_tracing,
+    check_serve_batching, check_serve_lifecycle, check_serve_lowprec,
+    check_serve_sharded, check_spmd_clean, check_train_device_preprocess,
     check_train_elastic, check_train_prefetch,
 )
 
@@ -145,6 +145,22 @@ def test_serve_burst_compiles_bounded_and_coalesces():
         or result["programs_compiled"] <= len(result["buckets"])
     assert result["distinct_batch_shapes"] <= len(result["buckets"])
     assert result["batch_occupancy_mean"] > 1.0
+
+
+def test_serve_compile_cache_warm_starts_without_compiling():
+    """Persistent AOT compile cache (round 18): a cold load publishes
+    every compiled bucket program to the cache dir; a second cold-start
+    PROCESS deserializes all of them (zero fresh XLA compiles, counted
+    at the cache stats, the jit-cache hook, and the obs
+    plan.compile_cache.hits counter), serves bit-identical outputs, and
+    loads with a measurably smaller warm wall."""
+    result = check_compile_cache()
+    assert result["cold"]["puts"] >= 1
+    assert result["cold"]["puts"] <= len(result["buckets"])
+    assert result["warm"]["compiles"] == 0
+    assert result["warm"]["hits"] == result["cold"]["puts"]
+    assert result["bit_identical"] is True
+    assert result["warm_wall_s"] < result["cold_wall_s"]
 
 
 def test_serve_lowprec_parity_programs_and_audit():

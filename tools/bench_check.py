@@ -97,8 +97,26 @@ def check_line(current: dict, priors: list[tuple[int, dict]],
     report: dict = {"verdict": "ok", "regressions": [], "checked": [],
                     "volatile": [], "new": [],
                     "rounds_compared": [n for n, _p in priors]}
+    # within-line A/B: absolute model-load walls are box weather (a
+    # cross-round band would flake on shared-core CI), but cold and warm
+    # come from the same line on the same box minutes apart — a warm
+    # compile-cache load costing MORE than the cold load that populated
+    # the cache is a real regression regardless of the box
+    cold = current.get("serve_load_wall_cold_s")
+    warm = current.get("serve_load_wall_warm_s")
+    if isinstance(cold, (int, float)) and not isinstance(cold, bool) \
+            and isinstance(warm, (int, float)) \
+            and not isinstance(warm, bool):
+        row = {"key": "serve_load_wall_warm_s", "class": "within-line",
+               "current": warm, "best": cold, "best_round": None,
+               "ratio": round(warm / cold, 4) if cold else None,
+               "band": "<= serve_load_wall_cold_s (same line)"}
+        report["checked"].append(row)
+        if warm > cold:
+            report["regressions"].append(row)
     if not priors:
-        report["verdict"] = "no-priors"
+        report["verdict"] = ("regressed" if report["regressions"]
+                             else "no-priors")
         return report
     for key in sorted(current):
         cls = classify(key)

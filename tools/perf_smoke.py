@@ -41,6 +41,15 @@ flake on a loaded CI box):
   jitted composite's own compile cache AND at the dispatch-shape seam)
   and coalesces to a mean batch occupancy > 1 (the batcher actually
   batches under load).
+* **serve compile cache (persistent AOT warm start)** — a cold load
+  against an empty ``compile_cache`` dir compiles and atomically
+  publishes one serialized program per distinct entry shape (bounded by
+  the bucket ladder); a second COLD-START
+  PROCESS against the same dir loads with ZERO fresh XLA compiles
+  (asserted at the cache's own stats, the jit-cache-size hook, and the
+  obs ``plan.compile_cache.hits`` counter), serves outputs bit-identical
+  to the compiling process, and its warm wall beats the cold wall
+  (core/compile_cache.py, docs/serving.md §compile cache).
 * **serve sharded (dp-replica fan-out)** — on the 8-device dryrun mesh a
   dp=4 replicated model sustains ≥ 2.5× the dp=1 throughput on a
   latency-bound model (device time simulated by an in-program callback
@@ -638,6 +647,160 @@ def check_serve_batching() -> dict:
         "batches": snap["batches"],
         "batch_occupancy_mean": occ,
     }
+
+
+# the warm cold-start half of check_compile_cache: a FRESH python
+# process (nothing shares jax's in-memory caches with the parent) loads
+# the same bundle against the same cache dir and reports what it paid.
+# NOTE: must use a plain flax model — a bundle with a pure_callback
+# (e.g. the latency model) compiles to an unserializable executable and
+# the cache deliberately degrades to in-memory compiles for it.
+_COMPILE_CACHE_CHILD = r"""
+import hashlib, json, sys
+repo, bundle_path, cache_dir, buckets_csv = sys.argv[1:5]
+sys.path.insert(0, repo)
+import numpy as np
+from mmlspark_tpu import obs
+from mmlspark_tpu.core import compile_cache as cc
+from mmlspark_tpu.data.downloader import load_bundle_file
+from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.models.jax_model import JaxModel
+from mmlspark_tpu.obs.metrics import registry
+from mmlspark_tpu.serve import ModelServer, ServeConfig
+
+obs.enable()
+buckets = tuple(int(b) for b in buckets_csv.split(","))
+bundle = load_bundle_file(bundle_path)
+jm = JaxModel(model=bundle, input_col="image", output_col="scores")
+rng = np.random.default_rng(7)
+rows = rng.integers(0, 255, (8, 32 * 32 * 3)).astype(np.uint8)
+server = ModelServer(ServeConfig(buckets=buckets, deadline_ms=None,
+                                 compile_cache=cache_dir))
+try:
+    server.add_model("cnn", jm, example=DataTable({"image": [rows[0]]}))
+    out = server.submit(
+        "cnn", DataTable({"image": list(rows)})).result(timeout=300)
+    snap = server.stats("cnn").snapshot()
+    programs = server.compiled_programs("cnn")
+finally:
+    server.close()
+digest = hashlib.sha256(np.ascontiguousarray(
+    np.stack(list(out["scores"]))).tobytes()).hexdigest()
+print(json.dumps({
+    "stats": dict(cc.active().stats),
+    "programs": programs,
+    "obs_hits": registry().value("plan.compile_cache.hits"),
+    "digest": digest,
+    "warm_wall_s": snap["warm_wall_s"],
+}))
+"""
+
+
+def check_compile_cache() -> dict:
+    """Persistent AOT compile cache: a cold load compiles and publishes
+    every bucket program; a second COLD-START PROCESS against the same
+    cache dir comes up with zero fresh XLA compiles (every published
+    program deserialized — counted at the cache's own stats, the
+    jit-cache-size hook, and the obs ``plan.compile_cache.hits``
+    counter), serves bit-identical outputs, and its warm wall beats the
+    cold one."""
+    import hashlib
+    import subprocess
+    import tempfile
+
+    from mmlspark_tpu.core import compile_cache as _cc
+    from mmlspark_tpu.data.downloader import save_bundle_file
+    from mmlspark_tpu.data.table import DataTable
+    from mmlspark_tpu.models.jax_model import JaxModel
+    from mmlspark_tpu.models.zoo import get_model
+    from mmlspark_tpu.serve import ModelServer, ServeConfig
+
+    buckets = (1, 8)
+    bundle = get_model("ConvNet_CIFAR10", widths=(8, 16), dense_width=32)
+    rng = np.random.default_rng(7)
+    rows = rng.integers(0, 255, (8, 32 * 32 * 3)).astype(np.uint8)
+
+    with tempfile.TemporaryDirectory(prefix="mmlspark-cc-") as tmp:
+        bundle_path = os.path.join(tmp, "cnn.bundle")
+        save_bundle_file(bundle, bundle_path)
+        cache_dir = os.path.join(tmp, "cache")
+
+        _cc.reset()
+        server = ModelServer(ServeConfig(buckets=buckets, deadline_ms=None,
+                                         compile_cache=cache_dir))
+        try:
+            jm = JaxModel(model=bundle, input_col="image",
+                          output_col="scores")
+            server.add_model("cnn", jm,
+                             example=DataTable({"image": [rows[0]]}))
+            out = server.submit(
+                "cnn", DataTable({"image": list(rows)})).result(timeout=300)
+            cold_snap = server.stats("cnn").snapshot()
+            cold_programs = server.compiled_programs("cnn")
+            cold = dict(_cc.active().stats)
+        finally:
+            server.close()
+            _cc.reset()  # don't leave the cache active for other gates
+        cold_digest = hashlib.sha256(np.ascontiguousarray(
+            np.stack(list(out["scores"]))).tobytes()).hexdigest()
+
+        # the planner may fold several rungs onto one padded entry shape
+        # (e.g. the 8-virtual-device mesh pads a 1-row batch to the same
+        # shape as the 8-bucket), so gate on what the cold load actually
+        # compiled, never on ladder cardinality — but quantization still
+        # bounds it by the ladder
+        assert cold["hits"] == 0 and cold["compiles"] >= 1 \
+            and cold["puts"] == cold["compiles"] \
+            and cold["misses"] == cold["puts"], (
+            f"cold load against an empty cache should miss+compile+publish "
+            f"every program exactly once: {cold}")
+        assert cold["puts"] <= len(buckets), (
+            f"{cold['puts']} programs published for a {len(buckets)}-bucket "
+            f"ladder — per-shape recompiles leaked into the cache: {cold}")
+        assert cold["bytes"] > 0, f"nothing published on disk: {cold}"
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [sys.executable, "-c", _COMPILE_CACHE_CHILD, repo, bundle_path,
+             cache_dir, ",".join(str(b) for b in buckets)],
+            capture_output=True, text=True, env=env, timeout=600)
+        assert proc.returncode == 0, (
+            f"warm cold-start process failed:\n{proc.stderr[-2000:]}")
+        warm = json.loads(
+            [ln for ln in proc.stdout.splitlines() if ln.strip()][-1])
+        ws = warm["stats"]
+
+        assert ws["compiles"] == 0, (
+            f"warm cold-start paid fresh XLA compiles: {ws}")
+        assert ws["hits"] == cold["puts"] and ws["puts"] == 0, (
+            f"warm cold-start should deserialize every published program "
+            f"({cold['puts']} hits, 0 puts): {ws}")
+        if warm["programs"] is not None and cold_programs is not None:
+            assert warm["programs"] == cold_programs, (
+                f"{warm['programs']} programs materialized warm vs "
+                f"{cold_programs} cold — the processes disagree on the "
+                "program set")
+        assert warm["obs_hits"] and warm["obs_hits"] >= cold["puts"], (
+            f"obs plan.compile_cache.hits={warm['obs_hits']} — the cache "
+            "counters are not mirrored into the metrics registry")
+        assert warm["digest"] == cold_digest, (
+            "warm-start outputs differ from the compiling process — the "
+            "deserialized program is not the program that was published")
+        assert warm["warm_wall_s"] < cold_snap["warm_wall_s"], (
+            f"warm load wall {warm['warm_wall_s']:.3f}s did not beat the "
+            f"cold {cold_snap['warm_wall_s']:.3f}s — deserialization is "
+            "not cheaper than compiling")
+        return {
+            "buckets": list(buckets),
+            "cold": {k: cold[k] for k in
+                     ("misses", "puts", "compiles", "bytes")},
+            "warm": {k: ws[k] for k in ("hits", "compiles", "load_ms")},
+            "cold_wall_s": cold_snap["warm_wall_s"],
+            "warm_wall_s": warm["warm_wall_s"],
+            "bit_identical": True,
+        }
 
 
 class _HoldProbe:
@@ -1858,6 +2021,7 @@ def main() -> int:
         train_pp = check_train_device_preprocess()
         train_elastic = check_train_elastic()
         serve = check_serve_batching()
+        serve_cc = check_compile_cache()
         serve_sharded = check_serve_sharded()
         serve_lowprec = check_serve_lowprec()
         serve_lifecycle = check_serve_lifecycle()
@@ -1874,6 +2038,7 @@ def main() -> int:
                       "train_device_preprocess": train_pp,
                       "train_elastic": train_elastic,
                       "serve": serve,
+                      "serve_compile_cache": serve_cc,
                       "serve_sharded": serve_sharded,
                       "serve_lowprec": serve_lowprec,
                       "serve_lifecycle": serve_lifecycle,

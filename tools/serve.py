@@ -6,7 +6,7 @@ Usage::
         [--buckets 1,8,32,128] [--max-queue N] [--deadline-ms D]
         [--mesh dp=N[,tp=M][,pp=K]] [--schema schema.json] [--no-warmup]
         [--obs] [--fleet DIR] [--slo-objective 0.999]
-        [--slo-latency-ms P99_MS]
+        [--slo-latency-ms P99_MS] [--compile-cache DIR]
 
 ``<model-path>`` is any of
 
@@ -148,6 +148,14 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--precision-tolerance", type=float, default=None,
                     help="per-model max-abs parity pin for --precision "
                          "(default: the mode's documented tolerance)")
+    ap.add_argument("--compile-cache", default=None, metavar="DIR",
+                    help="persistent AOT compile cache (same as "
+                         "MMLSPARK_TPU_COMPILE_CACHE): compiled bucket "
+                         "programs serialize into DIR and later cold "
+                         "starts deserialize them instead of paying XLA "
+                         "compiles (docs/serving.md §compile cache). An "
+                         "unwritable DIR degrades to one warning + "
+                         "in-memory compiles — never a failed load")
     ap.add_argument("--no-warmup", action="store_true",
                     help="skip compiling the bucket ladder at load")
     ap.add_argument("--obs", action="store_true",
@@ -217,14 +225,20 @@ def main(argv: list[str] | None = None) -> int:
               file=sys.stderr)
         return 2
 
-    config = ServeConfig(
-        buckets=tuple(int(b) for b in args.buckets.split(",")),
-        max_queue=args.max_queue,
-        deadline_ms=args.deadline_ms or None,
-        warmup=not args.no_warmup,
-        mesh=mesh,
-        slo=slo,
-        precision=precision)
+    try:
+        config = ServeConfig(
+            buckets=tuple(int(b) for b in args.buckets.split(",")),
+            max_queue=args.max_queue,
+            deadline_ms=args.deadline_ms or None,
+            warmup=not args.no_warmup,
+            mesh=mesh,
+            slo=slo,
+            precision=precision,
+            compile_cache=args.compile_cache)
+    except (ModelLoadError, ValueError) as e:
+        # a misordered/duplicate --buckets ladder is a typed refusal
+        print(str(e), file=sys.stderr)
+        return 2
     server = ModelServer(config)
     versions = None
     try:
@@ -260,6 +274,7 @@ def main(argv: list[str] | None = None) -> int:
         "deadline_ms": config.deadline_ms,
         "mesh": mesh.describe() if mesh is not None else None,
         "slo": slo.describe(),
+        "compile_cache": args.compile_cache,
         "endpoints": ["/healthz", "/livez", "/slo", "/metrics",
                       "/trace", "/v1/models", "/v1/stats"],
     }), flush=True)
