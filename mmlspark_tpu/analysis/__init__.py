@@ -12,6 +12,13 @@ and predict its device plan before any data moves.
   propagation through shard_map contracts, partial-sum escape and
   capacity/divisibility hazards, collective-schedule extraction with
   cross-host agreement and fence checks (docs/spmd_analysis.md).
+* :mod:`~mmlspark_tpu.analysis.concurrency` — the **whole-repo
+  concurrency verifier**: pure-AST interprocedural lock/thread
+  inventory, lock-order graph, and typed findings (CC101 lock-order
+  cycle, CC102 blocking under lock, CC103 unguarded acquire, CC104
+  joinless thread, CC105 callback under lock), paired with the runtime
+  lock-order witness in :mod:`~mmlspark_tpu.obs.lockwitness`
+  (docs/concurrency.md).
 * ``tools/analyze.py`` is the CLI entry point; ``tools/lint_jax.py`` is
   the companion AST lint for JAX anti-patterns in the codebase itself.
 """
@@ -26,6 +33,9 @@ from mmlspark_tpu.analysis.audit import (  # noqa: F401
 from mmlspark_tpu.analysis.collectives import (  # noqa: F401
     CollectiveOp, CollectiveSchedule, SpmdFinding, compare_schedules,
     extract_schedule,
+)
+from mmlspark_tpu.analysis.concurrency import (  # noqa: F401
+    ConcurrencyAnalyzer, analyze_paths, analyze_repo, analyze_sources,
 )
 from mmlspark_tpu.analysis.fingerprint import (  # noqa: F401
     plan_fingerprints,
@@ -43,6 +53,7 @@ __all__ = [
     "CollectiveOp",
     "CollectiveSchedule",
     "ColumnInfo",
+    "ConcurrencyAnalyzer",
     "Diagnostic",
     "PlanAudit",
     "PlanSegmentReport",
@@ -54,6 +65,9 @@ __all__ = [
     "TableSchema",
     "TrainPreprocessAudit",
     "analyze",
+    "analyze_paths",
+    "analyze_repo",
+    "analyze_sources",
     "audit_plan_spmd",
     "audit_train_preprocess",
     "check_stage_kinds",

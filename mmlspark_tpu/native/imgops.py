@@ -68,7 +68,7 @@ def _load() -> Any:
         src_mtime = os.path.getmtime(_SRC) if os.path.exists(_SRC) else 0
         lib_fresh = (os.path.exists(lib_path)
                      and os.path.getmtime(lib_path) >= src_mtime)
-        if not lib_fresh and not _build(lib_path):
+        if not lib_fresh and not _build(lib_path):  # concurrency: allow(CC102): one-shot cc build; serializing every caller behind the build IS the contract, and no other lock ever nests inside
             return None
         try:
             lib = ctypes.CDLL(lib_path)

@@ -54,6 +54,12 @@ timeline:
   append-only JSONL with a small query API (``range``/``rate``/
   ``last``) — the trend signals the adaptive ladder and autoscalers
   need.
+* :mod:`~mmlspark_tpu.obs.lockwitness` — the **runtime lock-order
+  witness**: ``named_lock``/``named_rlock``/``named_condition``
+  factories whose name strings join the static lock-order graph of
+  :mod:`mmlspark_tpu.analysis.concurrency`; opt-in edge recording,
+  both-order violation detection, and ``crosscheck`` labelling of
+  static edges (docs/concurrency.md).
 
 Everything is CPU-safe and jax-free at import time. See
 docs/observability.md for the architecture and the instrumented seams.
@@ -85,6 +91,7 @@ from mmlspark_tpu.obs import anomaly  # noqa: F401
 from mmlspark_tpu.obs import device  # noqa: F401
 from mmlspark_tpu.obs import fleet  # noqa: F401
 from mmlspark_tpu.obs import flight  # noqa: F401
+from mmlspark_tpu.obs import lockwitness  # noqa: F401
 from mmlspark_tpu.obs import timeseries  # noqa: F401
 from mmlspark_tpu.obs.anomaly import (  # noqa: F401
     NonFiniteLossError, NonFiniteSentinel, StragglerDetector,
@@ -124,6 +131,7 @@ __all__ = [
     "event",
     "fleet",
     "flight",
+    "lockwitness",
     "metrics_snapshot",
     "mint",
     "poll_memory",

@@ -44,6 +44,7 @@ import traceback
 from typing import Any
 
 from mmlspark_tpu.core import config
+from mmlspark_tpu.obs.lockwitness import named_lock
 from mmlspark_tpu.obs import runtime as _rt
 from mmlspark_tpu.obs.metrics import registry as _registry
 
@@ -99,7 +100,7 @@ class FlightRecorder:
         self.poll_s = float(poll_s)
         self.max_dumps = int(max_dumps)
         os.makedirs(self.out_dir, exist_ok=True)
-        self._lock = threading.Lock()
+        self._lock = named_lock("obs.flight.FlightRecorder._lock")
         self._beats: dict[str, _Heartbeat] = {}
         self._dumps = 0
         self._seq = 0

@@ -28,6 +28,8 @@ disabled path stays a flag check (see docs/observability.md).
 from __future__ import annotations
 
 import threading
+
+from mmlspark_tpu.obs.lockwitness import named_lock
 from collections import deque
 from typing import Any, Iterator
 
@@ -54,7 +56,7 @@ class Counter:
     def __init__(self, name: str, labels: tuple = ()):
         self.name = name
         self.labels = labels
-        self._lock = threading.Lock()
+        self._lock = named_lock("obs.metrics.Counter._lock")
         self._value = 0.0
 
     def add(self, n: float = 1.0) -> None:
@@ -77,7 +79,7 @@ class Gauge:
     def __init__(self, name: str, labels: tuple = ()):
         self.name = name
         self.labels = labels
-        self._lock = threading.Lock()
+        self._lock = named_lock("obs.metrics.Gauge._lock")
         self._value: float | None = None
 
     def set(self, v: float) -> None:
@@ -110,7 +112,7 @@ class Histogram:
         self.name = name
         self.labels = labels
         self.window = int(window)
-        self._lock = threading.Lock()
+        self._lock = named_lock("obs.metrics.Histogram._lock")
         self._values: deque = deque(maxlen=self.window)
         self._count = 0
         self._sum = 0.0
@@ -166,7 +168,7 @@ class MetricsRegistry:
     """Interning factory + snapshot surface for one metrics namespace."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = named_lock("obs.metrics.MetricsRegistry._lock")
         self._metrics: dict[tuple, Any] = {}
 
     def _get(self, kind: type, name: str, labels: dict,

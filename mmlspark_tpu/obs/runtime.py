@@ -27,6 +27,7 @@ from typing import Any
 
 from mmlspark_tpu.core import config
 from mmlspark_tpu.obs.events import EventRecord, SpanRecord
+from mmlspark_tpu.obs.lockwitness import named_lock
 
 DEFAULT_BUFFER = 65536
 # distinct request traces retained for grouping (obs/context.py) before
@@ -41,7 +42,7 @@ _enabled = False
 _device_annotations = False
 # bounded ring buffer of completed SpanRecord/EventRecord (oldest evicted)
 _buffer: deque = deque(maxlen=DEFAULT_BUFFER)
-_lock = threading.Lock()
+_lock = named_lock("obs.runtime._lock")
 # total records ever appended — lets the trace evictor compute how many
 # records arrived while it filtered outside the lock (len() can't: a
 # full ring stays at maxlen while still receiving appends)
@@ -49,7 +50,7 @@ _append_seq = 0
 # one physical span-eviction at a time; a thread that loses the race
 # skips — the live-set filter already bounds what readers group, the
 # next eviction round reclaims the spans
-_evict_lock = threading.Lock()
+_evict_lock = named_lock("obs.runtime._evict_lock")
 
 # ---- trace retention (the request_traces eviction policy) ----
 # The span ring is bounded by record COUNT, which bounded nothing per
@@ -66,7 +67,7 @@ _trace_order: dict[int, None] = {}  # insertion-ordered live trace ids
 # complete later must NOT be resurrected as the "newest" trace — that
 # would group a tail-only partial trace and double-count the drop
 _dropped_ids: dict[int, None] = {}
-_trace_lock = threading.Lock()
+_trace_lock = named_lock("obs.runtime._trace_lock")
 _traces_dropped = 0
 
 

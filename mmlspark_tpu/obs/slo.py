@@ -44,6 +44,7 @@ from typing import Any, Callable
 import numpy as np
 
 from mmlspark_tpu.obs import runtime as _rt
+from mmlspark_tpu.obs.lockwitness import named_lock
 from mmlspark_tpu.obs.metrics import registry as _registry
 from mmlspark_tpu.obs.spans import event as _event
 
@@ -163,7 +164,7 @@ class SLOTracker:
         # coalesce into the newest slot, so the ring holds at most
         # ~8192 samples at any poll rate
         self._samples: deque = deque()
-        self._lock = threading.Lock()
+        self._lock = named_lock("obs.slo.SLOTracker._lock")
 
     # -- the one read seam --
 

@@ -56,6 +56,7 @@ import numpy as np
 from mmlspark_tpu.core.logging_utils import get_logger
 from mmlspark_tpu.data.table import DataTable
 from mmlspark_tpu.obs import context as _obs_ctx
+from mmlspark_tpu.obs.lockwitness import named_condition
 from mmlspark_tpu.obs import flight as _obs_flight
 from mmlspark_tpu.obs import runtime as _obs_rt
 from mmlspark_tpu.obs.spans import event as _obs_event
@@ -272,7 +273,7 @@ class _Lane:
         self.mesh = mesh
         self.shard_params = shard_params
         self.replica = replica       # serve.mesh.Replica | None
-        self._cv = threading.Condition()
+        self._cv = named_condition("serve.batcher._Lane._cv")
         self._queue: deque = deque()   # (packed, batch, rows, bucket)
         self._window: deque = deque()  # (pending, batch, rows, bucket, t0)
         self._closing = False
@@ -530,13 +531,13 @@ class DynamicBatcher:
         #                              None — every lane dispatch (and
         #                              warm compile) pins it, so the
         #                              served program IS the policy's
-        self._cv = threading.Condition()
+        self._cv = named_condition("serve.batcher.DynamicBatcher._cv")
         self._queue: deque[ServeRequest] = deque()
         self._closed = False     # admission stopped (drain in progress)
         self._abort = False      # fail queued work instead of draining
         # lane scheduling state: lane.load counters live under this
         # condition; lanes notify it as batches resolve
-        self._sched_cv = threading.Condition()
+        self._sched_cv = named_condition("serve.batcher.DynamicBatcher._sched_cv")
         # lane self-healing: restart budget shared across lanes (bounds
         # total churn — a model whose lanes keep dying is a model
         # problem, not a restart problem) and an optional server-side
@@ -838,16 +839,21 @@ class DynamicBatcher:
         policy = self.config.lane_restart_policy()
         with self._sched_cv:
             used = self._lane_restarts_used
-            if used >= policy.max_attempts - 1:
-                _log.error(
-                    "%s lane %d: restart budget (%d) exhausted — lane "
-                    "stays down, capacity degraded", self.name,
-                    lane.index, policy.max_attempts - 1)
-                self._notify_lane_event("lane_down", {
-                    "model": self.name, "lane": lane.index,
-                    "restarts_used": used})
-                return None
-            self._lane_restarts_used = used + 1
+            exhausted = used >= policy.max_attempts - 1
+            if not exhausted:
+                self._lane_restarts_used = used + 1
+        if exhausted:
+            _log.error(
+                "%s lane %d: restart budget (%d) exhausted — lane "
+                "stays down, capacity degraded", self.name,
+                lane.index, policy.max_attempts - 1)
+            # hook fires with no lock held (CC105): a listener that
+            # re-enters the batcher (depth(), drain_barrier()) must not
+            # deadlock against the scheduler cv
+            self._notify_lane_event("lane_down", {
+                "model": self.name, "lane": lane.index,
+                "restarts_used": used})
+            return None
         delay = 0.0
         for i, d in enumerate(policy.delays()):
             if i == used:

@@ -45,6 +45,7 @@ import numpy as np
 
 from mmlspark_tpu.core.logging_utils import get_logger
 from mmlspark_tpu.obs import runtime as _obs_rt
+from mmlspark_tpu.obs.lockwitness import named_lock
 from mmlspark_tpu.obs.metrics import registry as _obs_registry
 from mmlspark_tpu.obs.spans import event as _obs_event
 
@@ -109,7 +110,7 @@ class DecisionJournal:
             os.makedirs(directory, exist_ok=True)
             self.path = os.path.join(directory, "decisions.jsonl")
         self._tail: deque = deque(maxlen=int(keep))
-        self._lock = threading.Lock()
+        self._lock = named_lock("serve.lifecycle.DecisionJournal._lock")
 
     def record(self, kind: str, payload: dict) -> dict:
         entry = {"ts": time.time(), "kind": kind, **payload}
@@ -289,9 +290,9 @@ class CanaryState:
         # one policy evaluation at a time: two concurrent /slo pollers
         # must not interleave sample → decide → ledger-update (a clean
         # window would double-count toward promotion)
-        self.tick_lock = threading.Lock()
+        self.tick_lock = named_lock("serve.lifecycle.CanaryState.tick_lock")
         self.parity_tolerance = parity_tolerance
-        self._lock = threading.Lock()
+        self._lock = named_lock("serve.lifecycle.CanaryState._lock")
         self._acc = 0.0
         # shadow mode: (stable request, mirror request) pairs awaiting
         # both resolutions; bounded drop-oldest — parity is a sampled

@@ -22,6 +22,7 @@ import numpy as np
 from mmlspark_tpu.core.logging_utils import get_logger
 from mmlspark_tpu.core.retry import RetryPolicy, call_with_retry
 from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.obs.lockwitness import named_lock
 from mmlspark_tpu.serve.batcher import DynamicBatcher, ServeRequest
 from mmlspark_tpu.serve.config import ServeConfig
 from mmlspark_tpu.serve.errors import (
@@ -165,7 +166,7 @@ class ModelServer:
             from mmlspark_tpu.core import compile_cache as _cc
             _cc.configure(self.config.compile_cache)
         self._models: dict[str, _ModelEntry] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("serve.server.ModelServer._lock")
         self._closed = False
         # lifecycle forensics: swap/canary/promote/rollback and lane
         # death/restart decisions — decisions.jsonl on disk when
@@ -374,16 +375,22 @@ class ModelServer:
         entry.load_kwargs = dict(schema=schema, example=example,
                                  mesh=mesh, shard_params=shard_params,
                                  precision=precision, version=version)
+        old = canary = None
         with self._lock:
-            if self._closed:
-                entry.batcher.close(drain=False)
-                raise ServerClosed("server is closed")
-            old = self._models.get(name)
-            if old is not None:
-                # the outgoing version's canary (if any) dies with it:
-                # a swap supersedes an in-flight rollout
-                canary, old.canary = old.canary, None
-            self._models[name] = entry
+            closed = self._closed
+            if not closed:
+                old = self._models.get(name)
+                if old is not None:
+                    # the outgoing version's canary (if any) dies with
+                    # it: a swap supersedes an in-flight rollout
+                    canary, old.canary = old.canary, None
+                self._models[name] = entry
+        if closed:
+            # teardown outside self._lock: close() joins lane threads,
+            # and holding the server-wide lock across those joins would
+            # stall every concurrent submit/snapshot (CC102)
+            entry.batcher.close(drain=False)
+            raise ServerClosed("server is closed")
         if old is not None:
             if canary is not None:
                 canary.batcher.close(drain=True)
@@ -692,15 +699,22 @@ class ModelServer:
             entry.batcher.close(drain=False)
             raise
         state.entry = entry  # promotion flips this whole entry in
+        reject: Exception | None = None
+        replaced = None
         with self._lock:
             if self._closed:
-                entry.batcher.close(drain=False)
-                raise ServerClosed("server is closed")
-            cur = self._models.get(name)
-            if cur is None:
-                entry.batcher.close(drain=False)
-                raise ModelNotFound(name, list(self._models))
-            replaced, cur.canary = cur.canary, state
+                reject = ServerClosed("server is closed")
+            else:
+                cur = self._models.get(name)
+                if cur is None:
+                    reject = ModelNotFound(name, list(self._models))
+                else:
+                    replaced, cur.canary = cur.canary, state
+        if reject is not None:
+            # close the never-attached batcher outside self._lock —
+            # close() joins lane threads (CC102 under the server lock)
+            entry.batcher.close(drain=False)
+            raise reject
         if replaced is not None:
             replaced.batcher.close(drain=True)
         self.journal.record("canary_deploy", {
@@ -720,13 +734,24 @@ class ModelServer:
         if canary is None:
             return None
         with canary.tick_lock:
-            return self._tick_locked(name, entry, canary)
+            result, drain = self._tick_locked(name, entry, canary)
+        if drain is not None:
+            # drain outside tick_lock: close(drain=True) joins lane
+            # threads for the full drain, and holding tick_lock across
+            # it would block every concurrent tick/rollback (CC102) —
+            # the detach under self._lock already made the decision
+            # exactly-once, so racers see a detached canary and bail
+            drain.close(drain=True)
+        return result
 
     def _tick_locked(self, name: str, entry: _ModelEntry,
-                     canary: Any) -> dict | None:
+                     canary: Any) -> tuple:
+        """One policy evaluation under ``canary.tick_lock``; returns
+        ``(result, batcher_to_drain)`` — the caller performs the drain
+        after releasing the lock."""
         from mmlspark_tpu.serve.lifecycle import Hold, Promote, Rollback
         if entry.canary is not canary:
-            return None  # a concurrent tick already decided
+            return None, None  # a concurrent tick already decided
         sig = canary.signal()
         action = canary.policy.decide(sig, canary.ledger)
         canary.ledger.ticks += 1
@@ -740,19 +765,21 @@ class ModelServer:
             "ticks": canary.ledger.ticks,
         }
         if isinstance(action, Rollback):
-            if self._end_canary(entry, canary, "rollback", detail):
-                return {"action": "rollback", **detail}
-            return None  # a racing close()/swap already detached it
+            drain = self._end_canary(entry, canary, "rollback", detail)
+            if drain is not None:
+                return {"action": "rollback", **detail}, drain
+            return None, None  # a racing close()/swap already detached it
         if isinstance(action, Promote):
-            if self._promote(entry, canary, detail):
-                return {"action": "promote", **detail}
-            return None
+            drain = self._promote(entry, canary, detail)
+            if drain is not None:
+                return {"action": "promote", **detail}, drain
+            return None, None
         assert isinstance(action, Hold)
         canary.ledger.clean_windows = (
             canary.ledger.clean_windows + 1 if action.clean else 0)
         detail["clean_windows"] = canary.ledger.clean_windows
         self.journal.record("hold", detail)
-        return {"action": "hold", **detail}
+        return {"action": "hold", **detail}, None
 
     def rollback(self, name: str, reason: str = "manual") -> dict | None:
         """Abort ``name``'s rollout now (the operator's big red
@@ -763,45 +790,47 @@ class ModelServer:
             return None
         detail = {"model": name, "version": canary.version,
                   "mode": canary.mode, "reason": reason}
-        if self._end_canary(entry, canary, "rollback", detail):
+        drain = self._end_canary(entry, canary, "rollback", detail)
+        if drain is not None:
+            drain.close(drain=True)
             return {"action": "rollback", **detail}
         return None
 
     def _end_canary(self, entry: _ModelEntry, canary: Any,
-                    kind: str, detail: dict) -> bool:
-        """Atomically detach + drain the canary (False when another
-        thread's decision already detached it — exactly one rollback/
-        promote ever executes per rollout)."""
+                    kind: str, detail: dict) -> Any | None:
+        """Atomically detach the canary; returns its batcher for the
+        caller to drain with no lock held (None when another thread's
+        decision already detached it — exactly one rollback/promote
+        ever executes per rollout)."""
         with self._lock:
             if entry.canary is not canary:
-                return False
+                return None
             entry.canary = None
-        canary.batcher.close(drain=True)
         self.journal.record(kind, {**detail, **canary.describe()})
-        return True
+        return canary.batcher
 
     def _promote(self, entry: _ModelEntry, canary: Any,
-                 detail: dict) -> bool:
+                 detail: dict) -> Any | None:
         """The candidate becomes stable: its (already warm) entry takes
         the name atomically, the outgoing stable drains — the same flip
         as a hot-swap, decided by the burn engine instead of an
-        operator."""
+        operator.  Returns the outgoing stable's batcher for the caller
+        to drain with no lock held (None when a racing close()/swap won)."""
         with self._lock:
             if self._closed or entry.canary is not canary \
                     or self._models.get(entry.name) is not entry:
                 # a racing close() owns teardown of whatever is still
                 # attached — installing the promoted entry after close
                 # snapshots would leak its batcher threads forever
-                return False
+                return None
             entry.canary = None
             promoted = canary.entry
             promoted.canary = None
             self._models[entry.name] = promoted
-        entry.batcher.close(drain=True)
         self.journal.record("promote", {
             **detail, "from_version": entry.version,
             **canary.describe()})
-        return True
+        return entry.batcher
 
     def canary_status(self, name: str) -> dict | None:
         entry = self._entry(name)

@@ -27,6 +27,36 @@ import numpy as np
 import pytest
 
 
+def thread_names(*prefixes):
+    """Live threads whose names start with one of ``prefixes``."""
+    import threading
+    return [t.name for t in threading.enumerate()
+            if any(t.name.startswith(p) for p in prefixes)]
+
+
+def assert_no_leaked_threads(*prefixes, timeout=5.0):
+    """Assert that no thread named with one of ``prefixes`` survives,
+    polling up to ``timeout`` — shutdown paths signal their workers
+    before join returns, so a just-closed subsystem may need a few ms
+    to finish unwinding. The one leak assertion every suite shares
+    (serve lanes, train loaders, beacons, obs samplers); prefix
+    allowlisting keeps it scoped to the subsystem under test instead
+    of flaking on pytest's own machinery threads."""
+    import time
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if not thread_names(*prefixes):
+            return
+        time.sleep(0.02)
+    raise AssertionError(
+        f"leaked threads (prefixes {prefixes}): {thread_names(*prefixes)}")
+
+
+@pytest.fixture(name="assert_no_leaked_threads")
+def _assert_no_leaked_threads_fixture():
+    return assert_no_leaked_threads
+
+
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(42)

@@ -1,0 +1,738 @@
+"""The whole-repo concurrency verifier and its runtime witness.
+
+Three layers under test, mirroring docs/concurrency.md:
+
+* the **static pass** (``mmlspark_tpu/analysis/concurrency.py``):
+  flagged + clean fixture pairs pin every rule (CC101–CC105), the
+  pragma policy (CC100 on an unjustified suppression), and the
+  repo-level zero-findings gate;
+* the **runtime lock-order witness** (``mmlspark_tpu/obs/lockwitness.py``):
+  held-stack edge recording, condition-wait truthfulness, the
+  crosscheck labels, and the ABBA fixture driven to the brink of a
+  real deadlock (timeout-guarded) with both conflicting orders
+  recorded;
+* the **lock-scope regression tests** for the bugs the verifier found
+  in ``serve/server.py`` and ``serve/batcher.py`` — batcher drains
+  must not run under the server/tick locks, and the ``lane_down``
+  hook must fire with no scheduler lock held.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from mmlspark_tpu.analysis.concurrency import (
+    RULES, analyze_paths, analyze_repo, analyze_sources,
+)
+from mmlspark_tpu.data.table import DataTable
+from mmlspark_tpu.models.bundle import ModelBundle
+from mmlspark_tpu.models.jax_model import JaxModel
+from mmlspark_tpu.models.zoo import MLP
+from mmlspark_tpu.obs import lockwitness as lw
+from mmlspark_tpu.serve import (
+    ModelServer, ServeConfig, ServerClosed,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+IN_DIM = 6
+
+
+def run_fixture(*sources):
+    """Analyze (module, source) pairs as one program."""
+    return analyze_sources([(src, f"{mod.replace('.', '/')}.py", mod)
+                            for mod, src in sources])
+
+
+def rules_of(an):
+    return sorted(f.rule for f in an.findings)
+
+
+def mlp_model(seed=0):
+    module = MLP(features=(8,), num_outputs=4)
+    params = module.init(jax.random.PRNGKey(seed),
+                         np.zeros((1, IN_DIM), np.float32))["params"]
+    bundle = ModelBundle(
+        module=module,
+        params=jax.tree_util.tree_map(np.asarray, params),
+        input_spec=(IN_DIM,), output_names=("features", "logits"),
+        name="mlp")
+    return JaxModel(model=bundle, input_col="x", output_col="s")
+
+
+def vec_table(n, seed=0):
+    rows = np.random.default_rng(seed).normal(
+        size=(n, IN_DIM)).astype(np.float32)
+    return DataTable({"x": list(rows)})
+
+
+@pytest.fixture(autouse=True)
+def _witness_off():
+    yield
+    lw.disable()
+    lw.reset()
+
+
+# ---- static pass: fixture pairs per rule ----
+
+
+ABBA_SRC = '''
+import threading
+
+_a = threading.Lock()
+_b = threading.Lock()
+
+
+def forward():
+    with _a:
+        with _b:
+            pass
+
+
+def backward():
+    with _b:
+        with _a:
+            pass
+'''
+
+ABBA_CLEAN_SRC = ABBA_SRC.replace(
+    "    with _b:\n        with _a:", "    with _a:\n        with _b:")
+
+
+class TestCC101LockOrderCycle:
+    def test_abba_flagged_with_both_witness_paths(self):
+        an = run_fixture(("fix.abba", ABBA_SRC))
+        assert rules_of(an) == ["CC101"]
+        msg = an.findings[0].message
+        # both directions of the cycle must be spelled out, each with
+        # its own file:line witness — an unactionable cycle report is
+        # as good as none
+        assert "fix.abba._a -> fix.abba._b" in msg
+        assert "fix.abba._b -> fix.abba._a" in msg
+        assert msg.count("fix/abba.py:") == 2
+
+    def test_consistent_order_clean(self):
+        an = run_fixture(("fix.abba", ABBA_CLEAN_SRC))
+        assert rules_of(an) == []
+        assert ("fix.abba._a", "fix.abba._b") in an.static_edges()
+
+    def test_cycle_through_callee_flagged(self):
+        src = '''
+import threading
+
+_a = threading.Lock()
+_b = threading.Lock()
+
+
+def inner_b():
+    with _b:
+        pass
+
+
+def forward():
+    with _a:
+        inner_b()
+
+
+def backward():
+    with _b:
+        inner_a()
+
+
+def inner_a():
+    with _a:
+        pass
+'''
+        an = run_fixture(("fix.chain", src))
+        assert "CC101" in rules_of(an)
+
+
+class TestCC102BlockingUnderLock:
+    def test_sleep_under_lock_flagged(self):
+        src = '''
+import threading
+import time
+
+_lk = threading.Lock()
+
+
+def hold():
+    with _lk:
+        time.sleep(0.5)
+'''
+        an = run_fixture(("fix.sleepy", src))
+        assert rules_of(an) == ["CC102"]
+        assert "fix.sleepy._lk" in an.findings[0].message
+
+    def test_sleep_after_release_clean(self):
+        src = '''
+import threading
+import time
+
+_lk = threading.Lock()
+
+
+def hold():
+    with _lk:
+        pass
+    time.sleep(0.5)
+'''
+        assert rules_of(run_fixture(("fix.sleepy", src))) == []
+
+    def test_blocking_reached_through_callee_flagged(self):
+        src = '''
+import threading
+import time
+
+_lk = threading.Lock()
+
+
+def slow_io():
+    time.sleep(0.5)
+
+
+def hold():
+    with _lk:
+        slow_io()
+'''
+        an = run_fixture(("fix.deep", src))
+        assert rules_of(an) == ["CC102"]
+
+    def test_condition_wait_is_not_blocking(self):
+        # cv.wait() releases the lock it waits on — the one blocking
+        # call that is legal (and idiomatic) under its own lock
+        src = '''
+import threading
+
+_cv = threading.Condition()
+
+
+def waiter():
+    with _cv:
+        while True:
+            _cv.wait(timeout=1.0)
+'''
+        assert rules_of(run_fixture(("fix.cv", src))) == []
+
+
+class TestCC103UnguardedAcquire:
+    def test_bare_acquire_flagged(self):
+        src = '''
+import threading
+
+_lk = threading.Lock()
+
+
+def bad():
+    _lk.acquire()
+    do_work()
+    _lk.release()
+
+
+def do_work():
+    pass
+'''
+        an = run_fixture(("fix.acq", src))
+        assert "CC103" in rules_of(an)
+
+    def test_try_finally_clean(self):
+        src = '''
+import threading
+
+_lk = threading.Lock()
+
+
+def good():
+    _lk.acquire()
+    try:
+        do_work()
+    finally:
+        _lk.release()
+
+
+def do_work():
+    pass
+'''
+        assert rules_of(run_fixture(("fix.acq", src))) == []
+
+
+class TestCC104JoinlessThread:
+    def test_nondaemon_unjoined_flagged(self):
+        src = '''
+import threading
+
+
+def spawn():
+    t = threading.Thread(target=work)
+    t.start()
+
+
+def work():
+    pass
+'''
+        an = run_fixture(("fix.thr", src))
+        assert rules_of(an) == ["CC104"]
+
+    def test_daemon_clean(self):
+        src = '''
+import threading
+
+
+def spawn():
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+
+
+def work():
+    pass
+'''
+        assert rules_of(run_fixture(("fix.thr", src))) == []
+
+    def test_joined_clean(self):
+        src = '''
+import threading
+
+
+def spawn():
+    t = threading.Thread(target=work)
+    t.start()
+    t.join()
+
+
+def work():
+    pass
+'''
+        assert rules_of(run_fixture(("fix.thr", src))) == []
+
+
+class TestCC105CallbackUnderLock:
+    def test_callback_under_lock_flagged(self):
+        src = '''
+import threading
+
+_lk = threading.Lock()
+
+
+def fire(on_done):
+    with _lk:
+        on_done()
+'''
+        an = run_fixture(("fix.cb", src))
+        assert rules_of(an) == ["CC105"]
+
+    def test_callback_after_release_clean(self):
+        src = '''
+import threading
+
+_lk = threading.Lock()
+
+
+def fire(on_done):
+    with _lk:
+        pass
+    on_done()
+'''
+        assert rules_of(run_fixture(("fix.cb", src))) == []
+
+
+class TestSuppressionPolicy:
+    SLEEPY = '''
+import threading
+import time
+
+_lk = threading.Lock()
+
+
+def hold():
+    with _lk:
+        time.sleep(0.5)  # concurrency: allow(CC102){just}
+'''
+
+    def test_unjustified_pragma_is_itself_a_finding(self):
+        src = self.SLEEPY.replace("{just}", "")
+        an = run_fixture(("fix.prag", src))
+        assert rules_of(an) == ["CC100"]
+        assert not an.suppressed
+
+    def test_justified_pragma_suppresses_and_records(self):
+        src = self.SLEEPY.replace("{just}", ": warming is the contract")
+        an = run_fixture(("fix.prag", src))
+        assert rules_of(an) == []
+        assert len(an.suppressed) == 1
+        f, why = an.suppressed[0]
+        assert f.rule == "CC102"
+        assert why == "warming is the contract"
+
+    def test_rule_catalogue_documented(self):
+        for r in ("CC100", "CC101", "CC102", "CC103", "CC104", "CC105"):
+            assert r in RULES and RULES[r]
+
+
+# ---- static pass: the repo itself ----
+
+
+class TestRepoGate:
+    def test_repo_has_zero_unsuppressed_findings(self):
+        an = analyze_repo()
+        assert [str(f) for f in an.findings] == []
+
+    def test_every_repo_suppression_is_justified(self):
+        an = analyze_repo()
+        assert an.suppressed, "the curated suppression list went empty"
+        for f, why in an.suppressed:
+            assert why.strip(), f"unjustified suppression: {f}"
+
+    def test_server_fixes_are_not_suppressions(self):
+        # the PR's serve/server.py lock-scope bugs were FIXED; pin that
+        # no CC102 is hiding behind a pragma there instead
+        an = analyze_repo()
+        for f, _why in an.suppressed:
+            assert not (f.rule == "CC102"
+                        and f.path.endswith("serve/server.py")), str(f)
+
+    def test_witness_identities_align_with_static_graph(self):
+        # the string passed to a named_* factory IS the identity the
+        # analyzer derives — the two graphs must join on these names
+        an = analyze_repo()
+        names = {ld.name for ld in an.locks.values()}
+        for hot in ("serve.batcher.DynamicBatcher._cv",
+                    "serve.batcher.DynamicBatcher._sched_cv",
+                    "serve.server.ModelServer._lock",
+                    "serve.lifecycle.CanaryState.tick_lock",
+                    "serve.lifecycle.DecisionJournal._lock",
+                    "obs.metrics.Counter._lock",
+                    "obs.runtime._lock",
+                    "obs.slo.SLOTracker._lock",
+                    "obs.flight.FlightRecorder._lock"):
+            assert hot in names, f"witnessed lock {hot} left the inventory"
+        assert ("serve.batcher.DynamicBatcher._cv",
+                "obs.metrics.Counter._lock") in an.static_edges()
+
+    def test_analyzer_never_imports_analyzed_code(self):
+        # a poisoned module must be analyzable, not executed
+        src = 'raise RuntimeError("imported!")\n'
+        an = run_fixture(("fix.poison", src))
+        assert rules_of(an) == []
+
+
+# ---- the runtime witness ----
+
+
+class TestWitnessRecording:
+    def test_disabled_records_nothing(self):
+        a = lw.named_lock("w.a")
+        with a:
+            pass
+        assert lw.edges() == {}
+        assert lw.acquire_counts() == {}
+
+    def test_nested_acquisition_records_edge(self):
+        a, b = lw.named_lock("w.a"), lw.named_lock("w.b")
+        lw.enable()
+        with a:
+            with b:
+                pass
+        assert ("w.a", "w.b") in lw.edges()
+        assert ("w.b", "w.a") not in lw.edges()
+        assert lw.acquire_counts() == {"w.a": 1, "w.b": 1}
+
+    def test_release_pops_held_stack(self):
+        a, b = lw.named_lock("w.a"), lw.named_lock("w.b")
+        lw.enable()
+        with a:
+            pass
+        with b:
+            pass
+        assert lw.edges() == {}  # never held together
+
+    def test_enable_resets_previous_run(self):
+        a, b = lw.named_lock("w.a"), lw.named_lock("w.b")
+        lw.enable()
+        with a:
+            with b:
+                pass
+        lw.enable()
+        assert lw.edges() == {}
+
+    def test_condition_wait_keeps_held_stack_truthful(self):
+        cv = lw.named_condition("w.cv")
+        other = lw.named_lock("w.other")
+        lw.enable()
+        woke = threading.Event()
+
+        def waiter():
+            with cv:
+                cv.wait(timeout=10.0)
+            # the wait RELEASED w.cv — locks taken while blocked in
+            # wait() on another thread must not edge from it
+            woke.set()
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        with other:  # acquired while waiter sits inside cv.wait()
+            pass
+        with cv:
+            cv.notify_all()
+        t.join(timeout=10.0)
+        assert woke.is_set()
+        assert ("w.cv", "w.other") not in lw.edges()
+        assert lw.violations() == []
+
+    def test_violations_report_both_directions(self):
+        a, b = lw.named_lock("w.a"), lw.named_lock("w.b")
+        lw.enable()
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        assert lw.violations() == [("w.a", "w.b")]
+
+    def test_crosscheck_labels(self):
+        a, b = lw.named_lock("w.a"), lw.named_lock("w.b")
+        c = lw.named_lock("w.c")
+        lw.enable()
+        with a:
+            with b:
+                pass
+        with a:
+            with c:
+                pass
+        cross = lw.crosscheck([("w.a", "w.b"), ("w.x", "w.y")])
+        assert cross["confirmed"] == [("w.a", "w.b")]
+        assert cross["plausible"] == [("w.x", "w.y")]
+        assert cross["novel"] == [("w.a", "w.c")]
+        assert cross["violations"] == []
+
+
+class TestABBABrink:
+    def test_abba_driven_to_the_brink_records_conflict(self):
+        """Two threads each hold their first lock and try the other's
+        under a timeout — the real ABBA interleaving, survived because
+        every blocking acquire is bounded. The witness must come back
+        with both orders (a CC101's runtime shadow) and the test must
+        finish: the fixture deadlocks precisely when the timeouts are
+        removed."""
+        a = lw.named_lock("abba.A")
+        b = lw.named_lock("abba.B")
+        lw.enable()
+        barrier = threading.Barrier(2, timeout=10.0)
+        outcomes = {}
+
+        def cross(name, first, second):
+            with first:
+                barrier.wait()  # both now hold their first lock
+                got = second.acquire(timeout=0.25)  # the brink
+                if got:
+                    second.release()
+                outcomes[name] = got
+
+        t1 = threading.Thread(target=cross, args=("t1", a, b), daemon=True)
+        t2 = threading.Thread(target=cross, args=("t2", b, a), daemon=True)
+        t0 = time.monotonic()
+        t1.start(); t2.start()
+        t1.join(timeout=10.0); t2.join(timeout=10.0)
+        assert not t1.is_alive() and not t2.is_alive(), "ABBA deadlocked"
+        assert time.monotonic() - t0 < 10.0
+        # whichever thread's timed acquire won (possibly both, after
+        # the loser released), seal both orders deterministically
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        assert ("abba.A", "abba.B") in lw.edges()
+        assert ("abba.B", "abba.A") in lw.edges()
+        assert lw.violations() == [("abba.A", "abba.B")]
+        cross_report = lw.crosscheck([("abba.A", "abba.B")])
+        assert cross_report["violations"] == [("abba.A", "abba.B")]
+
+
+# ---- regression tests: the lock-scope bugs the verifier found ----
+
+
+class _BlockingClose:
+    """Patch target: makes a batcher's close() block on an event so the
+    test can prove no server lock is held across the drain."""
+
+    def __init__(self, monkeypatch):
+        from mmlspark_tpu.serve.batcher import DynamicBatcher
+        self.entered = threading.Event()
+        self.release = threading.Event()
+        orig = DynamicBatcher.close
+        blocker = self
+
+        def slow_close(bself, drain=True):
+            blocker.entered.set()
+            assert blocker.release.wait(timeout=30.0)
+            return orig(bself, drain=drain)
+
+        monkeypatch.setattr(DynamicBatcher, "close", slow_close)
+
+
+class TestServeLockScopeRegressions:
+    def test_add_model_on_closed_server_drains_outside_lock(
+            self, monkeypatch):
+        """The CC102 fix: the closed-race cleanup close() (which joins
+        lane threads) must run after ``ModelServer._lock`` is released
+        — a server hit by a slow drain must keep answering reads."""
+        server = ModelServer(ServeConfig(buckets=(1,)))
+        server.close()
+        blocker = _BlockingClose(monkeypatch)
+        errs = []
+
+        def loser():
+            try:
+                server.add_model("late", mlp_model(),
+                                 example=vec_table(1))
+            except Exception as e:  # noqa: BLE001 - recorded for assert
+                errs.append(e)
+
+        t = threading.Thread(target=loser, daemon=True)
+        t.start()
+        assert blocker.entered.wait(timeout=30.0)
+        # the drain is mid-flight; the server lock must be free
+        t0 = time.monotonic()
+        assert server.models() == []
+        assert time.monotonic() - t0 < 1.0, (
+            "ModelServer._lock held across a batcher drain")
+        blocker.release.set()
+        t.join(timeout=30.0)
+        assert len(errs) == 1 and isinstance(errs[0], ServerClosed)
+
+    def test_rollback_drains_outside_tick_lock(self, monkeypatch):
+        """The CC102 fix in the lifecycle: a rollback's full drain must
+        not run under ``CanaryState.tick_lock`` — concurrent ticks must
+        see the detached canary and return immediately instead of
+        queueing behind the drain."""
+        server = ModelServer(ServeConfig(buckets=(1,)))
+        try:
+            server.add_model("m", mlp_model(0), example=vec_table(1))
+            server.deploy_canary("m", mlp_model(1), mode="shadow",
+                                 fraction=1.0, version="v2")
+            canary = server._models["m"].canary
+            blocker = _BlockingClose(monkeypatch)
+            results = {}
+
+            def roll():
+                results["rollback"] = server.rollback("m")
+
+            t = threading.Thread(target=roll, daemon=True)
+            t.start()
+            assert blocker.entered.wait(timeout=30.0)
+            # drain mid-flight: tick_lock is free and a concurrent tick
+            # sees the already-detached canary
+            t0 = time.monotonic()
+            assert canary.tick_lock.acquire(timeout=1.0), (
+                "tick_lock held across the canary drain")
+            canary.tick_lock.release()
+            assert server.lifecycle_tick("m") is None
+            assert time.monotonic() - t0 < 2.0
+            blocker.release.set()
+            t.join(timeout=30.0)
+            assert results["rollback"]["action"] == "rollback"
+        finally:
+            server.close()
+
+    def test_lane_down_hook_fires_with_no_scheduler_lock_held(self):
+        """The CC105 fix: the ``lane_down`` notification must fire
+        after ``_sched_cv`` is released, so a listener may re-enter the
+        batcher (queued(), the scheduler cv) without deadlocking."""
+        from mmlspark_tpu.core.retry import RetryPolicy
+        from mmlspark_tpu.serve import (
+            FaultPlan, FaultSpec, LaneFailed, faults,
+        )
+        server = ModelServer(ServeConfig(
+            buckets=(1, 2), max_queue=16,
+            lane_restart=RetryPolicy(max_attempts=1, jitter=0.0)))
+        reentered = threading.Event()
+        try:
+            server.add_model("m", mlp_model(), example=vec_table(1))
+            batcher = server._models["m"].batcher
+            journal_hook = batcher.on_lane_event
+
+            def reentrant_hook(kind, payload):
+                if kind == "lane_down":
+                    # both batcher locks must be acquirable from the
+                    # hook — this deadlocked when the notification
+                    # fired under _sched_cv
+                    assert batcher.queued >= 0  # takes _cv
+                    with batcher._sched_cv:
+                        pass
+                    reentered.set()
+                if journal_hook is not None:
+                    journal_hook(kind, payload)
+
+            batcher.on_lane_event = reentrant_hook
+            plan = FaultPlan([FaultSpec("lane_death", model="m")])
+            with faults.inject(plan):
+                h = server.submit("m", vec_table(2))
+                with pytest.raises(LaneFailed):
+                    h.result(timeout=30)
+            assert reentered.wait(timeout=30.0), (
+                "lane_down hook never completed — deadlocked against "
+                "the scheduler cv")
+        finally:
+            server.close()
+        from conftest import assert_no_leaked_threads
+        from mmlspark_tpu.serve.batcher import THREAD_PREFIX
+        assert_no_leaked_threads(THREAD_PREFIX)
+
+
+# ---- CLI surfaces ----
+
+
+class TestCLI:
+    def test_analyze_concurrency_json_schema(self, capsys):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import analyze
+        rc = analyze.main(["concurrency", "--json"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        rep = json.loads(out)
+        assert set(rep) == {"locks", "threads", "edges", "findings",
+                            "suppressed"}
+        assert rep["findings"] == []
+        for s in rep["suppressed"]:
+            assert {"rule", "path", "line", "message", "justification",
+                    "pragma"} <= set(s)
+            assert s["pragma"] == "allowed"
+            assert s["justification"].strip()
+
+    def test_analyze_concurrency_flags_fixture(self, tmp_path, capsys):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import analyze
+        bad = tmp_path / "abba.py"
+        bad.write_text(ABBA_SRC)
+        rc = analyze.main(["concurrency", str(bad)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "CC101" in out
+
+    def test_analyze_concurrency_missing_path_exit_2(self, capsys):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import analyze
+        assert analyze.main(
+            ["concurrency", "/nonexistent/nope.py"]) == 2
+
+    def test_lint_json_matches_schema(self, capsys):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import lint_jax
+        rc = lint_jax.main(["--json"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        rep = json.loads(out)
+        assert set(rep) == {"findings", "suppressed"}
+        assert rep["findings"] == []
+        for s in rep["suppressed"]:
+            assert {"rule", "path", "line", "message", "justification",
+                    "pragma"} <= set(s)

@@ -16,6 +16,8 @@ import pytest
 
 sys.path.insert(0, os.path.dirname(__file__))
 
+from conftest import assert_no_leaked_threads
+
 from mmlspark_tpu import obs
 from mmlspark_tpu.obs import fleet as obs_fleet
 from mmlspark_tpu.obs import flight as obs_flight
@@ -270,9 +272,7 @@ def test_exporter_no_stray_threads_and_retention(tmp_path):
     assert len(snaps) == 3  # bounded retention, newest kept
     obs_fleet.disable()
     assert not obs_ts.enabled()
-    names = [t.name for t in threading.enumerate()]
-    assert "FleetExporter" not in names
-    assert "TimeSeriesSampler" not in names
+    assert_no_leaked_threads("FleetExporter", "TimeSeriesSampler")
     # the exit snapshot is the final word
     view = FleetCollector(d).collect()
     assert view.processes[0].reason == "exit"
@@ -352,9 +352,7 @@ def test_flight_crash_dump_flushes_fleet_snapshot_order_pinned(tmp_path):
     finally:
         obs_fleet.disable()
         obs_flight.disable()
-    names = [t.name for t in threading.enumerate()]
-    assert "FleetExporter" not in names
-    assert "FlightWatchdog" not in names
+    assert_no_leaked_threads("FleetExporter", "FlightWatchdog")
 
 
 def test_collector_missing_dir_typed(tmp_path):
@@ -514,8 +512,7 @@ def test_timeseries_module_enable_disable_threads():
     assert any(t.name == "TimeSeriesSampler"
                for t in threading.enumerate())
     obs_ts.disable()
-    assert not any(t.name == "TimeSeriesSampler"
-                   for t in threading.enumerate())
+    assert_no_leaked_threads("TimeSeriesSampler")
     assert obs_ts.range_("serve.slo_burn_short") == {}
 
 

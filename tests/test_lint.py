@@ -12,7 +12,7 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
 
 from lint_jax import (  # noqa: E402
-    DEFAULT_ALLOWLIST, lint_paths, lint_source,
+    DEFAULT_ALLOWLIST, lint_paths, lint_source, lint_source_full,
 )
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -347,6 +347,116 @@ def test_jx108_pragma_suppresses():
            "    s = np.float64(0.5)  # lint-jax: allow(JX108)\n"
            "    return x * s\n")
     assert lint_source(src, "x.py") == []
+
+
+JX30X_FLAGGED = '''
+import threading
+import time
+import subprocess
+
+
+_lock = threading.Lock()
+
+
+def hold():
+    with _lock:
+        time.sleep(0.5)                               # JX301
+        subprocess.run(["true"])                      # JX301
+
+
+def manual():
+    _lock.acquire()                                   # JX302
+    work()
+    _lock.release()
+
+
+def spawn():
+    t = threading.Thread(target=work)                 # JX303
+    t.start()
+    t.join()
+
+
+def work():
+    pass
+'''
+
+
+def test_jx30x_flags_the_shallow_concurrency_face():
+    findings = lint_source(JX30X_FLAGGED, "fixture30x.py")
+    got = sorted((f.rule, f.line) for f in findings)
+    lines = JX30X_FLAGGED.splitlines()
+    want = sorted((rule, i + 1) for i, text in enumerate(lines)
+                  for rule in ("JX301", "JX302", "JX303")
+                  if f"# {rule}" in text)
+    assert got == want, (got, want)
+
+
+def test_jx30x_clean_counterparts():
+    # sleep outside the critical section, acquire chained to
+    # try/finally, spawn with an explicit lifecycle: all clean
+    src = ("import threading\nimport time\n"
+           "_lock = threading.Lock()\n"
+           "def hold():\n"
+           "    with _lock:\n"
+           "        pass\n"
+           "    time.sleep(0.5)\n"
+           "def manual():\n"
+           "    _lock.acquire()\n"
+           "    try:\n"
+           "        pass\n"
+           "    finally:\n"
+           "        _lock.release()\n"
+           "def spawn(work):\n"
+           "    t = threading.Thread(target=work, daemon=True)\n"
+           "    t.start()\n")
+    assert lint_source(src, "x.py") == []
+    # non-lockish receivers are out of scope for the shallow face
+    src2 = ("import time\n"
+            "def hold(session):\n"
+            "    with session:\n"
+            "        time.sleep(0.5)\n")
+    assert lint_source(src2, "x.py") == []
+
+
+def test_jx300_unjustified_jx3xx_pragma_is_a_finding():
+    src = ("import threading\nimport time\n"
+           "_lock = threading.Lock()\n"
+           "def hold():\n"
+           "    with _lock:\n"
+           "        time.sleep(0.5)  # lint-jax: allow(JX301)\n")
+    assert [f.rule for f in lint_source(src, "x.py")] == ["JX300"]
+
+
+def test_justified_jx3xx_pragma_suppresses_and_records():
+    src = ("import threading\nimport time\n"
+           "_lock = threading.Lock()\n"
+           "def hold():\n"
+           "    with _lock:\n"
+           "        time.sleep(0.5)"
+           "  # lint-jax: allow(JX301): warm wait is the contract\n")
+    findings, suppressed = lint_source_full(src, "x.py")
+    assert findings == []
+    assert len(suppressed) == 1
+    f, why = suppressed[0]
+    assert f.rule == "JX301"
+    assert why == "warm wait is the contract"
+
+
+def test_jx1xx_pragma_needs_no_justification():
+    # the justification requirement is scoped to the concurrency face;
+    # the established JX1xx pragma form stays valid
+    src = ("import jax\n"
+           "@jax.jit\n"
+           "def f(x):\n"
+           "    return x.item()  # lint-jax: allow(JX101)\n")
+    assert lint_source(src, "x.py") == []
+
+
+def test_allowlist_justifications_are_nonempty():
+    for suffix, rules in DEFAULT_ALLOWLIST.items():
+        for rule, why in rules.items():
+            assert why.strip(), (
+                f"allowlist entry ({suffix}, {rule}) has no justification")
 
 
 def test_pragma_suppresses():

@@ -379,12 +379,9 @@ class TestLifecycle:
                 outcomes.append("closed")
         assert "closed" in outcomes  # queued work was failed, not served
 
-    def test_no_leaked_threads_after_close(self):
-        def serve_threads():
-            return [t.name for t in threading.enumerate()
-                    if t.name.startswith(THREAD_PREFIX)]
-
-        assert serve_threads() == []
+    def test_no_leaked_threads_after_close(self, assert_no_leaked_threads):
+        from conftest import thread_names
+        assert_no_leaked_threads(THREAD_PREFIX, timeout=1.0)
         jm = JaxModel(model=mlp_bundle(), input_col="x",
                       output_col="scores")
         server = ModelServer(ServeConfig(buckets=(1, 4)))
@@ -392,9 +389,9 @@ class TestLifecycle:
                          example=vector_table(np.zeros((1, 6), np.float32)))
         server.predict("mlp", vector_table(np.zeros((2, 6), np.float32)),
                        timeout=30)
-        assert serve_threads() != []
+        assert thread_names(THREAD_PREFIX) != []
         server.close()
-        assert serve_threads() == []
+        assert_no_leaked_threads(THREAD_PREFIX)
 
 
 # ---- load-time validation (the analyzer gate) ----

@@ -13,7 +13,6 @@ Two families of guarantees:
 """
 
 import itertools
-import threading
 import time
 
 import numpy as np
@@ -21,23 +20,15 @@ import pytest
 
 import jax
 
+from conftest import assert_no_leaked_threads, thread_names
+
 from mmlspark_tpu.models.zoo import MLP
 from mmlspark_tpu.train import DeviceLoader, TrainConfig, Trainer
 from mmlspark_tpu.train.input import THREAD_PREFIX, input_stats
 
 
-def _loader_threads():
-    return [t for t in threading.enumerate()
-            if t.name.startswith(THREAD_PREFIX)]
-
-
 def _assert_no_leaked_threads(timeout=5.0):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if not _loader_threads():
-            return
-        time.sleep(0.02)
-    raise AssertionError(f"leaked loader threads: {_loader_threads()}")
+    assert_no_leaked_threads(THREAD_PREFIX, timeout=timeout)
 
 
 def _params_bitwise_equal(a, b):
@@ -145,10 +136,10 @@ class TestLoaderLifecycle:
         _assert_no_leaked_threads()
 
     def test_depth_zero_is_synchronous_no_thread(self):
-        before = _loader_threads()
+        before = thread_names(THREAD_PREFIX)
         ld = DeviceLoader(iter(range(5)), lambda v: v * 2, depth=0,
                           name="t-sync")
-        assert _loader_threads() == before  # no worker spawned
+        assert thread_names(THREAD_PREFIX) == before  # no worker spawned
         assert list(ld) == [0, 2, 4, 6, 8]
         assert ld.committed == ld.consumed == 5
         assert ld.max_ahead == 0

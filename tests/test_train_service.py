@@ -9,13 +9,14 @@ leaked threads)."""
 import json
 import os
 import sys
-import threading
 import time
 
 import numpy as np
 import pytest
 
 import jax
+
+from conftest import assert_no_leaked_threads
 
 from mmlspark_tpu.core.retry import RetryPolicy, call_with_retry
 from mmlspark_tpu.models.zoo import MLP
@@ -428,8 +429,7 @@ class TestServiceBeacon:
         # terminal write + no leaked beacon thread
         with open(os.path.join(str(tmp_path), "beacon_0.json")) as f:
             assert json.load(f)["status"] == "exited"
-        assert not [t for t in threading.enumerate()
-                    if t.name.startswith(BEACON_THREAD)]
+        assert_no_leaked_threads(BEACON_THREAD)
 
     def test_beacon_reports_crash_status(self, monkeypatch, tmp_path):
         self._env(monkeypatch, tmp_path)
@@ -438,8 +438,7 @@ class TestServiceBeacon:
                 raise RuntimeError("worker died")
         with open(os.path.join(str(tmp_path), "beacon_0.json")) as f:
             assert json.load(f)["status"] == "crashed"
-        assert not [t for t in threading.enumerate()
-                    if t.name.startswith(BEACON_THREAD)]
+        assert_no_leaked_threads(BEACON_THREAD)
 
 
 # ---------------------------------------------------------------------------
@@ -620,8 +619,7 @@ class TestTrainSupervisor:
         assert kinds.count("launch") == 2
         assert "restart" in kinds and "done" in kinds
         from mmlspark_tpu.train.service import WATCH_THREAD
-        assert not [t for t in threading.enumerate()
-                    if t.name.startswith(WATCH_THREAD)]
+        assert_no_leaked_threads(WATCH_THREAD)
 
     def test_supervisor_forgets_worker_heartbeats(self, tmp_path):
         """The satellite fix: dead workers' supervisor-side flight

@@ -1,12 +1,13 @@
 """analyze — pre-flight pipeline & codebase analysis CLI.
 
-Three subcommands::
+Four subcommands::
 
     python tools/analyze.py pipeline <saved-stage-dir> --schema schema.json
         [--rows N] [--precision f32|bf16|int8w] [--strict]
-    python tools/analyze.py code [path ...]
+    python tools/analyze.py code [path ...] [--json]
     python tools/analyze.py spmd [target ...] [--schema schema.json]
         [--rows N] [--cpu-devices N]
+    python tools/analyze.py concurrency [path ...] [--json]
 
 ``pipeline`` loads a persisted stage (a Pipeline/PipelineModel saved with
 ``.save()``, or any single stage), abstractly interprets it over the
@@ -36,6 +37,17 @@ dp-divisible). Prints each function's sharding contract, collective
 schedule, and findings; exit 1 when any finding survives. Runs on a
 virtual CPU mesh (``--cpu-devices``, default 8) — no accelerator is
 touched.
+
+``concurrency`` runs the whole-repo concurrency verifier
+(mmlspark_tpu/analysis/concurrency.py; docs/concurrency.md): lock
+inventory, interprocedural lock-order graph, and typed findings
+(CC101 lock-order cycle, CC102 blocking under lock, CC103 unguarded
+acquire, CC104 joinless non-daemon thread, CC105 callback under lock).
+Default target is the mmlspark_tpu package itself. ``--json`` emits
+the machine report (rule id, path, line, message, pragma status — the
+same schema as ``lint_jax --json``). Exit 0 clean, 1 when any
+unsuppressed finding survives, 2 on usage errors. Pure AST: nothing is
+imported or executed.
 """
 
 from __future__ import annotations
@@ -70,7 +82,34 @@ def cmd_pipeline(args: argparse.Namespace) -> int:
 
 def cmd_code(args: argparse.Namespace) -> int:
     import lint_jax
-    return lint_jax.main(args.paths)
+    return lint_jax.main(args.paths + (["--json"] if args.json else []))
+
+
+def cmd_concurrency(args: argparse.Namespace) -> int:
+    from mmlspark_tpu.analysis.concurrency import analyze_paths, analyze_repo
+    if args.paths:
+        bad = [p for p in args.paths if not os.path.exists(p)]
+        if bad:
+            print(f"no such path(s): {', '.join(bad)}", file=sys.stderr)
+            return 2
+        an = analyze_paths(args.paths)
+    else:
+        an = analyze_repo()
+    if args.json:
+        print(json.dumps(an.report(), indent=2, sort_keys=True))
+        return 1 if an.findings else 0
+    print(f"concurrency: {len(an.locks)} lock(s), {len(an.threads)} "
+          f"thread spawn(s), {len(an.edges)} lock-order edge(s)")
+    for e in sorted(an.edges, key=lambda e: (e.a, e.b)):
+        via = f"  (via {e.chain})" if e.chain else ""
+        print(f"  edge {e.a} -> {e.b}  [{e.path}:{e.line}]{via}")
+    for f, why in an.suppressed:
+        print(f"{f}  [suppressed: {why}]")
+    for f in sorted(an.findings, key=lambda f: (f.path, f.line, f.rule)):
+        print(f)
+    n = len(an.findings)
+    print(f"concurrency: {n} finding(s), {len(an.suppressed)} suppressed")
+    return 1 if n else 0
 
 
 def cmd_spmd(args: argparse.Namespace) -> int:
@@ -144,7 +183,19 @@ def main(argv: list[str] | None = None) -> int:
     c = sub.add_parser("code", help="run the JAX anti-pattern lint")
     c.add_argument("paths", nargs="*", help="files/dirs (default: "
                    "mmlspark_tpu/)")
+    c.add_argument("--json", action="store_true",
+                   help="machine-readable findings (rule, path, line, "
+                        "message, pragma status)")
     c.set_defaults(func=cmd_code)
+
+    k = sub.add_parser("concurrency",
+                       help="run the whole-repo concurrency verifier")
+    k.add_argument("paths", nargs="*",
+                   help="files/dirs (default: the mmlspark_tpu package)")
+    k.add_argument("--json", action="store_true",
+                   help="machine-readable report (locks, edges, findings "
+                        "with pragma status)")
+    k.set_defaults(func=cmd_concurrency)
 
     s = sub.add_parser("spmd", help="run the symbolic SPMD verifier")
     s.add_argument("targets", nargs="*",

@@ -81,19 +81,44 @@ semantically on the traced jaxpr; see docs/spmd_analysis.md):
   landed — the MoE pad-capacity bug class. Assign slot positions
   globally (gather counts, offset the local ranks).
 
+The JX3xx family is the AST face of the whole-repo concurrency verifier
+(``mmlspark_tpu/analysis/concurrency.py`` — which derives the same
+hazards interprocedurally, with lock identity and call-graph context;
+see docs/concurrency.md). These are the single-file checks cheap enough
+to run on every save:
+
+* **JX301 blocking call under a held lock** — ``time.sleep`` or a
+  ``subprocess.*`` call lexically inside a ``with <lock>:`` block (the
+  receiver *looks* like a lock: ``_lock``/``_cv``/``mutex``/...). The
+  deep pass (CC102) also follows callees and thread joins.
+* **JX302 manual acquire without try/finally** — a bare
+  ``lock.acquire()`` statement not immediately followed by a
+  ``try/finally`` that releases it: an exception between the two leaks
+  the lock forever (CC103's single-file face). Use ``with``.
+* **JX303 Thread() without an explicit daemon flag** — every spawn site
+  must declare its lifecycle; the deep pass (CC104) audits that
+  non-daemon threads have a reachable ``join()`` owner.
+
 Intentional exceptions are suppressed two ways, both documented in
 docs/static_analysis.md:
 
-* an inline pragma on the offending line: ``# lint-jax: allow(JX101)``;
-* the curated :data:`DEFAULT_ALLOWLIST` below (file-suffix → rules), for
-  files whose whole purpose is the exception (the shard_map shim itself).
+* an inline pragma on the offending line: ``# lint-jax: allow(JX101)``.
+  JX3xx pragmas **require a justification** after a colon
+  (``# lint-jax: allow(JX301): why this wait is the contract``) — an
+  unjustified one is itself a finding (**JX300**);
+* the curated :data:`DEFAULT_ALLOWLIST` below (file-suffix → rules,
+  with a per-entry justification), for files whose whole purpose is the
+  exception (the shard_map shim itself).
 
 Usage::
 
-    python tools/lint_jax.py [path ...]     # default: mmlspark_tpu/
+    python tools/lint_jax.py [path ...] [--json]   # default: mmlspark_tpu/
 
-Prints one line per finding and exits non-zero if any survive the
-allowlist. ``tests/test_lint.py`` runs this over the codebase in tier-1
+Prints one line per finding and exits 1 if any survive the allowlist
+(0 clean, 2 on a nonexistent path). ``--json`` emits the machine
+report — findings and suppressions with rule id, path, line, message,
+and pragma status — the same schema ``analyze.py concurrency --json``
+uses. ``tests/test_lint.py`` runs this over the codebase in tier-1
 (zero-findings gate) and over a seeded fixture (exact-findings gate).
 """
 
@@ -101,14 +126,20 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import json
 import os
+import re
 import sys
 
 # files whose entire purpose is the exception; suffix-matched against the
-# normalized path. Keep reasons here so the gate stays reviewable.
-DEFAULT_ALLOWLIST: dict[str, frozenset] = {
+# normalized path, each rule carrying its justification so the gate
+# stays reviewable in one place.
+DEFAULT_ALLOWLIST: dict[str, dict] = {
     # the compat shim itself: it must touch both jax.shard_map spellings
-    "mmlspark_tpu/parallel/mesh.py": frozenset({"JX103"}),
+    "mmlspark_tpu/parallel/mesh.py": {
+        "JX103": "the compat shim is the one module that must spell "
+                 "jax.shard_map directly (both sides of the "
+                 "check_rep/check_vma rename)"},
 }
 
 RULES = {
@@ -142,7 +173,34 @@ RULES = {
     "JX204": "capacity slots assigned from a local cumsum with no "
              "cross-shard count exchange (all_gather) before the "
              "dispatch; assign slot positions globally",
+    "JX300": "pragma suppressing a JX3xx rule has no justification; add "
+             "one after a colon: # lint-jax: allow(JX30n): why",
+    "JX301": "blocking call (time.sleep / subprocess.*) inside a "
+             "with-lock block; move the wait outside the critical "
+             "section (deep face: analysis/concurrency.py CC102)",
+    "JX302": "bare lock.acquire() not followed by try/finally release; "
+             "an exception in between leaks the lock — use `with` "
+             "(deep face: CC103)",
+    "JX303": "threading.Thread(...) without an explicit daemon= flag; "
+             "declare the lifecycle at the spawn site (deep face: "
+             "CC104 audits join ownership)",
 }
+
+# JX301's "looks like a lock" heuristic: the terminal name of a with-item
+# context expression. The deep pass resolves real lock identities; the
+# lint only needs the conventional spellings used in this codebase.
+_LOCKISH_RE = re.compile(
+    r"(?:^|_)(lock|locks|cv|cond|condition|mutex|sem|semaphore)$")
+
+# JX301's needles: module-level blocking calls that never belong inside
+# a critical section (thread joins / queue ops need type context — the
+# deep pass covers those)
+_BLOCKING_UNDER_LOCK = {("time", "sleep"), ("subprocess", "run"),
+                        ("subprocess", "call"), ("subprocess", "check_call"),
+                        ("subprocess", "check_output")}
+
+_PRAGMA_RE = re.compile(
+    r"lint-jax:\s*allow\(([A-Z0-9,\s]+)\)(?::\s*(.*))?")
 
 # mirror of parallel/mesh.py AXES — the lint must not import jax code
 _MESH_AXES = ("dp", "fsdp", "tp", "sp", "pp", "ep")
@@ -217,6 +275,10 @@ class Finding:
     def __str__(self) -> str:
         return f"{self.path}:{self.line}: {self.rule} {self.message}"
 
+    def as_dict(self) -> dict:  # same schema as analysis/concurrency.py
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message}
+
 
 def _callee_name(node: ast.AST) -> str | None:
     """Terminal name of a call target: ``step`` / ``self.step_masked``."""
@@ -284,6 +346,7 @@ class _Linter(ast.NodeVisitor):
         self.path = path
         self.lines = source.splitlines()
         self.findings: list[Finding] = []
+        self.suppressed: list[tuple[Finding, str]] = []  # (finding, why)
         self.loop_depth = 0
         self.jitted_names: set[str] = set()
         self.jitted_lambdas: list[ast.Lambda] = []
@@ -320,13 +383,75 @@ class _Linter(ast.NodeVisitor):
     def _emit(self, node: ast.AST, rule: str, message: str) -> None:
         line = getattr(node, "lineno", 0)
         text = self.lines[line - 1] if 0 < line <= len(self.lines) else ""
-        if f"lint-jax: allow({rule})" in text:
-            return
         finding = Finding(self.path, line, rule, message)
+        m = _PRAGMA_RE.search(text)
+        if m and rule in {r.strip() for r in m.group(1).split(",")}:
+            why = (m.group(2) or "").strip()
+            if rule.startswith("JX3") and not why:
+                # concurrency-family suppressions must say why — an
+                # unjustified pragma is itself a finding (mirrors CC100)
+                finding = Finding(self.path, line, "JX300", RULES["JX300"])
+                if finding not in self.findings:
+                    self.findings.append(finding)
+                return
+            if finding not in (f for f, _ in self.suppressed):
+                self.suppressed.append((finding, why))
+            return
         # nested loops run the JX105 subtree analysis once per level —
         # report each site once
         if finding not in self.findings:
             self.findings.append(finding)
+
+    # -- JX301 / JX302 / JX303: single-file concurrency face --
+
+    @staticmethod
+    def _lockish(expr: ast.AST) -> bool:
+        name = None
+        if isinstance(expr, ast.Attribute):
+            name = expr.attr
+        elif isinstance(expr, ast.Name):
+            name = expr.id
+        return bool(name and _LOCKISH_RE.search(name.lower()))
+
+    def visit_With(self, node: ast.With) -> None:
+        if any(self._lockish(item.context_expr) for item in node.items):
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                f = sub.func
+                if (isinstance(f, ast.Attribute)
+                        and isinstance(f.value, ast.Name)
+                        and (f.value.id, f.attr) in _BLOCKING_UNDER_LOCK):
+                    self._emit(sub, "JX301",
+                               f"{f.value.id}.{f.attr}(...) blocks inside "
+                               "a with-lock block; move the wait outside "
+                               "the critical section")
+        self.generic_visit(node)
+
+    def lint_acquire_blocks(self, tree: ast.AST) -> None:
+        """JX302: a bare ``lock.acquire()`` statement must be chained to
+        a ``try/finally`` releasing it as its immediate next sibling."""
+        for node in ast.walk(tree):
+            for field in ("body", "orelse", "finalbody"):
+                stmts = getattr(node, field, None)
+                if not isinstance(stmts, list):
+                    continue
+                for i, stmt in enumerate(stmts):
+                    if not (isinstance(stmt, ast.Expr)
+                            and isinstance(stmt.value, ast.Call)
+                            and isinstance(stmt.value.func, ast.Attribute)
+                            and stmt.value.func.attr == "acquire"
+                            and self._lockish(stmt.value.func.value)):
+                        continue
+                    nxt = stmts[i + 1] if i + 1 < len(stmts) else None
+                    if isinstance(nxt, ast.Try) and any(
+                            isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Attribute)
+                            and sub.func.attr == "release"
+                            for s in nxt.finalbody
+                            for sub in ast.walk(s)):
+                        continue
+                    self._emit(stmt.value, "JX302", RULES["JX302"])
 
     # -- JX102 / JX103 / JX104 / JX105: module-wide --
 
@@ -512,6 +637,13 @@ class _Linter(ast.NodeVisitor):
                 and isinstance(node.args[1], ast.Constant)
                 and node.args[1].value == "shard_map"):
             self._emit(node, "JX103", RULES["JX103"])
+        # JX303: Thread spawned without declaring its lifecycle
+        if ((isinstance(func, ast.Attribute) and func.attr == "Thread"
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "threading")
+                or (isinstance(func, ast.Name) and func.id == "Thread")):
+            if not any(kw.arg == "daemon" for kw in node.keywords):
+                self._emit(node, "JX303", RULES["JX303"])
         # Param(default=<mutable>)
         if (isinstance(func, ast.Name) and func.id == "Param") or (
                 isinstance(func, ast.Attribute) and func.attr == "Param"):
@@ -684,27 +816,39 @@ class _Linter(ast.NodeVisitor):
                                    "the computation in jax")
 
 
-def lint_source(source: str, path: str = "<string>") -> list[Finding]:
+def lint_source_full(source: str, path: str = "<string>",
+                     ) -> tuple[list[Finding], list[tuple[Finding, str]]]:
+    """(active findings, pragma-suppressed (finding, justification))."""
     tree = ast.parse(source, filename=path)
     linter = _Linter(path, source)
     linter.collect(tree)
     linter.visit(tree)
     linter.lint_lambdas()
-    return linter.findings
+    linter.lint_acquire_blocks(tree)
+    return linter.findings, linter.suppressed
 
 
-def _allowed(path: str, rule: str, allowlist: dict) -> bool:
+def lint_source(source: str, path: str = "<string>") -> list[Finding]:
+    return lint_source_full(source, path)[0]
+
+
+def _allowed(path: str, rule: str, allowlist: dict) -> str | None:
+    """The allowlist justification suppressing (path, rule), or None.
+    Legacy frozenset entries justify as the empty string."""
     norm = path.replace(os.sep, "/")
     for suffix, rules in allowlist.items():
         if norm.endswith(suffix) and rule in rules:
-            return True
-    return False
+            return rules[rule] if isinstance(rules, dict) else ""
+    return None
 
 
-def lint_paths(paths: list[str],
-               allowlist: dict | None = None) -> list[Finding]:
+def lint_paths_full(paths: list[str], allowlist: dict | None = None,
+                    ) -> tuple[list[Finding], list[dict]]:
+    """(active findings, suppressed entries with pragma status) over
+    files/trees — the ``--json`` payload halves."""
     allowlist = DEFAULT_ALLOWLIST if allowlist is None else allowlist
     findings: list[Finding] = []
+    suppressed: list[dict] = []
     for root in paths:
         files = []
         if os.path.isdir(root):
@@ -716,19 +860,47 @@ def lint_paths(paths: list[str],
         for f in sorted(files):
             with open(f, "r", encoding="utf-8") as fh:
                 src = fh.read()
-            findings.extend(x for x in lint_source(src, f)
-                            if not _allowed(f, x.rule, allowlist))
-    return findings
+            active, pragmaed = lint_source_full(src, f)
+            for x in active:
+                why = _allowed(f, x.rule, allowlist)
+                if why is None:
+                    findings.append(x)
+                else:
+                    suppressed.append({**x.as_dict(), "pragma": "allowed",
+                                       "justification": why})
+            suppressed.extend({**x.as_dict(), "pragma": "allowed",
+                               "justification": why}
+                              for x, why in pragmaed)
+    return findings, suppressed
+
+
+def lint_paths(paths: list[str],
+               allowlist: dict | None = None) -> list[Finding]:
+    return lint_paths_full(paths, allowlist)[0]
 
 
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
+    json_out = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    bad = [p for p in argv if not os.path.exists(p)]
+    if bad:
+        print(f"no such path(s): {', '.join(bad)}", file=sys.stderr)
+        return 2
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     paths = argv or [os.path.join(repo, "mmlspark_tpu")]
-    findings = lint_paths(paths)
+    findings, suppressed = lint_paths_full(paths)
+    if json_out:
+        print(json.dumps(
+            {"findings": [{**f.as_dict(), "pragma": "none"}
+                          for f in findings],
+             "suppressed": suppressed},
+            indent=2, sort_keys=True))
+        return 1 if findings else 0
     for f in findings:
         print(f)
-    print(f"lint_jax: {len(findings)} finding(s) over {paths}")
+    print(f"lint_jax: {len(findings)} finding(s) over {paths} "
+          f"({len(suppressed)} suppressed)")
     return 1 if findings else 0
 
 

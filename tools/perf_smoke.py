@@ -2002,6 +2002,160 @@ def check_spmd_clean() -> dict:
     }
 
 
+def check_concurrency_clean(min_confirmed: int = 5,
+                            max_static_s: float = 20.0,
+                            max_fraction: float = 0.02) -> dict:
+    """The whole-repo concurrency gate (docs/concurrency.md), three
+    clauses in one pass:
+
+    1. **static** — ``analysis.concurrency.analyze_repo()`` over the
+       package finishes inside ``max_static_s`` with ZERO unsuppressed
+       findings, and every suppression carries a non-empty
+       justification (the pragma/allowlist policy is load-bearing);
+    2. **witness** — a dp=4 serve burst (shadow canary deployed,
+       overload driven, ``snapshot()`` + ``lifecycle_tick()`` +
+       ``rollback()`` exercised) runs with the lock-order witness on:
+       at least ``min_confirmed`` static lock-order edges must be
+       CONFIRMED by real acquisitions, with ZERO order violations
+       (no edge observed in both directions);
+    3. **overhead** — the witness's disabled-path cost — the delta of a
+       witnessed acquire/release cycle over a raw ``threading.Lock``,
+       priced at every acquisition the burst actually performed — stays
+       under ``max_fraction`` (2%) of the burst wall time, the same
+       analytic-bound methodology as :func:`check_obs_overhead`.
+    """
+    import threading
+    import time
+
+    import jax
+
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.analysis.concurrency import analyze_repo
+    from mmlspark_tpu.data.table import DataTable
+    from mmlspark_tpu.models.jax_model import JaxModel
+    from mmlspark_tpu.obs import lockwitness as lw
+    from mmlspark_tpu.serve.config import ServeConfig
+    from mmlspark_tpu.serve.errors import Overloaded
+    from mmlspark_tpu.serve.server import ModelServer
+
+    if len(jax.devices()) < 8:
+        raise AssertionError(
+            "check_concurrency_clean needs the 8-device CPU mesh "
+            "(--xla_force_host_platform_device_count=8); got "
+            f"{len(jax.devices())} device(s)")
+    assert not lw.enabled(), (
+        "check_concurrency_clean must start with the witness disabled")
+
+    # -- 1. static pass under a wall budget --
+    t0 = time.perf_counter()
+    an = analyze_repo()
+    static_s = time.perf_counter() - t0
+    assert static_s < max_static_s, (
+        f"whole-repo concurrency pass took {static_s:.1f}s "
+        f"(budget {max_static_s:.0f}s) — the analyzer grew "
+        "superlinear work")
+    findings = [str(f) for f in an.findings]
+    assert findings == [], (
+        "concurrency verifier findings over the repo:\n"
+        + "\n".join(findings))
+    for f, why in an.suppressed:
+        assert why.strip(), f"unjustified concurrency suppression: {f}"
+
+    # -- 2. witnessed dp=4 serve burst --
+    sleep_s, n_req, rows = 0.004, 64, 4
+    bundle, _probe = _latency_bundle(sleep_s)
+    bundle2, _probe2 = _latency_bundle(sleep_s)
+    jm = JaxModel(model=bundle, input_col="x", output_col="scores")
+    jm2 = JaxModel(model=bundle2, input_col="x", output_col="scores")
+    d_in = int(np.prod(tuple(bundle.input_spec)))
+    rng = np.random.default_rng(7)
+
+    def table(n):
+        return DataTable({"x": [rng.random(d_in).astype(np.float32)
+                                for _ in range(n)]})
+
+    obs.enable(max_traces=4)
+    lw.enable()
+    rejected = 0
+    t0 = time.perf_counter()
+    try:
+        srv = ModelServer(ServeConfig(buckets=(8,), max_queue=40,
+                                      deadline_ms=None, mesh="dp=4"))
+        srv.add_model("m", jm, example=table(1))
+        srv.deploy_canary("m", jm2, mode="shadow", fraction=1.0,
+                          version="v2")
+        handles = []
+        for _ in range(n_req):
+            try:
+                handles.append(srv.submit("m", table(rows)))
+            except Overloaded:
+                rejected += 1
+        for h in handles:
+            h.result(timeout=60.0)
+        srv.snapshot()
+        srv.lifecycle_tick("m")
+        srv.rollback("m")
+        srv.close()
+    finally:
+        burst_wall = time.perf_counter() - t0
+        lw.disable()
+        obs.disable()
+        obs.clear()
+    cross = lw.crosscheck(an.static_edges())
+    n_ops = sum(lw.acquire_counts().values())
+    lw.reset()
+    assert cross["violations"] == [], (
+        "lock-order inversion observed at runtime (both directions of "
+        f"an edge executed): {cross['violations']}")
+    assert len(cross["confirmed"]) >= min_confirmed, (
+        f"only {len(cross['confirmed'])} of {len(an.static_edges())} "
+        f"static lock-order edges confirmed at runtime (need "
+        f">={min_confirmed}): {cross['confirmed']} — the serve burst "
+        "stopped exercising the hot lock nests, or the witness names "
+        "drifted from the analyzer's identities")
+
+    # -- 3. disabled-path witness cost, priced per real acquisition --
+    reps = 200_000
+    probe_w = lw.named_lock("concurrency.overhead.probe")
+    probe_r = threading.Lock()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        with probe_r:
+            pass
+    unit_raw = (time.perf_counter() - t0) / reps
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        with probe_w:
+            pass
+    unit_wit = (time.perf_counter() - t0) / reps
+    delta = max(0.0, unit_wit - unit_raw)
+    fraction = (delta * n_ops) / burst_wall if burst_wall > 0 else 0.0
+    assert fraction < max_fraction, (
+        f"disabled-path witness overhead bound {fraction:.4%} exceeds "
+        f"{max_fraction:.0%} of the serve burst ({n_ops} acquisitions "
+        f"× {delta * 1e9:.0f} ns vs {burst_wall * 1e3:.0f} ms) — the "
+        "witness grew work on its disabled path")
+
+    return {
+        "locks": len(an.locks),
+        "static_edges": len(an.static_edges()),
+        "static_s": round(static_s, 2),
+        "findings": len(findings),
+        "suppressed": len(an.suppressed),
+        "confirmed": len(cross["confirmed"]),
+        "plausible": len(cross["plausible"]),
+        "novel": len(cross["novel"]),
+        "violations": len(cross["violations"]),
+        "burst_requests": n_req,
+        "burst_rejected": rejected,
+        "burst_wall_s": round(burst_wall, 2),
+        "lock_ops": n_ops,
+        "witness_delta_ns": round(delta * 1e9, 1),
+        "overhead_fraction_bound": round(fraction, 6),
+        "max_fraction": max_fraction,
+    }
+
+
 def _timed_once(pm, table, time_mod) -> float:
     t0 = time_mod.perf_counter()
     pm.transform(table)
@@ -2030,6 +2184,7 @@ def main() -> int:
         fleet_obs = check_fleet_obs()
         flight_rec = check_flight_recorder()
         spmd = check_spmd_clean()
+        concurrency = check_concurrency_clean()
     except AssertionError as e:
         print(json.dumps({"perf_smoke": "FAIL", "reason": str(e)}))
         return 1
@@ -2045,7 +2200,8 @@ def main() -> int:
                       "obs_overhead": obs_overhead,
                       "obs_request_tracing": obs_tracing,
                       "fleet_obs": fleet_obs,
-                      "flight_recorder": flight_rec, "spmd": spmd}))
+                      "flight_recorder": flight_rec, "spmd": spmd,
+                      "concurrency": concurrency}))
     return 0
 
 
