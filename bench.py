@@ -480,6 +480,72 @@ def bench_serve_swap(rng, n_total: int = 160, conc: int = 8) -> dict:
     return out
 
 
+def bench_serve_generate(rng, n_req: int = 32, max_new: int = 16) -> dict:
+    """Token-serving bench (round 18): a streaming generate burst
+    through the continuous-batching engine (serve/generate.py) — wall
+    tokens/s, TTFT p50/p99 and ITL p99 from the engine's ServerStats,
+    mean slot occupancy, and the compiled-program count against the
+    ``len(prefill_buckets) + 1`` budget.
+
+    A small causal TransformerTagger on CPU is a labeled-regime number
+    like the precision A/B — the cross-regime observables are the
+    program budget and occupancy; real-chip rounds read the
+    throughput/latency. Warmup goes through ``generate_oneshot`` (the
+    same compiled programs, no stats), so the burst percentiles never
+    include compile time."""
+    import jax
+
+    from mmlspark_tpu.models.sequence import TransformerTagger
+    from mmlspark_tpu.serve import (
+        Client, GenerateConfig, ModelServer, ServeConfig,
+    )
+
+    vocab, t_max = 128, 128
+    model = TransformerTagger(vocab_size=vocab, embed_dim=32, num_heads=2,
+                              num_layers=2, mlp_dim=64, num_tags=vocab,
+                              max_len=t_max, causal=True)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((1, 8), np.int32))["params"]
+    cfg = GenerateConfig(slots=8, t_max=t_max, prefill_buckets=(8, 32),
+                         prefill_rows=4, max_new_tokens=max_new,
+                         max_queue=n_req + 8)
+    prompts = [[int(t) for t in rng.integers(1, vocab,
+                                             int(rng.integers(4, 30)))]
+               for _ in range(n_req)]
+    server = ModelServer(ServeConfig())
+    try:
+        server.add_generator("lm", model, params, config=cfg)
+        for blen in cfg.prefill_buckets:  # warm the ladder + decode
+            server.generate_oneshot(
+                "lm", [int(t) for t in rng.integers(1, vocab, blen - 1)],
+                max_new_tokens=2)
+        client = Client(server)
+        t0 = time.perf_counter()
+        streams = [client.generate("lm", p, stream=True) for p in prompts]
+        toks = [st.result(timeout=600) for st in streams]
+        wall = time.perf_counter() - t0
+        snap = server.snapshot()["lm"]
+        programs = snap["programs_compiled"]
+    finally:
+        server.close()
+    n_tokens = sum(len(t) for t in toks)
+    ttft = snap.get("ttft_ms") or {}
+    itl = snap.get("itl_ms") or {}
+    return {
+        "requests": n_req,
+        "max_new_tokens": max_new,
+        "tokens": n_tokens,
+        "tokens_per_s": round(n_tokens / wall, 1),
+        "ttft_p50_ms": ttft.get("p50"),
+        "ttft_p99_ms": ttft.get("p99"),
+        "itl_p99_ms": itl.get("p99"),
+        "slot_occupancy_mean": snap.get("slot_occupancy_mean"),
+        "decode_steps": snap.get("decode_steps"),
+        "programs_compiled": programs,
+        "program_budget": len(cfg.prefill_buckets) + 1,
+    }
+
+
 def bench_serve_sharded(jm, rng, n_total: int = 192,
                         conc: int = 8) -> dict:
     """Sharded-serving scaling A/B: one chip (``dp=1``) vs DP-replica
@@ -1021,6 +1087,16 @@ def main() -> int:
     except Exception as e:  # best-effort metric; label failures accurately
         serve_swap = {"error": f"{type(e).__name__}: {e}"}
 
+    # token serving (round 18): streaming generate burst through the
+    # continuous-batching engine — tokens/s, TTFT/ITL percentiles, slot
+    # occupancy, and the compiled-program budget (docs/serving.md
+    # §token streaming)
+    serve_generate: dict | None = None
+    try:
+        serve_generate = bench_serve_generate(rng)
+    except Exception as e:  # best-effort metric; label failures accurately
+        serve_generate = {"error": f"{type(e).__name__}: {e}"}
+
     # compile-cache load-wall A/B (round 18): cold (compile + publish)
     # vs warm (deserialize) model load against one cache dir — the
     # restart wall a fleet actually pays (docs/serving.md §compile
@@ -1113,6 +1189,17 @@ def main() -> int:
             "swap", {}).get("p99_ms"),
         "serve_swap_dropped": (serve_swap or {}).get(
             "swap", {}).get("dropped"),
+        "serve_generate": serve_generate,
+        "serve_generate_tokens_per_s": (serve_generate or {}).get(
+            "tokens_per_s"),
+        "serve_generate_ttft_p50_ms": (serve_generate or {}).get(
+            "ttft_p50_ms"),
+        "serve_generate_ttft_p99_ms": (serve_generate or {}).get(
+            "ttft_p99_ms"),
+        "serve_generate_itl_p99_ms": (serve_generate or {}).get(
+            "itl_p99_ms"),
+        "serve_generate_slot_occupancy": (serve_generate or {}).get(
+            "slot_occupancy_mean"),
         "serve_load_wall_cold_s": (serve_load_wall or {}).get(
             "cold", {}).get("load_wall_s"),
         "serve_load_wall_warm_s": (serve_load_wall or {}).get(

@@ -716,6 +716,42 @@ def _build_serve_lowprec(mesh):
     return composite, (params_tuple, entry)
 
 
+def _build_serve_decode(mesh):
+    """The continuous-batching decode program (serve/generate.py): ONE
+    fixed-shape ``[slots]`` token step over the slot-major KV cache,
+    requests joining/leaving through the active mask. A DP replica owns
+    its own slot table and cache, so the contract is ZERO manual
+    collectives — a collective here would lockstep independent replicas'
+    decode loops. Donation safety (the cache buffers return
+    shape/dtype-identical, so ``donate_argnums=(0,)`` updates in place)
+    is the other half of the contract; :func:`audit_stateful_spmd` and
+    tests/test_spmd.py pin it on this same build."""
+    import jax
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.models.sequence import TransformerTagger
+    from mmlspark_tpu.serve.generate import build_decode_step
+
+    S, L, H, T, hd = 4, 2, 2, 16, 8
+    model = TransformerTagger(vocab_size=32, embed_dim=H * hd,
+                              num_heads=H, num_layers=L, mlp_dim=32,
+                              num_tags=32, max_len=T, causal=True)
+    params = jax.eval_shape(model.init, jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+    step = build_decode_step(model)
+    bufs = {"k": jax.ShapeDtypeStruct((S, L, H, T, hd), jnp.float32),
+            "v": jax.ShapeDtypeStruct((S, L, H, T, hd), jnp.float32)}
+    iv = jax.ShapeDtypeStruct((S,), jnp.int32)
+    bv = jax.ShapeDtypeStruct((S,), jnp.bool_)
+    return step, (bufs, params, iv, iv, bv, iv, bv)
+
+
+def serve_decode_build(mesh: Any = None):
+    """Public handle on the decode entry's build (what
+    ``tests/test_spmd.py`` and the stateful audit reuse)."""
+    return _build_serve_decode(mesh)
+
+
 ENTRY_POINTS: tuple[EntryPoint, ...] = (
     EntryPoint("moe_apply", {"dp": 2, "ep": 4},
                ("dp", "fsdp", "ep"), _build_moe, capacity_dispatch=True),
@@ -742,6 +778,12 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
                _build_serve_lowprec, expect_no_collectives=True),
     EntryPoint("serve_int8w_tp", {"dp": 2, "tp": 4}, (),
                _build_serve_lowprec, expect_no_collectives=True),
+    # the continuous-batching token-serving decode step (PR 18,
+    # serve/generate.py): one fixed-shape [slots] program over the
+    # donated KV cache — a DP replica's decode loop must stay
+    # manual-collective-free, like every other replica segment
+    EntryPoint("serve_decode_replica", {"dp": 1}, (),
+               _build_serve_decode, expect_no_collectives=True),
 )
 
 
@@ -893,10 +935,53 @@ def audit_plan_spmd(stages: list, meta_of: Callable,
     return audit
 
 
+def audit_stateful_spmd(step_fn: Callable, state_structs: Any,
+                        args: tuple, name: str = "<stateful>",
+                        expect_axes: Iterable[str] | None = None
+                        ) -> SpmdReport:
+    """SPMD audit of one stateful plan segment
+    (:class:`~mmlspark_tpu.core.plan.StatefulSegment`): the multi-chip
+    audit's coverage of programs that OWN device state across
+    dispatches, which ``audit_plan_spmd``'s stateless segment replay
+    cannot see.
+
+    Two contracts, both static:
+
+    * the usual collective contract — ``expect_axes=None`` (the
+      dp-replica default) requires ZERO manual collectives
+      (SPMD105), any declared axes bound communication (SPMD101);
+    * **donation safety** (SPMD106): the step's returned state subtree
+      must match the input state leaf-for-leaf in shape AND dtype, or
+      ``donate_argnums=(0,)`` cannot alias the buffers in place — XLA
+      silently falls back to a copy on CPU and refuses the donation on
+      TPU, turning every token step into a full cache copy.
+    """
+    import jax
+
+    report = verify_function(step_fn, state_structs, *args, name=name,
+                             expect_axes=expect_axes,
+                             expect_no_collectives=expect_axes is None)
+    out = jax.eval_shape(step_fn, state_structs, *args)
+    new_state = out[0] if isinstance(out, tuple) else out
+    in_leaves, in_tree = jax.tree_util.tree_flatten(state_structs)
+    out_leaves, out_tree = jax.tree_util.tree_flatten(new_state)
+    mismatched = in_tree != out_tree or any(
+        a.shape != b.shape or a.dtype != b.dtype
+        for a, b in zip(in_leaves, out_leaves))
+    if mismatched:
+        report.findings.append(SpmdFinding(
+            "SPMD106", name,
+            "stateful step returns a state subtree that does not match "
+            "the input state leaf-for-leaf (shape/dtype/structure): the "
+            "donated buffers cannot be updated in place — every "
+            "dispatch would copy the whole device state"))
+    return report
+
+
 # ---- the repo-wide gate ----
 
 _FENCED_SOURCES = ("train/loop.py", "train/input.py", "serve/batcher.py",
-                   "serve/mesh.py")
+                   "serve/mesh.py", "serve/generate.py")
 
 
 def verify_repo(repo_root: str | None = None,

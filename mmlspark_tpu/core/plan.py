@@ -956,3 +956,137 @@ def execute_stages(stages: list, table: DataTable,
         table = stages[i].transform(table)
         i += 1
     return table
+
+
+# ---- stateful segments (device-resident state across dispatches) ----
+#
+# Everything above treats a compiled segment as a pure function: params
+# upload once, every dispatch streams batch in → batch out, and nothing
+# survives on the device between calls. Autoregressive decode breaks
+# that shape — the KV-cache is device state that every token step reads
+# AND rewrites, and re-uploading it per step would cost
+# O(slots·layers·T_max·d) H2D per token. A *stateful segment* is the
+# minimal extension: a jitted step function whose first argument is a
+# device-resident buffer pytree, compiled with ``donate_argnums=(0,)``
+# so XLA reuses the input cache's buffers for the output cache (an
+# in-place update, no reallocation), with the rebind of the new state
+# serialized under a witnessed lock. The jitted step registers in the
+# owner's ``_plan_cache`` under a ``("stateful", name)`` key so
+# ``obs.runtime.compiled_programs`` counts its programs on the same
+# ladder budget as stateless segments.
+
+class SegmentState:
+    """Device-resident buffers owned by a stateful segment.
+
+    ``buffers`` is an arbitrary jax pytree living on the device (for the
+    serve plane: the slot-major KV-cache pair
+    ``[slots, layers, heads, T_max, d]`` of one replica lane). Reads and
+    rebinds go through :meth:`swap` under the witnessed lock — after a
+    donated dispatch the OLD buffers are deleted by XLA, so a racing
+    reader holding a stale reference would fetch a dead buffer.
+    """
+
+    __slots__ = ("name", "_buffers", "_lock")
+
+    def __init__(self, name: str, buffers: Any):
+        from mmlspark_tpu.obs.lockwitness import named_lock
+        self.name = name
+        self._buffers = buffers
+        self._lock = named_lock("core.plan.SegmentState._lock")
+
+    @property
+    def buffers(self) -> Any:
+        with self._lock:
+            return self._buffers
+
+    def swap(self, fn: Callable[[Any], tuple]) -> Any:
+        """Run ``fn(buffers) -> (new_buffers, out)`` under the lock,
+        rebind the state to ``new_buffers``, and return ``out``. The ONE
+        mutation point: dispatches that donate the old buffers and reads
+        that snapshot them serialize here."""
+        with self._lock:
+            self._buffers, out = fn(self._buffers)
+            return out
+
+
+def allocate_segment_state(name: str, shapes: dict, target: Any = None,
+                           dtype: Any = None) -> SegmentState:
+    """Allocate zeroed device buffers for a stateful segment.
+
+    ``shapes`` maps buffer name → shape tuple (all sharing ``dtype``,
+    default f32); ``target`` is a device or sharding for
+    ``jax.device_put`` (default placement when None). Zero is the right
+    init for a KV-cache: the active-slot mask keeps unwritten positions
+    out of every attention denominator."""
+    import jax
+    import jax.numpy as jnp
+
+    dt = jnp.float32 if dtype is None else dtype
+    bufs = {k: jnp.zeros(s, dt) for k, s in shapes.items()}
+    if target is not None:
+        bufs = jax.device_put(bufs, target)
+    return SegmentState(name, bufs)
+
+
+def register_stateful_program(cache_host: Any, name: str, jitted: Any,
+                              pinned: Any = None) -> Any:
+    """Enter a stateful segment's jitted step into ``cache_host``'s
+    compiled-segment cache under a ``("stateful", name)`` key.
+
+    This is what keeps the serve plane's program accounting honest:
+    ``obs.runtime.compiled_programs(cache_host)`` walks ``_plan_cache``
+    and sums each entry's live jit-cache size, so a decode loop that
+    silently retraced per batch size would blow the ladder budget the
+    tier-1 gate pins. Stateful entries are pinned outside the LRU window
+    (state outlives any bucket traffic pattern): the eviction loop in
+    ``_cached_segment`` only pops ``while len > max``, so keep the
+    stateful program count small. Returns ``jitted`` for chaining."""
+    lock = cache_host.__dict__.setdefault("_plan_lock", threading.Lock())
+    with lock:
+        store = cache_host.__dict__.setdefault("_plan_cache", {})
+        store[("stateful", name)] = (("stateful", name), (jitted,),
+                                     (pinned,))
+    return jitted
+
+
+class StatefulSegment:
+    """A compiled step function owning :class:`SegmentState`.
+
+    ``step_fn(buffers, *args) -> (new_buffers, out)`` is jitted with the
+    buffers donated (``donate_argnums=(0,)`` unless ``donate=False``),
+    so each :meth:`dispatch` updates the device state in place — no
+    per-step reallocation, no H2D re-upload of the cache. Dispatches
+    serialize through :meth:`SegmentState.swap`; the jitted program
+    registers on ``cache_host`` (when given) for
+    ``compiled_programs`` accounting."""
+
+    __slots__ = ("name", "state", "_jitted")
+
+    def __init__(self, name: str, step_fn: Callable, state: SegmentState,
+                 cache_host: Any = None, donate: bool = True,
+                 static_argnums: tuple = ()):
+        import jax
+
+        self.name = name
+        self.state = state
+        kwargs: dict = {"static_argnums": tuple(
+            n + 1 for n in static_argnums)} if static_argnums else {}
+        if donate:
+            kwargs["donate_argnums"] = (0,)
+        self._jitted = jax.jit(step_fn, **kwargs)
+        if cache_host is not None:
+            register_stateful_program(cache_host, name, self._jitted,
+                                      pinned=state)
+
+    @property
+    def jitted(self) -> Any:
+        """The jitted step — what the SPMD audit traces and
+        ``jit_cache_size`` counts."""
+        return self._jitted
+
+    def dispatch(self, *args) -> Any:
+        """One step: run the donated program over the current buffers,
+        rebind the new buffers, return the step outputs (still device
+        arrays — async dispatch; the caller owns the fetch policy)."""
+        return self.state.swap(
+            lambda bufs: self._jitted(bufs, *args))

@@ -98,12 +98,35 @@ class TransformerTagger(nn.Module):
     @nn.compact
     def __call__(self, tokens, output: str = "logits", train: bool = False,
                  attention_fn: Callable | None = None, mask=None,
-                 moe_fn: Callable | None = None):
+                 moe_fn: Callable | None = None, cache=None, positions=None,
+                 update_mask=None, return_cache: bool = False,
+                 decode_attention_fn: Callable | None = None):
         # mask: [B, L] bool (True = real token); pad keys are excluded from
         # attention so logits don't depend on the bucket's padding amount.
         # attention_fn receives (q, k, v, kv_mask, causal) so a
         # causal-configured model stays causal on the sequence-parallel
         # path — ring_attention/ulysses_attention take the same kwargs.
+        #
+        # Autoregressive decode (serve/generate.py) threads a slot-major
+        # KV-cache through the SAME params:
+        #
+        # * ``return_cache=True`` (prefill): the full causal forward
+        #   additionally returns every layer's K/V stacked
+        #   ``[B, layers, H, L, head_dim]`` — what the serve prefill
+        #   program scatters into assigned cache slots;
+        # * ``cache=(ck, cv)`` (decode): ``tokens`` is ``[S, 1]`` (one new
+        #   token per slot), ``positions`` ``[S]`` is each slot's write
+        #   index (== its current length), and the caches are
+        #   ``[S, layers, H, T_max, head_dim]``. The new token's K/V is
+        #   written at ``positions`` (rows where ``update_mask`` is False
+        #   keep their cache untouched — the inactive-slot guard of the
+        #   fixed-shape decode program), attention runs ``q_len=1``
+        #   against the cache through ``decode_attention_fn`` (default
+        #   :func:`~mmlspark_tpu.ops.pallas.attention.decode_attention`),
+        #   and the call returns ``(logits [S, num_tags], (ck', cv'))``.
+        if cache is not None:
+            return self._decode_step(tokens, cache, positions, update_mask,
+                                     moe_fn, decode_attention_fn)
         B, L = tokens.shape
         if mask is None and self.pad_token_id is not None:
             mask = tokens.astype(jnp.int32) != self.pad_token_id
@@ -113,6 +136,7 @@ class TransformerTagger(nn.Module):
                          (self.max_len, self.embed_dim))
         x = x + pos[None, :L]
         head_dim = self.embed_dim // self.num_heads
+        kv_layers: list = []
         for i in range(self.num_layers):
             h = nn.LayerNorm(name=f"ln_a{i}")(x)
             qkv = nn.Dense(3 * self.embed_dim, name=f"qkv{i}")(h)
@@ -120,6 +144,10 @@ class TransformerTagger(nn.Module):
             q = q.reshape(B, L, self.num_heads, head_dim)
             k = k.reshape(B, L, self.num_heads, head_dim)
             v = v.reshape(B, L, self.num_heads, head_dim)
+            if return_cache:
+                # [B, H, L, head_dim] — the slot-major cache layer slice
+                kv_layers.append((k.transpose(0, 2, 1, 3),
+                                  v.transpose(0, 2, 1, 3)))
             if attention_fn is None:
                 from mmlspark_tpu.parallel.ring_attention import (
                     attention_reference,
@@ -138,9 +166,69 @@ class TransformerTagger(nn.Module):
                 h = nn.gelu(h)
                 x = x + nn.Dense(self.embed_dim, name=f"mlp_out{i}")(h)
         x = nn.LayerNorm(name="ln_f")(x)
-        if output == "features":
-            return x
-        return nn.Dense(self.num_tags, name="head")(x)
+        out = x if output == "features" \
+            else nn.Dense(self.num_tags, name="head")(x)
+        if return_cache:
+            ck = jnp.stack([k for k, _ in kv_layers], axis=1)
+            cv = jnp.stack([v for _, v in kv_layers], axis=1)
+            return out, (ck, cv)
+        return out
+
+    def _decode_step(self, tokens, cache, positions, update_mask, moe_fn,
+                     decode_attention_fn):
+        """One token step against the slot-major KV-cache — the body of
+        the serve plane's ONE fixed-shape decode program. Same submodule
+        names (and therefore the same params) as the full forward."""
+        if decode_attention_fn is None:
+            from mmlspark_tpu.ops.pallas.attention import decode_attention
+            decode_attention_fn = decode_attention
+        ck, cv = cache
+        S = tokens.shape[0]
+        T = ck.shape[3]
+        head_dim = self.embed_dim // self.num_heads
+        positions = positions.astype(jnp.int32)
+        x = nn.Embed(self.vocab_size, self.embed_dim, name="embed")(
+            tokens.astype(jnp.int32))          # [S, 1, D]
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (self.max_len, self.embed_dim))
+        x = x + jnp.take(pos, positions, axis=0)[:, None, :]
+        rows = jnp.arange(S)
+        # the new position becomes visible to its own query (inclusive)
+        keep = jnp.arange(T)[None, :] <= positions[:, None]
+        if update_mask is not None:
+            keep = keep & update_mask[:, None]
+            sel = update_mask[:, None, None, None, None]
+        for i in range(self.num_layers):
+            h = nn.LayerNorm(name=f"ln_a{i}")(x)
+            qkv = nn.Dense(3 * self.embed_dim, name=f"qkv{i}")(h)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(S, self.num_heads, head_dim)
+            k = k.reshape(S, self.num_heads, head_dim)
+            v = v.reshape(S, self.num_heads, head_dim)
+            # functional in-place write at each slot's position; rows
+            # outside update_mask keep their old cache bits exactly (an
+            # inactive slot's stale position must never clobber a row a
+            # concurrent prefill just filled)
+            ck_new = ck.at[rows, i, :, positions].set(k)
+            cv_new = cv.at[rows, i, :, positions].set(v)
+            if update_mask is not None:
+                ck = jnp.where(sel, ck_new, ck)
+                cv = jnp.where(sel, cv_new, cv)
+            else:
+                ck, cv = ck_new, cv_new
+            attn = decode_attention_fn(q, ck[:, i], cv[:, i], keep)
+            attn = attn.astype(x.dtype).reshape(S, 1, self.embed_dim)
+            x = x + nn.Dense(self.embed_dim, name=f"proj{i}")(attn)
+            h = nn.LayerNorm(name=f"ln_b{i}")(x)
+            if self.moe_experts > 0:
+                x = x + self._moe_ffn(h, i, moe_fn, None)
+            else:
+                h = nn.Dense(self.mlp_dim, name=f"mlp_in{i}")(h)
+                h = nn.gelu(h)
+                x = x + nn.Dense(self.embed_dim, name=f"mlp_out{i}")(h)
+        x = nn.LayerNorm(name="ln_f")(x)
+        logits = nn.Dense(self.num_tags, name="head")(x)[:, 0]
+        return logits, (ck, cv)
 
     def mesh_hooks(self, mesh) -> dict:
         """Trainer integration (train/loop.py:resolve_mesh_hooks): on an
@@ -216,14 +304,50 @@ class TransformerTagger(nn.Module):
 
 # ---- padded/bucketed batching (the 613-token fixed pad, generalized) ----
 
+def _check_sequence(i: int, s) -> np.ndarray:
+    """Validate one token sequence; returns it as an int32 array.
+
+    Typed errors instead of silent misshape: an empty sequence would
+    produce an all-pad row whose logits are pure padding noise, and
+    non-integer tokens would be silently cast by ``np.int32`` (floats
+    floor, strings crash deep inside the embed lookup)."""
+    arr = np.asarray(s)
+    if arr.ndim != 1:
+        raise ValueError(
+            f"sequence {i} has shape {arr.shape}; expected a flat 1-D "
+            "token sequence")
+    if arr.size == 0:
+        raise ValueError(
+            f"sequence {i} is empty; an empty sequence has no tokens to "
+            "tag (drop it before batching)")
+    if not np.issubdtype(arr.dtype, np.integer):
+        if arr.dtype == bool or not np.issubdtype(arr.dtype, np.number) \
+                or not np.array_equal(arr, arr.astype(np.int64)):
+            raise TypeError(
+                f"sequence {i} has non-integer tokens (dtype "
+                f"{arr.dtype}); token ids must be integers")
+    return arr.astype(np.int32)
+
+
 def pad_sequences(seqs: Sequence[Sequence[int]], length: int,
                   pad_value: int = 0) -> tuple[np.ndarray, np.ndarray]:
-    """Pad/truncate token sequences to ``length``; returns (tokens, mask)."""
+    """Pad token sequences to ``length``; returns (tokens, mask).
+
+    Raises ``ValueError`` for empty or overlong sequences and
+    ``TypeError`` for non-integer tokens — a sequence longer than
+    ``length`` used to be silently truncated, which dropped tokens with
+    no signal at all (use :func:`bucket_batches` to pick covering pads).
+    """
     out = np.full((len(seqs), length), pad_value, dtype=np.int32)
     mask = np.zeros((len(seqs), length), dtype=bool)
     for i, s in enumerate(seqs):
-        n = min(len(s), length)
-        out[i, :n] = np.asarray(s[:n], dtype=np.int32)
+        arr = _check_sequence(i, s)
+        n = arr.shape[0]
+        if n > length:
+            raise ValueError(
+                f"sequence {i} has {n} tokens > pad length {length}; "
+                "truncation would silently drop tokens")
+        out[i, :n] = arr
         mask[i, :n] = True
     return out, mask
 
@@ -238,18 +362,25 @@ def bucket_batches(seqs: Sequence[Sequence[int]], batch_size: int,
     unique length — the compilation-model-aware version of the reference's
     single fixed 613-token pad. Yields (tokens [b, bucket], mask, indices)
     with original row indices for order restoration.
+
+    Raises ``ValueError`` when a sequence is empty or exceeds the
+    largest bucket (it used to be silently truncated into the top
+    bucket) and ``TypeError`` for non-integer tokens.
     """
     # ascending order makes the first covering bucket below the smallest
     bucket_sizes = sorted(bucket_sizes)
     buckets: dict[int, list[int]] = {b: [] for b in bucket_sizes}
     overflow = max(bucket_sizes)
     for i, s in enumerate(seqs):
+        n = _check_sequence(i, s).shape[0]
+        if n > overflow:
+            raise ValueError(
+                f"sequence {i} has {n} tokens > largest bucket "
+                f"{overflow}; truncation would silently drop tokens")
         for b in bucket_sizes:
-            if len(s) <= b:
+            if n <= b:
                 buckets[b].append(i)
                 break
-        else:
-            buckets[overflow].append(i)
     for b, idxs in buckets.items():
         for start in range(0, len(idxs), batch_size):
             chunk = idxs[start:start + batch_size]

@@ -285,8 +285,11 @@ class SLOTracker:
             else int(self.queued_fn())
         occupancy = self.stats.occupancy_mean()
         skew = self._replica_skew()
+        ttft = self.stats.ttft_percentiles()
+        itl = self.stats.itl_percentiles()
         self._publish_gauges(burn_short, burn_long, queue_depth,
-                             occupancy, skew, budget_remaining)
+                             occupancy, skew, budget_remaining,
+                             ttft=ttft, itl=itl)
         return {
             "slo": spec.describe(),
             "burn_rate_short": None if burn_short is None
@@ -301,6 +304,11 @@ class SLOTracker:
             "queue_depth": queue_depth,
             "occupancy_mean": occupancy,
             "replica_skew": skew,
+            # per-token SLOs (token serving only; None for batch models)
+            "ttft_ms": None if ttft is None else {
+                k: round(v, 3) for k, v in ttft.items()},
+            "itl_ms": None if itl is None else {
+                k: round(v, 3) for k, v in itl.items()},
             "counters": {"admitted": cur["admitted"],
                          "completed": cur["completed"],
                          **cur["errors"]},
@@ -308,7 +316,8 @@ class SLOTracker:
         }
 
     def _publish_gauges(self, burn_short, burn_long, queue_depth,
-                        occupancy, skew, budget_remaining) -> None:
+                        occupancy, skew, budget_remaining,
+                        ttft=None, itl=None) -> None:
         """Derived values become first-class gauges in the model's own
         registry — the queue-depth/skew/burn series autoscalers and the
         adaptive ladder consume from /metrics without re-deriving."""
@@ -331,6 +340,15 @@ class SLOTracker:
                       **lbl).set(occupancy)
         if skew is not None:
             reg.gauge("serve.replica_skew", **lbl).set(skew)
+        # per-token SLO gauges (token serving): the TimeSeriesSampler
+        # persists serve.ttft_*/serve.itl_* into MetricHistory, so the
+        # streaming latency objectives get the same history/rate surface
+        # as the burn gauges
+        if ttft is not None:
+            reg.gauge("serve.ttft_p50_ms", **lbl).set(ttft["p50"])
+            reg.gauge("serve.ttft_p99_ms", **lbl).set(ttft["p99"])
+        if itl is not None:
+            reg.gauge("serve.itl_p99_ms", **lbl).set(itl["p99"])
 
 
 class SlowStepDetector:

@@ -52,6 +52,8 @@ DEFAULT_PREFIXES = (
     "serve.queue_depth",
     "serve.occupancy_mean_window",
     "serve.replica_skew",
+    "serve.ttft_",
+    "serve.itl_",
     "serve.lane_",
     "train.host_step_ms",
     "train.host_skew",

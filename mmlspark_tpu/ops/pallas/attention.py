@@ -250,6 +250,170 @@ def flash_attention(q, k, v, kv_mask=None, causal: bool = False,
     return flash_attention_reference(q, k, v, mask3, s, block_k)
 
 
+# ---- the KV-cache decode variant (q_len=1 against cached K/V) ----
+#
+# Autoregressive serving (serve/generate.py) holds a slot-major KV-cache
+# [slots, H, T_max, D] as plan-managed device state and issues ONE query
+# row per slot per token step. The decode attention is the same online-
+# softmax recurrence restricted to Tq=1 — ONE shared body
+# (`_decode_tile`) that is the Pallas kernel, the XLA reference, and the
+# numpy oracle — with the slot's validity mask ([S, T] — True up to the
+# slot's current length) standing in for the causal constraint (the
+# cache never holds a future position). A fully-masked slot (inactive,
+# length 0) yields EXACT zeros via the shared denominator floor, which
+# is what lets inactive slots ride the fixed-shape decode program
+# without polluting anything.
+
+
+def decode_mask2(s: int, tk: int, kv_mask):
+    """The one ``[S, Tk]`` int8 validity mask the decode implementations
+    consume (True→1 = attend). Traced (jnp); the host oracle converts
+    with :func:`host_decode_mask2`."""
+    if kv_mask is None:
+        return jnp.ones((s, tk), jnp.int8)
+    return jnp.asarray(kv_mask, bool).astype(jnp.int8)
+
+
+def host_decode_mask2(s: int, tk: int, kv_mask) -> np.ndarray:
+    """Numpy twin of :func:`decode_mask2` for the oracle path."""
+    if kv_mask is None:
+        return np.ones((s, tk), np.int8)
+    return np.asarray(kv_mask, bool).astype(np.int8)
+
+
+def _decode_tile(q, k, v, keep, scale, xp, block_k: int):
+    """THE shared decode body: attention of one query row against one
+    (slot, head) cache tile via the online-softmax block loop. ``q``
+    ``[1, D]`` f32, ``k``/``v`` ``[Tk, D]`` f32, ``keep`` ``[1, Tk]``
+    bool → ``[1, D]`` f32.
+
+    Same recurrence as :func:`_flash_tile`, with the two ``Tq=1``
+    contractions written as broadcast-multiply + axis reductions instead
+    of ``xp.dot``: a ``dot_general`` with an M=1 operand reassociates
+    under vmap batching (the reference) vs. the standalone lowering (the
+    kernel tile), drifting tens of ULPs — the reduce form lowers
+    bit-identically both ways, which is what lets the ≤ 1 ULP pin hold
+    for the decode variant too."""
+    tk = k.shape[0]
+    m = xp.full((1, 1), -xp.inf, np.float32)
+    denom = xp.zeros((1, 1), np.float32)
+    acc = xp.zeros((1, k.shape[1]), np.float32)
+    for start in range(0, tk, block_k):
+        stop = min(start + block_k, tk)
+        ks, vs, kp = k[start:stop], v[start:stop], keep[:, start:stop]
+        # [1, bk] scores: sum over D of q ⊙ ks (the vmap-stable form)
+        scores = xp.sum(q[:, None, :] * ks[None, :, :], axis=-1) * scale
+        scores = xp.where(kp, scores, -xp.inf)
+        blk_max = xp.max(scores, axis=-1, keepdims=True)
+        m_new = xp.maximum(m, blk_max)
+        corr = xp.where(xp.isfinite(m), xp.exp(m - m_new), np.float32(0))
+        p = xp.exp(xp.where(xp.isfinite(scores), scores - m_new,
+                            -xp.inf))
+        # [1, D] weighted values: sum over the block of p ⊙ vs
+        acc = acc * corr + xp.sum(p[0][:, None] * vs, axis=0)[None]
+        denom = denom * corr + xp.sum(p, axis=-1, keepdims=True)
+        m = m_new
+    return acc / xp.maximum(denom, _DENOM_FLOOR)
+
+
+def decode_attention_reference(q, k, v, mask2, scale,
+                               block_k: int = DEFAULT_BLOCK_K):
+    """Pure-XLA anchor of the decode variant: the SAME ``_decode_tile``
+    body vmapped over (slot, head). ``q`` ``[S, H, D]``, ``k``/``v``
+    ``[S, H, Tk, D]``, ``mask2`` ``[S, Tk]`` int8 (shared across heads).
+    Returns ``[S, H, D]`` float32."""
+    s = np.float32(scale)
+
+    def tile(q1, k2, v2, keep1):
+        out = _decode_tile(q1[None].astype(jnp.float32),
+                           k2.astype(jnp.float32),
+                           v2.astype(jnp.float32),
+                           keep1[None] != 0, s, jnp, block_k)
+        return out[0]
+
+    over_h = jax.vmap(tile, in_axes=(0, 0, 0, None))
+    return jax.vmap(over_h)(q, k, v, mask2)
+
+
+def decode_attention_host(q, k, v, mask2, scale,
+                          block_k: int = DEFAULT_BLOCK_K) -> np.ndarray:
+    """Numpy oracle of the decode variant: identical tile body,
+    python-looped over (slot, head)."""
+    q = np.asarray(q, np.float32)
+    k = np.asarray(k, np.float32)
+    v = np.asarray(v, np.float32)
+    mask2 = np.asarray(mask2)
+    sc = np.float32(scale)
+    s, h, d = q.shape
+    out = np.empty((s, h, d), np.float32)
+    for si in range(s):
+        keep = mask2[si][None] != 0
+        for hi in range(h):
+            out[si, hi] = _decode_tile(q[si, hi][None], k[si, hi],
+                                       v[si, hi], keep, sc, np,
+                                       block_k)[0]
+    return out
+
+
+def _decode_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *,
+                   scale: np.float32, block_k: int):
+    # one (slot, head) tile per program: q arrives [1, 1, D] (a single
+    # query row), K/V [1, 1, Tk, D], the mask [1, Tk]; the shared body
+    # runs on the 2-D [1, D] / [Tk, D] tiles
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0, 0].astype(jnp.float32)
+    v = v_ref[0, 0].astype(jnp.float32)
+    keep = mask_ref[:] != 0
+    o_ref[0] = _decode_tile(q, k, v, keep, scale, jnp, block_k)
+
+
+def _decode_call(q, k, v, mask2, scale, block_k: int):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    s, h, d = q.shape
+    tk = k.shape[2]
+    kern = functools.partial(_decode_kernel, scale=np.float32(scale),
+                             block_k=block_k)
+    return pl.pallas_call(
+        kern,
+        grid=(s, h),
+        in_specs=[
+            pl.BlockSpec((1, 1, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, tk, d), lambda i, j: (i, j, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, tk, d), lambda i, j: (i, j, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tk), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, d), lambda i, j: (i, j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((s, h, d), jnp.float32),
+        interpret=jax.default_backend() != "tpu",
+    )(q, k, v, mask2)
+
+
+def decode_attention(q, k, v, kv_mask=None, scale=None,
+                     impl: str = "auto", block_k: int = DEFAULT_BLOCK_K):
+    """Single-token decode attention against cached K/V.
+
+    ``q`` ``[S, H, D]`` (one query per slot), ``k``/``v`` ``[S, H, Tk, D]``
+    (the slot-major cache, one slot's layer-slice per row), ``kv_mask``
+    ``[S, Tk]`` bool (True = valid cached position; typically
+    ``arange(Tk) <= position``). Returns ``[S, H, D]`` float32;
+    fully-masked slots yield exact zeros. Same ``impl``/VMEM-fallback
+    discipline as :func:`flash_attention`."""
+    s_, h, d = q.shape
+    tk = k.shape[2]
+    sc = _resolve_scale(scale, d)
+    mask2 = decode_mask2(s_, tk, kv_mask)
+    if resolve_impl(impl) == "pallas" and _fits_vmem(1, tk, d, block_k):
+        return _decode_call(q, k, v, mask2, sc, block_k)
+    return decode_attention_reference(q, k, v, mask2, sc, block_k)
+
+
 # ---- the ring-hop local block: one online update as a kernel ----
 
 def _update_kernel(q_ref, k_ref, v_ref, mask_ref, m_ref, d_ref, a_ref,

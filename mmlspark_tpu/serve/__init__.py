@@ -29,7 +29,9 @@ compiles exactly one program per (model, bucket) — see docs/serving.md.
   Arrow bodies); ``tools/serve.py`` is the CLI.
 """
 
-from mmlspark_tpu.serve.config import ServeConfig  # noqa: F401
+from mmlspark_tpu.serve.config import (  # noqa: F401
+    GenerateConfig, ServeConfig,
+)
 from mmlspark_tpu.serve.errors import (  # noqa: F401
     BadRequest, DeadlineExceeded, LaneFailed, ModelLoadError,
     ModelNotFound, Overloaded, ServeError, ServerClosed,
@@ -47,6 +49,9 @@ from mmlspark_tpu.serve.lifecycle import (  # noqa: F401
 from mmlspark_tpu.serve.batcher import (  # noqa: F401
     DynamicBatcher, ServeRequest, THREAD_PREFIX,
 )
+from mmlspark_tpu.serve.generate import (  # noqa: F401
+    GenerateBatcher, TokenStream,
+)
 from mmlspark_tpu.serve.mesh import (  # noqa: F401
     LockstepCoordinator, Replica, ReplicaSet, ServeMeshSpec,
     build_replicas,
@@ -63,6 +68,8 @@ __all__ = [
     "DynamicBatcher",
     "FaultPlan",
     "FaultSpec",
+    "GenerateBatcher",
+    "GenerateConfig",
     "Hold",
     "InjectedFault",
     "LadderAdvisor",
@@ -86,6 +93,7 @@ __all__ = [
     "ServerClosed",
     "ServerStats",
     "THREAD_PREFIX",
+    "TokenStream",
     "expected_padded_rows",
     "fit_ladder",
     "validate_ladder",

@@ -32,6 +32,12 @@ point                  seam
                        atomic rename — a crash mid-publish of an AOT
                        program (the staging dir is inert; loads miss
                        and fall back to in-memory compiles)
+``generate_cancel``    ``serve/generate.GenerateBatcher`` decode loop,
+                       once per token step — a client abandoning its
+                       stream mid-decode: the engine cancels the oldest
+                       active request, releases its slot, and the
+                       join/leave churn gate asserts no slot
+                       double-assignment under the schedule
 =====================  ====================================================
 
 The seams pay ONE module-attribute check when no plan is installed

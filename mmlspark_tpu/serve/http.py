@@ -12,6 +12,13 @@ Protocol (details + examples in docs/serving.md):
     bridge; the response is an Arrow stream when the ``Accept`` header
     asks for one, JSON otherwise. Deadline via ``X-Deadline-Ms``.
 
+* ``POST /v1/models/<name>:generate`` — autoregressive token serving on
+  a registered generator: ``{"prompt": [int, ...], "max_new_tokens": n,
+  "stream": true}``. Streaming (default) answers chunked
+  ``application/x-ndjson`` — one ``{"token", "index"}`` line per token
+  as it decodes, then a terminal ``{"done": true, "tokens": [...]}``
+  summary; ``stream: false`` blocks for one JSON object.
+
 * ``GET /healthz`` — drain-aware **readiness**: the
   ok/degraded/unhealthy state machine over the SLO burn rates
   (``obs/health.py``), 200 while ready, 503 when draining or unhealthy
@@ -294,6 +301,11 @@ class _Handler(BaseHTTPRequestHandler):
             # the leftover body would parse as the next request line
             length = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(length)
+            if self.path.startswith("/v1/models/") \
+                    and self.path.endswith(":generate"):
+                name = self.path[len("/v1/models/"):-len(":generate")]
+                self._generate(name, body)
+                return
             if not (self.path.startswith("/v1/models/")
                     and self.path.endswith(":predict")):
                 self._send_json(404, {"error": "NotFound",
@@ -348,6 +360,61 @@ class _Handler(BaseHTTPRequestHandler):
             "model": name,
             "rows": table_to_json_rows(out, columns),
         })
+
+    def _generate(self, name: str, body: bytes) -> None:
+        """``POST /v1/models/<name>:generate`` — autoregressive token
+        serving. Body ``{"prompt": [int, ...], "max_new_tokens": n,
+        "stream": true}``. ``stream: true`` (the default) answers with a
+        chunked ``application/x-ndjson`` body: one ``{"token": t,
+        "index": i}`` line per token AS IT DECODES (the TTFT a client
+        observes is the engine's TTFT, not the whole generation), then a
+        final ``{"done": true, ...}`` summary line. ``stream: false``
+        blocks and answers with one JSON object. Admission errors map to
+        the usual typed status codes; a mid-stream failure is reported
+        as a terminal ``{"error": ...}`` line (the status line already
+        went out)."""
+        try:
+            payload = json.loads(body or b"{}")
+            prompt = payload["prompt"]
+        except (ValueError, KeyError, TypeError) as e:
+            raise BadRequest(f"malformed generate body: {e}") from e
+        if not isinstance(prompt, list) or not prompt \
+                or not all(isinstance(t, int) and not isinstance(t, bool)
+                           for t in prompt):
+            raise BadRequest(
+                "generate body needs a non-empty integer 'prompt' list")
+        max_new = payload.get("max_new_tokens")
+        if max_new is not None and not isinstance(max_new, int):
+            raise BadRequest(
+                f"malformed max_new_tokens: {max_new!r} (want an int)")
+        # admission happens BEFORE any response bytes: Overloaded /
+        # BadRequest / ModelNotFound still map to clean status codes
+        stream = self._ms.generate(name, prompt, max_new_tokens=max_new)
+        if not payload.get("stream", True):
+            tokens = stream.result()
+            self._send_json(200, {"model": name, "tokens": tokens,
+                                  "cancelled": stream.cancelled})
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def chunk(obj: dict) -> None:
+            data = json.dumps(obj).encode("utf-8") + b"\n"
+            self.wfile.write(f"{len(data):X}\r\n".encode("ascii")
+                             + data + b"\r\n")
+            self.wfile.flush()
+
+        try:
+            for i, tok in enumerate(stream):
+                chunk({"token": int(tok), "index": i})
+            chunk({"done": True, "model": name,
+                   "tokens": [int(t) for t in stream.tokens],
+                   "cancelled": stream.cancelled})
+        except ServeError as e:
+            chunk({"error": type(e).__name__, "message": str(e)})
+        self.wfile.write(b"0\r\n\r\n")
 
     def _predict_arrow(self, name: str, body: bytes) -> None:
         pa = _require_pyarrow()

@@ -147,6 +147,18 @@ class _ModelEntry:
         self.ladder_advisor: Any = None
 
 
+class _GeneratorEntry:
+    """One registered token-serving engine: the autoregressive analog of
+    :class:`_ModelEntry`. No lanes, no canary — the engine owns its one
+    decode loop; SLO sampling rides the same tracker machinery so
+    ``/slo`` carries TTFT/ITL burn next to the batch models."""
+
+    def __init__(self, name: str, engine: Any, slo: Any):
+        self.name = name
+        self.engine = engine    # serve.generate.GenerateBatcher
+        self.slo = slo          # obs.slo.SLOTracker
+
+
 class ModelServer:
     """Serves one or more fitted models through per-model dynamic batchers.
 
@@ -166,6 +178,7 @@ class ModelServer:
             from mmlspark_tpu.core import compile_cache as _cc
             _cc.configure(self.config.compile_cache)
         self._models: dict[str, _ModelEntry] = {}
+        self._generators: dict[str, _GeneratorEntry] = {}
         self._lock = named_lock("serve.server.ModelServer._lock")
         self._closed = False
         # lifecycle forensics: swap/canary/promote/rollback and lane
@@ -645,6 +658,94 @@ class ModelServer:
         """Blocking submit+wait."""
         return self.submit(name, table, deadline_ms).result(timeout)
 
+    # -- autoregressive token serving (serve/generate.py) --
+
+    def add_generator(self, name: str, model: Any, params: Any,
+                      config: Any = None,
+                      decode_attention_fn: Any = None) -> None:
+        """Register an autoregressive token-serving engine under
+        ``name``: a causal :class:`~mmlspark_tpu.models.sequence.
+        TransformerTagger` (+ its fitted params) served through
+        continuous batching with the KV cache as plan-managed device
+        state (:class:`~mmlspark_tpu.serve.generate.GenerateBatcher`).
+
+        Generators share the server's SLO machinery — an
+        :class:`~mmlspark_tpu.obs.slo.SLOTracker` over the engine's
+        :class:`ServerStats` publishes the per-token gauges
+        (``serve.ttft_p50_ms``/``serve.ttft_p99_ms``/
+        ``serve.itl_p99_ms``) on every ``/slo`` poll, and the engine's
+        registry rides ``/metrics`` and the fleet exporter. The name
+        space is shared with batch models: one name, one servable."""
+        from mmlspark_tpu.obs.slo import SLOSpec, SLOTracker
+        from mmlspark_tpu.serve.config import GenerateConfig
+        from mmlspark_tpu.serve.generate import GenerateBatcher
+        cfg = config or GenerateConfig()
+        try:
+            spec = SLOSpec.parse(self.config.slo)
+        except (TypeError, ValueError) as e:
+            raise ModelLoadError(name, message=(
+                f"generator {name!r}: invalid SLO spec: {e}")) from e
+        stats = ServerStats(cfg.stats_window, model=name)
+        engine = GenerateBatcher(name, model, params, config=cfg,
+                                 stats=stats,
+                                 decode_attention_fn=decode_attention_fn)
+        tracker = SLOTracker(spec, stats,
+                             queued_fn=lambda: engine.queued)
+        entry = _GeneratorEntry(name, engine, tracker)
+        reject: Exception | None = None
+        old = None
+        with self._lock:
+            if self._closed:
+                reject = ServerClosed("server is closed")
+            elif name in self._models:
+                reject = ModelLoadError(name, message=(
+                    f"{name!r} already serves a batch model — one name, "
+                    f"one servable"))
+            else:
+                old = self._generators.get(name)
+                self._generators[name] = entry
+        if reject is not None:
+            engine.close(drain=False)
+            raise reject
+        if old is not None:
+            # re-registration is the generator hot-swap: drained, so
+            # every admitted stream is answered by the engine that
+            # admitted it
+            old.engine.close(drain=True)
+            self.journal.record("swap", {"model": name, "generator": True})
+        _log.info("serve[%s]: generator loaded (slots=%d, "
+                  "prefill_buckets=%s, t_max=%d)", name, cfg.slots,
+                  cfg.prefill_buckets, cfg.t_max)
+
+    def _generator(self, name: str) -> _GeneratorEntry:
+        with self._lock:
+            entry = self._generators.get(name)
+            if entry is None:
+                raise ModelNotFound(name, list(self._generators))
+            return entry
+
+    def generate(self, name: str, prompt: Any,
+                 max_new_tokens: int | None = None) -> Any:
+        """Admit a generation request on generator ``name``; returns the
+        :class:`~mmlspark_tpu.serve.generate.TokenStream` (iterate for
+        tokens as they decode, or ``.result()`` for the full list)."""
+        return self._generator(name).engine.submit(
+            prompt, max_new_tokens=max_new_tokens)
+
+    def generate_oneshot(self, name: str, prompt: Any,
+                         max_new_tokens: int | None = None) -> list[int]:
+        """Whole-sequence reference decode of one prompt through
+        generator ``name``'s OWN compiled programs
+        (:meth:`~mmlspark_tpu.serve.generate.GenerateBatcher.oneshot`,
+        fresh buffers, engine state untouched) — the bit-identity anchor
+        every continuously-batched stream is pinned against."""
+        return self._generator(name).engine.oneshot(
+            prompt, max_new_tokens=max_new_tokens)
+
+    def generators(self) -> list[str]:
+        with self._lock:
+            return sorted(self._generators)
+
     # -- rollout: canary/shadow + SLO-driven promotion (lifecycle.py) --
 
     def deploy_canary(self, name: str, model: Any,
@@ -857,7 +958,16 @@ class ModelServer:
         """All models' stats in one JSON-safe dict (the /v1/stats body)."""
         with self._lock:
             entries = list(self._models.values())
+            gens = list(self._generators.values())
         out = {}
+        for g in gens:
+            snap = g.engine.stats.snapshot()
+            snap["queued"] = g.engine.queued
+            programs = g.engine.compiled_programs()
+            if programs is not None:
+                snap["programs_compiled"] = programs
+            snap["generator"] = True
+            out[g.name] = snap
         for e in entries:
             snap = e.batcher.stats.snapshot()
             snap["queued"] = e.batcher.queued
@@ -891,6 +1001,8 @@ class ModelServer:
         process-wide obs registry."""
         with self._lock:
             out = []
+            for g in self._generators.values():
+                out.append(g.engine.stats.registry)
             for e in self._models.values():
                 out.append(e.batcher.stats.registry)
                 if e.canary is not None:
@@ -935,7 +1047,13 @@ class ModelServer:
         rides along under ``"lifecycle"``)."""
         with self._lock:
             entries = list(self._models.values())
+            gens = list(self._generators.values())
         out = {}
+        for g in gens:
+            # a generator's SLO sample carries the per-token gauges
+            # (TTFT/ITL percentiles published into its registry) next
+            # to the shared burn-rate machinery
+            out[g.name] = {**g.slo.sample(), "generator": True}
         for e in entries:
             decision = None
             if e.canary is not None:
@@ -983,6 +1101,9 @@ class ModelServer:
         with self._lock:
             self._closed = True
             entries = list(self._models.values())
+            gens = list(self._generators.values())
+        for g in gens:
+            g.engine.close(drain=drain)
         for e in entries:
             canary, e.canary = e.canary, None
             if canary is not None:
@@ -1079,3 +1200,25 @@ class Client:
             return self.server.submit(model, rows, deadline_ms)
         return call_with_retry(
             lambda: self.server.submit(model, rows, deadline_ms), policy)
+
+    def generate(self, model: str, prompt: Iterable[int],
+                 max_new_tokens: int | None = None,
+                 stream: bool = False,
+                 timeout: float | None = None,
+                 retry: Any = None) -> Any:
+        """Token generation on a registered generator. ``stream=True``
+        returns the :class:`~mmlspark_tpu.serve.generate.TokenStream`
+        (iterate for tokens as they decode); the default blocks for the
+        full token list. ``retry`` covers ADMISSION only (the same
+        contract as :meth:`predict_async` — a stream that exists is
+        never resubmitted)."""
+        policy = _retry_policy(retry if retry is not None
+                               else self._retry)
+        prompt = list(prompt)
+        if policy is None:
+            handle = self.server.generate(model, prompt, max_new_tokens)
+        else:
+            handle = call_with_retry(
+                lambda: self.server.generate(model, prompt,
+                                             max_new_tokens), policy)
+        return handle if stream else handle.result(timeout)

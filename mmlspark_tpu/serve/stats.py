@@ -78,6 +78,22 @@ class ServerStats:
         # one value per load/swap) — with the persistent compile cache
         # this is the warm-start observable bench A/Bs
         self._warm_wall = reg.gauge("serve.warm_wall_s", **lbl)
+        # token-serving seams (continuous batching, serve/generate.py):
+        # TTFT is prefill-completion minus submit (per request), ITL is
+        # the gap between consecutive streamed tokens (per token) — the
+        # two per-token SLOs the /slo surface publishes. Slot occupancy
+        # is observed once per decode step (active slots / table size):
+        # the padding-waste observable of the fixed-shape decode program
+        self._ttft_ms = reg.histogram("serve.ttft_ms", window=window,
+                                      **lbl)
+        self._itl_ms = reg.histogram("serve.itl_ms", window=window, **lbl)
+        self._tokens_out = reg.counter("serve.tokens_out", **lbl)
+        self._gen_requests = reg.counter("serve.generate_requests", **lbl)
+        self._gen_cancelled = reg.counter("serve.generate_cancelled",
+                                          **lbl)
+        self._decode_steps = reg.counter("serve.decode_steps", **lbl)
+        self._slot_occupancy = reg.histogram("serve.slot_occupancy",
+                                             window=window, **lbl)
         # distinct batch shapes OBSERVED entering the device (reported by
         # the dispatch handle, one per uploaded chunk — not the intended
         # bucket label): for a fixed program each new shape is one XLA
@@ -166,6 +182,36 @@ class ServerStats:
         adaptive-ladder fit input (``LadderAdvisor.propose``)."""
         return [int(v) for v in self._request_rows.values()]
 
+    def ttft_percentiles(self) -> dict | None:
+        """Time-to-first-token percentiles (None pre-traffic) — the
+        prefill-latency SLO read."""
+        return self._ttft_ms.percentiles(ndigits=None)
+
+    def itl_percentiles(self) -> dict | None:
+        """Inter-token-latency percentiles (None pre-traffic) — the
+        streaming-cadence SLO read."""
+        return self._itl_ms.percentiles(ndigits=None)
+
+    def slot_occupancy_mean(self) -> float | None:
+        """Mean active-slot fraction over the decode-step window."""
+        return self._slot_occupancy.mean()
+
+    @property
+    def tokens_out(self) -> int:
+        return int(self._tokens_out.value)
+
+    @property
+    def generate_requests(self) -> int:
+        return int(self._gen_requests.value)
+
+    @property
+    def generate_cancelled(self) -> int:
+        return int(self._gen_cancelled.value)
+
+    @property
+    def decode_steps(self) -> int:
+        return int(self._decode_steps.value)
+
     def record_warm_wall(self, seconds: float) -> None:
         self._warm_wall.set(seconds)
 
@@ -206,6 +252,28 @@ class ServerStats:
         self._completed.add()
         self._e2e_ms.observe(e2e_ms)
         self._queue_ms.observe(queue_ms)
+
+    # -- token-serving side (serve/generate.py) --
+
+    def record_generate_admitted(self, prompt_tokens: int) -> None:
+        self._gen_requests.add()
+        self._request_rows.observe(prompt_tokens)
+
+    def record_generate_cancelled(self) -> None:
+        self._gen_cancelled.add()
+
+    def record_ttft(self, ms: float) -> None:
+        self._ttft_ms.observe(ms)
+
+    def record_itl(self, ms: float) -> None:
+        self._itl_ms.observe(ms)
+
+    def record_tokens(self, n: int = 1) -> None:
+        self._tokens_out.add(n)
+
+    def record_decode_step(self, active: int, slots: int) -> None:
+        self._decode_steps.add()
+        self._slot_occupancy.observe(active / slots if slots else 0.0)
 
     # -- batch side --
 
@@ -285,6 +353,14 @@ class ServerStats:
             "e2e_ms": self._e2e_ms.percentiles(),
             "queue_wait_ms": self._queue_ms.percentiles(),
             "device_ms": self._device_ms.percentiles(),
+            # token-serving view (zero/None for pure batch models)
+            "tokens_out": self.tokens_out,
+            "generate_requests": self.generate_requests,
+            "generate_cancelled": self.generate_cancelled,
+            "decode_steps": self.decode_steps,
+            "ttft_ms": self._ttft_ms.percentiles(),
+            "itl_ms": self._itl_ms.percentiles(),
+            "slot_occupancy_mean": self._slot_occupancy.mean(),
             "distinct_batch_shapes": n_shapes,
             # per-replica breakdown (empty unless the model serves
             # sharded): dispatch counts / rows / device-time percentiles

@@ -83,6 +83,63 @@ class TestKernelUlpPins:
         assert not fa._fits_vmem(16384, 16384, 128, 128)
 
 
+class TestDecodeAttention:
+    """The KV-cache decode variant (round 18): one query row per slot
+    against the slot-major cache. Same kernel discipline — pallas ≤ 1
+    ULP vs the jitted XLA reference, the numpy oracle pinned against the
+    jitted reference, fully-masked slots exact zeros — plus the semantic
+    anchor: a decode step IS flash attention at ``Tq=1``."""
+
+    def shkd(self, S=4, H=2, Tk=32, D=8, seed=11):
+        r = np.random.default_rng(seed)
+        q = jnp.asarray(r.normal(size=(S, H, D)), jnp.float32)
+        k = jnp.asarray(r.normal(size=(S, H, Tk, D)), jnp.float32)
+        v = jnp.asarray(r.normal(size=(S, H, Tk, D)), jnp.float32)
+        mask = jnp.asarray(np.arange(Tk)[None, :]
+                           <= np.asarray([5, 31, 0, 17])[:, None])
+        return q, k, v, mask
+
+    def test_kernel_matches_reference_under_jit_one_ulp(self):
+        q, k, v, mask = self.shkd()
+
+        def run(impl):
+            fn = jax.jit(lambda a, b, c: fa.decode_attention(
+                a, b, c, kv_mask=mask, impl=impl, block_k=16))
+            return np.asarray(fn(q, k, v))
+
+        np.testing.assert_array_max_ulp(run("xla"), run("pallas"),
+                                        maxulp=1)
+
+    def test_numpy_oracle_pinned_against_jitted_reference(self):
+        q, k, v, mask = self.shkd(seed=12)
+        ref = np.asarray(jax.jit(
+            lambda a, b, c: fa.decode_attention(
+                a, b, c, kv_mask=mask, impl="xla", block_k=16))(q, k, v))
+        m2 = fa.host_decode_mask2(4, 32, np.asarray(mask))
+        host = fa.decode_attention_host(
+            np.asarray(q), np.asarray(k), np.asarray(v), m2,
+            fa._resolve_scale(None, 8), block_k=16)
+        np.testing.assert_allclose(host, ref, rtol=1e-5, atol=1e-6)
+
+    def test_decode_is_flash_attention_at_tq_one(self):
+        q, k, v, mask = self.shkd(seed=13)
+        out = fa.decode_attention(q, k, v, kv_mask=mask, impl="xla",
+                                  block_k=16)
+        full = fa.flash_attention(q[:, :, None, :], k, v, kv_mask=mask,
+                                  impl="xla", block_k=16)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(full)[:, :, 0],
+                                   rtol=2e-5, atol=2e-6)
+
+    def test_fully_masked_slots_are_exact_zeros(self):
+        q, k, v, _ = self.shkd(seed=14)
+        none = jnp.zeros((4, 32), bool)
+        for impl in ("xla", "pallas"):
+            out = np.asarray(fa.decode_attention(q, k, v, kv_mask=none,
+                                                 impl=impl))
+            assert (out == 0.0).all(), impl
+
+
 class TestBlockUpdate:
     """The ring-hop local block: one online update as a kernel."""
 

@@ -214,6 +214,81 @@ def test_jx106_pragma_suppresses_and_ignores_plain_calls():
     assert lint_source(src_ok, "x.py") == []
 
 
+def test_jx109_lagged_decode_fetch_is_clean():
+    # the serve/generate.py discipline: dispatch step t+1, then consume
+    # step t's output — the in-loop fetch target is the PREVIOUS
+    # dispatch, so device decode overlaps host token fan-out
+    src = ("import numpy as np\n"
+           "def loop(engine, steps, bufs):\n"
+           "    prev = None\n"
+           "    for _ in range(steps):\n"
+           "        bufs, out = engine._decode.dispatch(bufs)\n"
+           "        if prev is not None:\n"
+           "            toks = np.asarray(prev)  # lint-jax: allow(JX109)\n"
+           "        prev = out\n"
+           "    return np.asarray(prev)\n")
+    assert lint_source(src, "x.py") == []
+    # the anti-pattern: fetch the CURRENT step's tokens before the next
+    # dispatch — every token pays a full device round-trip
+    src_sync = ("import numpy as np\n"
+                "def loop(engine, steps, bufs):\n"
+                "    for _ in range(steps):\n"
+                "        bufs, out = engine._decode.dispatch(bufs)\n"
+                "        toks = np.asarray(out)\n"
+                "    return toks\n")
+    assert [f.rule for f in lint_source(src_sync, "x.py")] == ["JX109"]
+
+
+def test_jx109_matches_full_dotted_spelling():
+    # JX109's source predicate sees the WHOLE dotted call spelling —
+    # "self._decode.jitted" is decode-flavored even though the leaf
+    # attribute ("jitted") says nothing about decoding
+    src = ("import numpy as np\n"
+           "def loop(self, steps, bufs, carry):\n"
+           "    for _ in range(steps):\n"
+           "        bufs, carry = self._decode.jitted(bufs, carry)\n"
+           "        tok = int(np.asarray(carry)[0])\n"
+           "    return tok\n")
+    assert [f.rule for f in lint_source(src, "x.py")] == ["JX109"]
+
+
+def test_jx109_wins_over_jx105_and_jx106_on_decode_calls():
+    # "decode_step" is both step- and decode-flavored; "decode_dispatch"
+    # both dispatch- and decode-flavored — one site, one rule: the
+    # decode-aware JX109 claims them and JX105/JX106 stand down
+    src = ("def gen(state, steps, decode_step):\n"
+           "    for _ in range(steps):\n"
+           "        state, tok = decode_step(state)\n"
+           "        t = int(tok)\n"
+           "    return t\n")
+    assert [f.rule for f in lint_source(src, "x.py")] == ["JX109"]
+    src2 = ("import numpy as np\n"
+            "def gen(bufs, steps, decode_dispatch):\n"
+            "    for _ in range(steps):\n"
+            "        out = decode_dispatch(bufs)\n"
+            "        toks = np.asarray(out)\n"
+            "    return toks\n")
+    assert [f.rule for f in lint_source(src2, "x.py")] == ["JX109"]
+
+
+def test_jx109_pragma_suppresses_and_ignores_plain_calls():
+    src = ("import numpy as np\n"
+           "def loop(engine, steps, bufs):\n"
+           "    for _ in range(steps):\n"
+           "        bufs, out = engine.decode(bufs)\n"
+           "        toks = np.asarray(out)  # lint-jax: allow(JX109)\n"
+           "    return toks\n")
+    assert lint_source(src, "x.py") == []
+    # fetches on values from non-decode calls stay out of JX109's scope
+    src_ok = ("import numpy as np\n"
+              "def walk(rows, score):\n"
+              "    for r in rows:\n"
+              "        v = score(r)\n"
+              "        s = float(np.asarray(v))\n"
+              "    return s\n")
+    assert lint_source(src_ok, "x.py") == []
+
+
 JX107_FLAGGED = '''
 import cv2
 from mmlspark_tpu.native import imgops

@@ -16,7 +16,8 @@ from perf_smoke import (  # noqa: E402
     check_compile_cache, check_concurrency_clean, check_fleet_obs,
     check_fused_crossings, check_flight_recorder, check_obs_overhead,
     check_obs_request_tracing, check_serve_batching,
-    check_serve_lifecycle, check_serve_lowprec, check_serve_sharded,
+    check_serve_generate, check_serve_lifecycle, check_serve_lowprec,
+    check_serve_sharded,
     check_spmd_clean, check_train_device_preprocess, check_train_elastic,
     check_train_prefetch,
 )
@@ -222,6 +223,30 @@ def test_serve_lifecycle_survives_seeded_chaos():
     assert "rollback" in canary["decision_kinds"]
     assert "swap" in canary["decision_kinds"]
     assert "lane_restart" in canary["decision_kinds"]
+
+
+def test_serve_generate_streams_bit_identical_and_batches():
+    """Autoregressive token serving (round 18): a streaming burst with
+    seeded join/leave churn delivers every token stream bit-identical
+    to the one-shot whole-sequence decode (cancelled streams exact
+    prefixes), compiled programs stay <= len(prefill_buckets) + 1 (ONE
+    fixed-shape decode program), TTFT/ITL gauges reach /slo and the
+    timeseries MetricHistory, no engine threads leak, and continuous
+    batching sustains >= 2x the request-serial tokens/s on a
+    latency-bound decode with >= 2x fewer decode dispatches."""
+    result = check_serve_generate()
+    burst = result["burst"]
+    assert burst["cancelled"] >= 1
+    assert burst["programs_compiled"] is None \
+        or burst["programs_compiled"] <= burst["program_budget"]
+    assert burst["ttft_ms"]["p50"] > 0 and burst["itl_ms"]["p99"] > 0
+    for gauge, series in burst["slo_gauge_history"].items():
+        assert series and all(n >= 3 for n in series.values()), (
+            f"{gauge}: {series}")
+    tp = result["throughput"]
+    assert tp["speedup"] >= tp["min_speedup"]
+    assert tp["step_ratio"] >= 2.0
+    assert tp["batched"]["tokens"] == tp["serial"]["tokens"]
 
 
 def test_serve_dp_replica_fanout_multiplies_throughput():

@@ -225,11 +225,35 @@ class TestBucketing:
         assert shapes <= {64, 256, 1024}
         assert sorted(seen) == list(range(50))
 
-    def test_overlong_truncated_into_top_bucket(self):
+    def test_overlong_sequence_is_a_typed_error(self):
+        # used to be silently truncated into the top bucket — dropping
+        # tokens with no signal; now a ValueError names the sequence
         seqs = [list(range(100))]
-        batches = list(bucket_batches(seqs, 4, bucket_sizes=(8, 16)))
-        assert len(batches) == 1
-        assert batches[0][0].shape == (1, 16)
+        with pytest.raises(ValueError, match="largest bucket"):
+            list(bucket_batches(seqs, 4, bucket_sizes=(8, 16)))
+
+    def test_pad_sequences_rejects_overlong(self):
+        with pytest.raises(ValueError, match="truncation"):
+            pad_sequences([[1, 2, 3, 4, 5]], 4)
+
+    def test_empty_sequence_is_a_typed_error(self):
+        with pytest.raises(ValueError, match="empty"):
+            pad_sequences([[1, 2], []], 4)
+        with pytest.raises(ValueError, match="empty"):
+            list(bucket_batches([[]], 4, bucket_sizes=(8,)))
+
+    def test_non_integer_tokens_are_a_typed_error(self):
+        with pytest.raises(TypeError, match="non-integer"):
+            pad_sequences([[1.5, 2.5]], 4)
+        with pytest.raises(TypeError, match="non-integer"):
+            list(bucket_batches([["a", "b"]], 4, bucket_sizes=(8,)))
+        # float-typed but integer-valued ids pass (numpy upcasts freely)
+        toks, _ = pad_sequences([np.asarray([1.0, 2.0])], 4)
+        np.testing.assert_array_equal(toks[0], [1, 2, 0, 0])
+
+    def test_nested_sequence_is_a_typed_error(self):
+        with pytest.raises(ValueError, match="1-D"):
+            pad_sequences([[[1, 2], [3, 4]]], 4)
 
     def test_unsorted_bucket_sizes_still_smallest_covering(self):
         # an unsorted tuple must not over-pad: a 10-token sequence belongs

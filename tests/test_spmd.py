@@ -67,12 +67,17 @@ EXPECTED_SCHEDULES = {
     # introduce NO collectives on a replica nor under GSPMD-tp
     "serve_int8w_replica": [],
     "serve_int8w_tp": [],
+    # the continuous-batching decode step: a DP replica owns its slot
+    # table and KV cache, so its token loop is manual-collective-free —
+    # a collective here would lockstep independent replicas' decodes
+    "serve_decode_replica": [],
 }
 
 # shard_map sites per entry point: 1 for every manual-collective module,
 # 0 for the GSPMD-only serve segments (no shard_map at all)
 EXPECTED_SITES = {"serve_dp_replica": 0, "serve_tp_segment": 0,
-                  "serve_int8w_replica": 0, "serve_int8w_tp": 0}
+                  "serve_int8w_replica": 0, "serve_int8w_tp": 0,
+                  "serve_decode_replica": 0}
 
 
 @pytest.mark.parametrize("ep", ENTRY_POINTS, ids=lambda e: e.name)
@@ -153,6 +158,32 @@ def test_lone_model_stage_audits_as_one_segment():
                             n_rows=48)
     assert len(audit.segments) == 1, audit.format()
     assert audit.ok and audit.segments[0].schedule.ops == []
+
+
+def test_stateful_decode_audit_pins_donation_safety():
+    """audit_stateful_spmd on the REAL continuous-batching decode build
+    (the same program serve/generate.py jits with donate_argnums=(0,)):
+    collective-free AND donation-safe — the returned KV-cache subtree
+    matches the input leaf-for-leaf, so XLA aliases the buffers in
+    place. A step that shrinks the cache draws SPMD106: donation would
+    silently degrade to a full cache copy per token."""
+    from mmlspark_tpu.analysis.spmd import (audit_stateful_spmd,
+                                            serve_decode_build)
+
+    step, args = serve_decode_build(None)
+    bufs, rest = args[0], args[1:]
+    report = audit_stateful_spmd(step, bufs, rest, name="decode_step")
+    assert report.findings == [], "\n".join(str(f) for f in
+                                            report.findings)
+    assert report.schedule.ops == []
+
+    def shrinking(state, *a):
+        new_state, nxt = step(state, *a)
+        return {"k": new_state["k"][:2], "v": new_state["v"]}, nxt
+
+    bad = audit_stateful_spmd(shrinking, bufs, rest, name="shrunk")
+    assert [f.code for f in bad.findings] == ["SPMD106"]
+    assert "donated" in bad.findings[0].message
 
 
 # ---- predictions = observations: Trainer steps on the dryrun meshes ----

@@ -10,6 +10,14 @@ tolerance bands::
     tail latency (``*p99*``)                          current <= 1.25x best prior (min)
     byte ratios (``*bytes_ratio*``)                   exact == last prior
 
+The round-18 token-serving keys ride the same bands —
+``serve_generate_tokens_per_s`` is throughput,
+``serve_generate_ttft_p99_ms``/``serve_generate_itl_p99_ms`` are tail
+latency — plus :data:`LATENCY_GATED_P50` names median-latency keys
+(e.g. ``serve_generate_ttft_p50_ms``) that gate under the p99 band
+too: a median is far less weather-prone than a tail, so a 1.25x drift
+there is a real regression, not a loaded box.
+
 and exits **2 with a named-regressions report** when any gated metric
 falls outside its band (``tools/trace.py``'s typed exit-2 discipline).
 Metrics present only in the current line are reported as *new* (a
@@ -57,11 +65,19 @@ VOLATILE = frozenset({
 })
 
 
+#: median-latency keys gated under the p99 band: medians of
+#: high-sample-count token streams (TTFT over a whole burst) are stable
+#: enough that the tail band is a meaningful floor for them too
+LATENCY_GATED_P50 = frozenset({
+    "serve_generate_ttft_p50_ms",
+})
+
+
 def classify(key: str) -> str | None:
     """Metric key → tolerance class (None = informational, ungated)."""
     if "bytes_ratio" in key:
         return "exact"
-    if "p99" in key:
+    if "p99" in key or key in LATENCY_GATED_P50:
         return "p99"
     if "per_s" in key or key.endswith("_mb_s") or key.endswith("_tf_s"):
         return "throughput"

@@ -32,6 +32,16 @@ jax initialization) catching the mistakes that cost the most on TPU:
   through the bounded in-flight window and drain the *oldest* entry (or
   fetch after the loop) — the discipline of
   ``mmlspark_tpu/serve/batcher.py``.
+* **JX109 blocking fetch in a decode/generate loop** — ``np.asarray``/
+  ``float()``/``int()``/``.item()``/``.tolist()`` on the output of a
+  ``*decode*``/``*generate*`` call (the full dotted spelling counts:
+  ``self._decode.jitted(...)`` qualifies) inside the loop that issued
+  it: autoregressive decode is a chain of tiny dispatches, so a
+  same-step host fetch serializes every token on its device round-trip
+  — the worst case of the JX105/JX106 stall, paid per token. Carry the
+  token on device (the decode program's own output feeds the next
+  step's input) and consume the *previous* step's output instead — the
+  one-step-lagged discipline of ``mmlspark_tpu/serve/generate.py``.
 * **JX108 implicit f64 promotion in device code** — ``np.float64(...)``/
   ``np.double(...)`` scalar constructors or ``dtype=np.float64`` /
   ``dtype="float64"`` arguments inside a jit-traced body, a device-stage
@@ -161,6 +171,10 @@ RULES = {
              "device-stage bodies or step/serve loops; numpy f64 scalars "
              "are strongly typed and silently widen bf16/f32 activation "
              "chains — use np.float32 or a python literal",
+    "JX109": "blocking fetch on the current decode step's output inside "
+             "the decode/generate loop; carry the token on device and "
+             "consume the previous step's output one step lagged "
+             "(serve/generate.py's discipline)",
     "JX201": "collective under data-dependent control flow (lax.cond/"
              "switch/while_loop); hoist it out — hosts that disagree on "
              "the predicate deadlock",
@@ -227,7 +241,20 @@ _PIL_ROOTS = {"Image", "PIL"}
 
 
 def _is_step_call(name: str) -> bool:
-    return _STEP_HINT in name.lower()
+    # "decode" spellings route to JX109 (the per-token face of the same
+    # stall), so a `decode_step` call must not double-fire as JX105
+    low = name.lower()
+    return _STEP_HINT in low.rsplit(".", 1)[-1] \
+        and not _is_decode_call(low)
+
+
+def _is_decode_call(name: str) -> bool:
+    """JX109's taint source: an autoregressive decode/generate call —
+    matched over the FULL dotted spelling (``self._decode.jitted``,
+    ``engine.advance_decode``, ``decode_step``), because the decode
+    handle is usually the receiver, not the terminal attribute."""
+    low = name.lower()
+    return "decode" in low or "generate" in low
 
 
 def _host_image_call(node: ast.Call) -> str | None:
@@ -254,9 +281,13 @@ def _host_image_call(node: ast.Call) -> str | None:
 
 def _is_dispatch_call(name: str) -> bool:
     """JX106's taint source: an async batch dispatch — ``*dispatch*`` or
-    the ``*_async`` naming convention (``transform_async`` & co)."""
+    the ``*_async`` naming convention (``transform_async`` & co). A
+    decode-flavored dispatch (``self._decode.dispatch``) routes to
+    JX109 instead — one site, one rule."""
     low = name.lower()
-    return "dispatch" in low or low.endswith("_async")
+    leaf = low.rsplit(".", 1)[-1]
+    return ("dispatch" in leaf or leaf.endswith("_async")) \
+        and not _is_decode_call(low)
 
 _JIT_NAMES = {"jit", "pjit"}
 _NUMPY_ALIASES = {"np", "numpy", "onp"}
@@ -287,6 +318,25 @@ def _callee_name(node: ast.AST) -> str | None:
     if isinstance(node, ast.Attribute):
         return node.attr
     return None
+
+
+def _call_spelling(node: ast.AST) -> str | None:
+    """Full dotted spelling of a call target, lowercased:
+    ``self._decode.jitted`` → ``"self._decode.jitted"``. The fetch-loop
+    rules match sources over this (JX109 needs the qualifying path —
+    the decode handle is the receiver, the terminal attr is just
+    ``dispatch``/``jitted``); predicates that only care about the
+    terminal name split the last segment off themselves."""
+    parts = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+    elif not parts:
+        return None
+    return ".".join(reversed(parts)).lower()
 
 
 def _literal_axis_names(expr: ast.AST | None) -> set:
@@ -472,6 +522,11 @@ class _Linter(ast.NodeVisitor):
                               "a dispatched batch",
                               "inside the serve dispatch loop",
                               flag_np=True)
+        # JX109: same stall, paid PER TOKEN — a fetch on the current
+        # decode step's output inside the decode/generate loop
+        self._lint_fetch_loop(node, _is_decode_call, "JX109",
+                              "a decode-step output",
+                              "inside the decode loop", flag_np=True)
         has_step = any(
             isinstance(sub, ast.Call)
             and (name := _callee_name(sub.func)) is not None
@@ -560,7 +615,7 @@ class _Linter(ast.NodeVisitor):
             if not (isinstance(node, ast.Assign)
                     and isinstance(node.value, ast.Call)):
                 continue
-            fname = _callee_name(node.value.func)
+            fname = _call_spelling(node.value.func)
             if fname and is_source(fname):
                 for target in node.targets:
                     elts = (target.elts if isinstance(target, ast.Tuple)

@@ -57,6 +57,15 @@ flake on a loaded CI box):
   overlap measures the fan-out honestly), outputs bit-identical across
   replica counts, all four replicas used, and compiled programs still ≤
   ``len(buckets)`` per model — never replicas × buckets.
+* **serve token generation (continuous batching)** — a streaming
+  generate burst with seeded join/leave churn must deliver every token
+  stream bit-identical to the one-shot whole-sequence decode through
+  the same compiled programs (cancelled streams exact prefixes),
+  compile ≤ ``len(prefill_buckets) + 1`` programs (ONE fixed-shape
+  decode program forever), publish TTFT/ITL gauges through ``/slo``
+  into the timeseries MetricHistory, leak no engine threads, and
+  sustain ≥ 2× the tokens/s of request-serial decoding on a
+  latency-bound decode program (serve/generate.py, docs/serving.md).
 * **serve low-precision (int8w+bf16)** — a model served through the
   plan-level precision pass (``core/precision.py``: per-channel int8
   weights dequantized in-program, bf16 activations) must stay within
@@ -1234,6 +1243,252 @@ def check_serve_lifecycle() -> dict:
     return result
 
 
+def check_serve_generate(min_speedup: float = 2.0) -> dict:
+    """Autoregressive token serving (serve/generate.py): a streaming
+    burst with join/leave churn must deliver every request's token
+    stream BIT-IDENTICAL to the one-shot whole-sequence decode through
+    the same compiled programs (a seeded ``generate_cancel`` churn plan
+    truncates some streams — those must be exact PREFIXES), compile at
+    most ``len(prefill_buckets) + 1`` XLA programs (the one-fixed-shape
+    decode discipline, counted at the engine's own plan cache), publish
+    the per-token SLO gauges (TTFT p50/p99, ITL p99) through ``/slo``
+    into the timeseries MetricHistory, leak no engine threads, and —
+    on a latency-bound decode (callback hold inside the decode
+    program, the :func:`_latency_bundle` argument) — sustain
+    ≥ ``min_speedup``× the tokens/s of request-serial decoding with
+    ≥ 2× fewer decode-step dispatches per token (continuous batching
+    actually batches)."""
+    import sys as _sys
+    import threading
+    import time
+
+    import jax
+
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.models.sequence import TransformerTagger
+    from mmlspark_tpu.obs import timeseries as obs_ts
+    from mmlspark_tpu.serve import (
+        Client, FaultPlan, FaultSpec, GenerateBatcher, GenerateConfig,
+        ModelServer, ServeConfig, THREAD_PREFIX, faults,
+    )
+
+    vocab, t_max = 48, 64
+    model = TransformerTagger(vocab_size=vocab, embed_dim=16, num_heads=2,
+                              num_layers=2, mlp_dim=32, num_tags=vocab,
+                              max_len=t_max, causal=True)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((1, 8), np.int32))["params"]
+    cfg = GenerateConfig(slots=4, t_max=t_max, prefill_buckets=(4, 8),
+                         prefill_rows=2, max_new_tokens=8, max_queue=64)
+    rng = np.random.default_rng(0)
+    n_req = 12
+    prompts = [[int(t) for t in rng.integers(1, vocab,
+                                             int(rng.integers(2, 9)))]
+               for _ in range(n_req)]
+    budgets = [int(rng.integers(4, 13)) for _ in range(n_req)]
+
+    obs.disable()
+    obs.clear()
+    obs.registry().reset()
+    obs.enable()
+    result: dict = {"requests": n_req,
+                    "prefill_buckets": list(cfg.prefill_buckets)}
+    server = ModelServer(ServeConfig(slo={
+        "objective": 0.99, "min_requests": 1,
+        "window_s": 2.0, "long_window_s": 4.0}))
+    sampler = obs_ts.enable(
+        interval_s=3600.0,  # on-demand: one history sample per /slo poll
+        registries=lambda: [obs.registry()] + server.metric_registries())
+    try:
+        server.add_generator("lm", model, params, config=cfg)
+        # the one-shot references FIRST: same engine, same compiled
+        # programs, fresh buffers — what every stream must reproduce
+        refs = [server.generate_oneshot("lm", p, n)
+                for p, n in zip(prompts, budgets)]
+        assert all(len(r) >= 1 for r in refs)
+
+        # -- the streaming burst, under a seeded churn plan (clients
+        #    abandoning streams mid-decode → slot leave/rejoin) --
+        churn = FaultPlan([FaultSpec("generate_cancel", model="lm",
+                                     after=6, times=2)], seed=11)
+        client = Client(server)
+        with faults.inject(churn):
+            streams = [client.generate("lm", p, max_new_tokens=n,
+                                       stream=True)
+                       for p, n in zip(prompts, budgets)]
+            got = [st.result(timeout=300) for st in streams]
+        cancelled = sum(1 for st in streams if st.cancelled)
+        assert churn.counts().get("generate_cancel", 0) >= 1 \
+            and cancelled >= 1, (
+            f"the seeded churn plan never cancelled a stream "
+            f"(fired={churn.counts()}, cancelled={cancelled}) — the "
+            "join/leave path went unexercised")
+        for i, (st, toks) in enumerate(zip(streams, got)):
+            if st.cancelled:
+                assert toks == refs[i][:len(toks)], (
+                    f"request {i}: cancelled stream is not a prefix of "
+                    f"the one-shot decode: {toks} vs {refs[i]}")
+            else:
+                assert toks == refs[i], (
+                    f"request {i}: continuously-batched stream diverged "
+                    f"from the one-shot whole-sequence decode: {toks} "
+                    f"vs {refs[i]} — slot state is leaking across "
+                    "requests")
+
+        snap = server.snapshot()["lm"]
+        assert snap.get("generator") is True
+        programs = snap["programs_compiled"]
+        budget = len(cfg.prefill_buckets) + 1
+        if programs is not None:
+            assert programs <= budget, (
+                f"{programs} XLA programs for a "
+                f"{len(cfg.prefill_buckets)}-bucket prefill ladder + ONE "
+                f"decode program (budget {budget}) — join/leave churn is "
+                "recompiling the decode step")
+        assert snap["decode_steps"] > 0
+        occ = snap["slot_occupancy_mean"]
+        assert occ is not None and occ > 1.0 / cfg.slots, (
+            f"mean slot occupancy {occ} under a {n_req}-request burst "
+            f"on {cfg.slots} slots — the engine is decoding one request "
+            "at a time")
+
+        # -- per-token SLO gauges through /slo into MetricHistory --
+        slo = None
+        for _ in range(3):
+            slo = server.slo_snapshot()
+            sampler.sample()
+            time.sleep(0.01)
+        g = slo["lm"]
+        assert g.get("generator") is True
+        assert g["ttft_ms"] and g["ttft_ms"]["p50"] > 0 \
+            and g["ttft_ms"]["p99"] >= g["ttft_ms"]["p50"], g["ttft_ms"]
+        assert g["itl_ms"] and g["itl_ms"]["p99"] > 0, g["itl_ms"]
+        history = {}
+        for gname in ("serve.ttft_p50_ms", "serve.ttft_p99_ms",
+                      "serve.itl_p99_ms"):
+            series = obs_ts.range_(gname)
+            assert series, f"no MetricHistory for {gname} — the "\
+                "serve.ttft_/serve.itl_ sampler prefixes regressed"
+            for key, samples in series.items():
+                assert len(samples) >= 3, (
+                    f"timeseries {key} holds {len(samples)} sample(s); "
+                    "the per-token SLO history needs >= 3")
+            history[gname] = {k: len(v) for k, v in series.items()}
+        result["burst"] = {
+            "cancelled": cancelled,
+            "faults_fired": churn.counts(),
+            "programs_compiled": programs,
+            "program_budget": budget,
+            "decode_steps": snap["decode_steps"],
+            "tokens_out": snap["tokens_out"],
+            "slot_occupancy_mean": occ,
+            "ttft_ms": g["ttft_ms"],
+            "itl_ms": g["itl_ms"],
+            "slo_gauge_history": history,
+        }
+    finally:
+        server.close()
+        obs_ts.disable()
+        obs.disable()
+        obs.clear()
+        obs.registry().reset()
+    leaked = [t.name for t in threading.enumerate()
+              if t.name.startswith(THREAD_PREFIX)]
+    assert leaked == [], f"generate engine threads leaked: {leaked}"
+
+    # -- continuous batching vs request-serial decode on a
+    #    latency-bound model: the decode program holds inside a
+    #    callback (a real device's per-step latency the host does not
+    #    pay), so packed slots amortize it and serial decode cannot --
+    from mmlspark_tpu.ops.pallas.attention import decode_attention
+
+    hold_s = 0.006  # ×2 layers = 12 ms per decode dispatch
+
+    def holding_attention(q, k, v, keep):
+        out = decode_attention(q, k, v, keep)
+
+        def hold(x):
+            time.sleep(hold_s)
+            return x
+
+        return jax.pure_callback(
+            hold, jax.ShapeDtypeStruct(out.shape, out.dtype), out)
+
+    cfg2 = GenerateConfig(slots=4, t_max=32, prefill_buckets=(8,),
+                          prefill_rows=4, max_new_tokens=8, max_queue=64)
+    n2, max_new2 = 8, 8
+    prompts2 = [[int(t) for t in rng.integers(1, vocab, 6)]
+                for _ in range(n2)]
+    runs: dict[str, dict] = {}
+    tokens_by_mode: dict[str, list] = {}
+    switch = _sys.getswitchinterval()
+    _sys.setswitchinterval(0.001)
+    try:
+        for mode in ("serial", "batched"):
+            engine = GenerateBatcher(f"lm_{mode}", model, params,
+                                     config=cfg2,
+                                     decode_attention_fn=holding_attention)
+            try:
+                # warm both programs outside the timed burst
+                engine.submit(prompts2[0], max_new_tokens=2).result(
+                    timeout=300)
+                steps0 = engine.stats.decode_steps
+                t0 = time.perf_counter()
+                if mode == "serial":
+                    toks = [engine.submit(p, max_new_tokens=max_new2)
+                            .result(timeout=300) for p in prompts2]
+                else:
+                    pending = [engine.submit(p, max_new_tokens=max_new2)
+                               for p in prompts2]
+                    toks = [st.result(timeout=300) for st in pending]
+                wall = time.perf_counter() - t0
+                steps = engine.stats.decode_steps - steps0
+            finally:
+                engine.close()
+            n_tokens = sum(len(t) for t in toks)
+            tokens_by_mode[mode] = toks
+            runs[mode] = {
+                "tokens": n_tokens,
+                "wall_s": round(wall, 4),
+                "tokens_per_s": round(n_tokens / wall, 1),
+                "decode_steps": steps,
+            }
+    finally:
+        _sys.setswitchinterval(switch)
+    assert tokens_by_mode["batched"] == tokens_by_mode["serial"], (
+        "batched decode produced different tokens than request-serial "
+        "decode — continuous batching is not row-independent")
+    step_ratio = (runs["serial"]["decode_steps"]
+                  / max(1, runs["batched"]["decode_steps"]))
+    assert step_ratio >= 2.0, (
+        f"continuous batching dispatched only {step_ratio:.2f}x fewer "
+        f"decode steps than request-serial decode "
+        f"({runs['serial']['decode_steps']} vs "
+        f"{runs['batched']['decode_steps']}) for {cfg2.slots} slots — "
+        "requests are not sharing decode dispatches")
+    speedup = (runs["batched"]["tokens_per_s"]
+               / runs["serial"]["tokens_per_s"]
+               if runs["serial"]["tokens_per_s"] else 0.0)
+    assert speedup >= min_speedup, (
+        f"continuous batching sustained only {speedup:.2f}x the "
+        f"request-serial tokens/s ({runs['batched']['tokens_per_s']} vs "
+        f"{runs['serial']['tokens_per_s']}) on the latency-bound decode "
+        "— slot packing is not amortizing the per-step device latency")
+    leaked = [t.name for t in threading.enumerate()
+              if t.name.startswith(THREAD_PREFIX)]
+    assert leaked == [], f"generate engine threads leaked: {leaked}"
+    result["throughput"] = {
+        "min_speedup": min_speedup,
+        "speedup": round(speedup, 2),
+        "step_ratio": round(step_ratio, 2),
+        "device_hold_ms": hold_s * 2 * 1e3,
+        "slots": cfg2.slots,
+        "serial": runs["serial"],
+        "batched": runs["batched"],
+    }
+    return result
+
+
 def check_serve_lowprec(tolerance: float = 6e-2) -> dict:
     """Serve a model int8w+bf16 (weight-only int8, bf16 activations —
     core/precision.py); raise AssertionError unless its outputs stay
@@ -2177,6 +2432,7 @@ def main() -> int:
         serve = check_serve_batching()
         serve_cc = check_compile_cache()
         serve_sharded = check_serve_sharded()
+        serve_generate = check_serve_generate()
         serve_lowprec = check_serve_lowprec()
         serve_lifecycle = check_serve_lifecycle()
         obs_overhead = check_obs_overhead()
@@ -2195,6 +2451,7 @@ def main() -> int:
                       "serve": serve,
                       "serve_compile_cache": serve_cc,
                       "serve_sharded": serve_sharded,
+                      "serve_generate": serve_generate,
                       "serve_lowprec": serve_lowprec,
                       "serve_lifecycle": serve_lifecycle,
                       "obs_overhead": obs_overhead,
