@@ -677,6 +677,143 @@ def bench_serve_load_wall(rng) -> dict:
     return out
 
 
+def bench_serve_fleet(rng, n_total: int = 64, conc: int = 8) -> dict:
+    """Fleet-tier serving A/B (round 19): the same request stream pushed
+    through the router (serve/fleet/) at 1 supervised backend, then at 2
+    after a ``scale_up``, then a 2-backend burst with one backend
+    kill -9'd mid-burst — wall rows/s per fleet size plus the
+    client-observed p99 across the kill (failover pays the re-route
+    INSIDE the request; the kill burst must finish with zero errors).
+
+    Backends are separate processes sharing this box's cores, so on a
+    CPU box the 2-backend rows/s is a labeled-regime number like the
+    sharded A/B — on real multi-chip hosts each backend owns its chips
+    and the A/B multiplies. The cross-regime observables are the zero
+    kill errors and the bounded kill p99."""
+    import os
+    import shutil
+    import signal as _signal
+    import tempfile
+    import threading
+    import urllib.request
+
+    from mmlspark_tpu.serve.fleet import (
+        BackendPool, FleetConfig, FleetRouter, ScalePolicy,
+        ServeSupervisor,
+    )
+    from mmlspark_tpu.serve.fleet.worker import MODEL_NAME, selftest_rows
+    from mmlspark_tpu.train.service import RecoveryPolicy
+
+    tmp = tempfile.mkdtemp(prefix="bench-serve-fleet-")
+    rows = selftest_rows(8)
+    body = json.dumps({"rows": [{"image": r.tolist()} for r in rows],
+                       "dtype": "uint8"}).encode()
+    pool = BackendPool()
+    sup = ServeSupervisor(FleetConfig(
+        service_dir=os.path.join(tmp, "fleet"), initial_backends=1,
+        compile_cache=os.path.join(tmp, "cache"),
+        policy=RecoveryPolicy(max_restarts=2,
+                              rescale_on_exhausted=False,
+                              preempt_exit_codes=()),
+        # manual scaling only: the bench drives fleet size itself
+        scale=ScalePolicy(burn_sustain_s=3600.0, idle_sustain_s=3600.0,
+                          min_backends=1, max_backends=2),
+        worker_obs=False, worker_fleet=False), pool=pool)
+    router = FleetRouter(pool)
+
+    def wait_up(n, timeout=240.0):
+        deadline = time.perf_counter() + timeout
+        while pool.up_count() < n:
+            if time.perf_counter() > deadline:
+                raise RuntimeError(
+                    f"fleet never reached {n} backends: "
+                    f"{pool.snapshot()}")
+            time.sleep(0.2)
+
+    def burst(kill_pid=None):
+        """n_total requests over conc threads; optionally SIGKILL a
+        backend once ~25% of the stream is underway. Returns
+        (rows_per_s, latencies_ms, errors)."""
+        lat_ms: list[float] = []
+        errors: list[str] = []
+        done = [0]
+        lock = threading.Lock()
+        host, port = router.address
+        url = f"http://{host}:{port}/v1/models/{MODEL_NAME}:predict"
+
+        def one():
+            req = urllib.request.Request(
+                url, data=body,
+                headers={"Content-Type": "application/json"})
+            t0 = time.perf_counter()
+            with urllib.request.urlopen(req, timeout=120) as r:
+                r.read()
+            return (time.perf_counter() - t0) * 1e3
+
+        def worker(k):
+            for _ in range(k, n_total, conc):
+                try:
+                    ms = one()
+                    with lock:
+                        lat_ms.append(ms)
+                        done[0] += 1
+                except Exception as e:  # noqa: BLE001 — reported
+                    with lock:
+                        errors.append(f"{type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=worker, args=(k,))
+                   for k in range(conc)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        if kill_pid is not None:
+            while True:
+                with lock:
+                    if done[0] >= n_total // 4 or errors:
+                        break
+                time.sleep(0.005)
+            os.kill(kill_pid, _signal.SIGKILL)
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        return round(n_total * len(rows) / wall, 1), lat_ms, errors
+
+    out: dict = {"requests": n_total, "rows_per_request": len(rows)}
+
+    def record(label, rps, lat_ms, errors):
+        out[label] = {
+            "rows_per_s": rps,
+            "p50_ms": round(float(np.percentile(lat_ms, 50)), 1)
+            if lat_ms else None,
+            "p99_ms": round(float(np.percentile(lat_ms, 99)), 1)
+            if lat_ms else None,
+            "errors": len(errors),
+        }
+        if errors:
+            out[label]["first_error"] = errors[0]
+
+    try:
+        sup.start()
+        router.start()
+        wait_up(1)
+        burst()  # warm the ladder through the router
+        record("fleet1", *burst())
+        sup.scale_up()
+        wait_up(2)
+        record("fleet2", *burst())
+        if isinstance(out["fleet1"]["rows_per_s"], float) \
+                and out["fleet1"]["rows_per_s"]:
+            out["speedup"] = round(out["fleet2"]["rows_per_s"]
+                                   / out["fleet1"]["rows_per_s"], 2)
+        victim = next(iter(sup._backends.values()))
+        record("kill", *burst(kill_pid=victim.proc.pid))
+    finally:
+        router.close()
+        sup.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def main() -> int:
     import jax
 
@@ -1108,6 +1245,16 @@ def main() -> int:
     except Exception as e:  # best-effort metric; label failures accurately
         serve_load_wall = {"error": f"{type(e).__name__}: {e}"}
 
+    # fleet serving (round 19): 1-vs-2 supervised backend processes
+    # behind the router, plus client-observed p99 across an induced
+    # kill -9 mid-burst — the failover cost as the client pays it
+    # (docs/serving.md §fleet tier)
+    serve_fleet: dict | None = None
+    try:
+        serve_fleet = bench_serve_fleet(rng)
+    except Exception as e:  # best-effort metric; label failures accurately
+        serve_fleet = {"error": f"{type(e).__name__}: {e}"}
+
     # BASELINE configs 3-5 (flagship models); skip with BENCH_FAST=1
     import os
     extra: dict = {}
@@ -1205,6 +1352,16 @@ def main() -> int:
         "serve_load_wall_warm_s": (serve_load_wall or {}).get(
             "warm", {}).get("load_wall_s"),
         "serve_load_wall": serve_load_wall,
+        "serve_fleet": serve_fleet,
+        "serve_fleet_rows_per_s_1b": (serve_fleet or {}).get(
+            "fleet1", {}).get("rows_per_s"),
+        "serve_fleet_rows_per_s_2b": (serve_fleet or {}).get(
+            "fleet2", {}).get("rows_per_s"),
+        "serve_fleet_speedup": (serve_fleet or {}).get("speedup"),
+        "serve_fleet_kill_p99_ms": (serve_fleet or {}).get(
+            "kill", {}).get("p99_ms"),
+        "serve_fleet_kill_errors": (serve_fleet or {}).get(
+            "kill", {}).get("errors"),
         "serve_precision_ab": serve_precision,
         **{f"serve_rows_per_s_{p}": (serve_precision or {}).get(
             p, {}).get("serve_rows_per_s") for p in ("f32", "bf16",

@@ -84,6 +84,14 @@ def call_with_retry(fn: Callable[[], Any], policy: RetryPolicy,
     the hook call sites use to log and bump their retry counters (e.g.
     the downloader's ``data.fetch_retries``). A failure not matching
     ``policy.retry_on`` propagates without consuming attempts.
+
+    A failure may carry a server-provided hint in a ``retry_after_s``
+    attribute (the serving plane stamps it on ``Overloaded`` /
+    ``ServerClosed`` from the same config that feeds the HTTP
+    ``Retry-After`` header). The hint is a FLOOR on the backoff delay,
+    never a cap: retrying sooner than the server asked just burns an
+    attempt on a rejection the server already promised, while a policy
+    that wants to wait longer still may.
     """
     delays = policy.delays(rng)
     for attempt in range(1, policy.max_attempts + 1):
@@ -95,6 +103,9 @@ def call_with_retry(fn: Callable[[], Any], policy: RetryPolicy,
             delay = next(delays, None)
             if delay is None:  # attempts exhausted — the caller sees the
                 raise          # real failure, not a retry wrapper
+            hint = getattr(e, "retry_after_s", None)
+            if hint is not None:
+                delay = max(delay, float(hint))
             if on_retry is not None:
                 on_retry(attempt, e, delay)
             sleep(delay)

@@ -55,6 +55,7 @@ DEFAULT_PREFIXES = (
     "serve.ttft_",
     "serve.itl_",
     "serve.lane_",
+    "serve.fleet.",
     "train.host_step_ms",
     "train.host_skew",
     "train.service.",

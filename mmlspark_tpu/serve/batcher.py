@@ -585,13 +585,15 @@ class DynamicBatcher:
         with self._cv:
             if self._closed:
                 raise ServerClosed(
-                    f"model {self.name!r} is shutting down")
+                    f"model {self.name!r} is shutting down",
+                    retry_after_s=self.config.retry_after_s)
             if len(self._queue) >= self.config.max_queue:
                 self.stats.record_rejected()
                 _obs_event("serve/overloaded", "serve",
                            {"model": self.name})
                 raise Overloaded(self.name, len(self._queue),
-                                 self.config.max_queue)
+                                 self.config.max_queue,
+                                 retry_after_s=self.config.retry_after_s)
             self._queue.append(req)
             self.stats.record_admitted(req.n_rows)
             self._cv.notify()
@@ -915,7 +917,8 @@ class DynamicBatcher:
             self.drain_barrier()
             lane = self._acquire_lane()
             if lane is None:  # aborted at the fence: nothing was agreed
-                raise ServerClosed(f"model {self.name!r} closed")
+                raise ServerClosed(f"model {self.name!r} closed",
+                                   retry_after_s=self.config.retry_after_s)
             try:
                 # fenced cross-process seam: every lockstep process
                 # exits agree() together — the fleet plane's serve-side
@@ -942,7 +945,8 @@ class DynamicBatcher:
         while True:
             lane = self._acquire_lane()
             if lane is None:  # aborted while waiting for a slot
-                raise ServerClosed(f"model {self.name!r} closed")
+                raise ServerClosed(f"model {self.name!r} closed",
+                                   retry_after_s=self.config.retry_after_s)
             if lane.assign(packed, batch, rows, bucket):
                 return
             # raced a lane death between acquire and assign: the healer

@@ -160,6 +160,9 @@ class GenerateConfig:
     max_new_tokens: int = 16    # default generation budget per request
     max_queue: int = 128        # waiting-for-a-slot bound; admission
     #                             backpressure past it (Overloaded)
+    retry_after_s: float = 1.0  # backpressure hint stamped on Overloaded/
+    #                             ServerClosed (HTTP Retry-After + the
+    #                             client retry sleep floor)
     eos_token: int | None = None  # stop token (None = run to budget)
     stats_window: int = 4096
     drain_timeout_s: float = 30.0

@@ -8,7 +8,17 @@ from __future__ import annotations
 
 
 class ServeError(Exception):
-    """Base of every serving-layer error."""
+    """Base of every serving-layer error.
+
+    ``retry_after_s`` is the server-provided backpressure hint (the same
+    value the HTTP layer sends as ``Retry-After``): ``None`` means the
+    server offered none. Backpressure errors (:class:`Overloaded`,
+    :class:`ServerClosed`) stamp it from the rejecting server's config;
+    ``core/retry.call_with_retry`` treats it as a floor on its backoff
+    delay so a client never retries sooner than the server asked.
+    """
+
+    retry_after_s: float | None = None
 
 
 class Overloaded(ServeError):
@@ -18,13 +28,15 @@ class Overloaded(ServeError):
     shed load. Carries the observed depth so callers can log honestly.
     """
 
-    def __init__(self, model: str, queued: int, max_queue: int):
+    def __init__(self, model: str, queued: int, max_queue: int,
+                 retry_after_s: float | None = None):
         super().__init__(
             f"model {model!r} overloaded: {queued} requests queued "
             f"(max_queue={max_queue})")
         self.model = model
         self.queued = queued
         self.max_queue = max_queue
+        self.retry_after_s = retry_after_s
 
 
 class DeadlineExceeded(ServeError):
@@ -62,6 +74,11 @@ class ModelNotFound(ServeError):
 class ServerClosed(ServeError):
     """Submission after shutdown began (new work is rejected during
     drain)."""
+
+    def __init__(self, message: str = "server is closed",
+                 retry_after_s: float | None = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
 
 
 class LaneFailed(ServeError):

@@ -38,6 +38,19 @@ point                  seam
                        active request, releases its slot, and the
                        join/leave churn gate asserts no slot
                        double-assignment under the schedule
+``backend_down``       ``serve/fleet/router.FleetRouter`` before it
+                       connects to the picked backend (``lane`` scopes
+                       the backend id) — a backend that died between
+                       selection and connect; the router must re-route,
+                       never drop
+``backend_slow``       same router seam, a ``delay_s`` sleep — a
+                       backend answering slowly without failing, the
+                       case deadline-aware selection must ride out
+``backend_torn_response``  router response-read seam — the TCP stream
+                       tears mid-body (backend killed -9 with bytes in
+                       flight); predicts resend elsewhere, generate
+                       streams replay on a new backend minus the
+                       already-delivered token prefix
 =====================  ====================================================
 
 The seams pay ONE module-attribute check when no plan is installed

@@ -1,0 +1,48 @@
+"""The serve fleet tier — router, backend pool, supervisor, autoscaler.
+
+One process's chips bound the single-``ModelServer`` serving plane;
+this package is the front tier that spreads traffic across N backend
+serve *processes* and grows/shrinks the fleet from its own SLO burn
+signals (docs/serving.md §fleet tier):
+
+* :class:`BackendPool` (``pool.py``) — the routing table: least-loaded
+  deadline-aware selection, ``Retry-After`` holds, zero-drop drains.
+* :class:`FleetRouter` (``router.py``) — the HTTP fan-in proxying
+  ``/v1/models/...`` (``:generate`` streams with per-stream backend
+  affinity) with typed re-route-never-drop failover.
+* :class:`ServeSupervisor` (``supervisor.py``) — launches/watches the
+  backend processes on the shared supervision core
+  (``mmlspark_tpu/service/``), restart-with-backoff via
+  ``RecoveryPolicy``, every decision journaled.
+* :class:`ScalePolicy` (``scale.py``) — the pure autoscaling decision
+  function over ``MetricHistory`` burn/occupancy signals.
+
+CLI: ``python tools/serve_fleet.py``.
+"""
+
+from mmlspark_tpu.serve.fleet.pool import (
+    Backend, BackendPool, NoBackendAvailable,
+)
+from mmlspark_tpu.serve.fleet.router import FleetRouter
+from mmlspark_tpu.serve.fleet.scale import (
+    FleetLedger, Hold, ScaleDown, ScalePolicy, ScaleSignal, ScaleUp,
+    signal_from_history, sustained_s,
+)
+from mmlspark_tpu.serve.fleet.supervisor import FleetConfig, ServeSupervisor
+
+__all__ = [
+    "Backend",
+    "BackendPool",
+    "FleetConfig",
+    "FleetLedger",
+    "FleetRouter",
+    "Hold",
+    "NoBackendAvailable",
+    "ScaleDown",
+    "ScalePolicy",
+    "ScaleSignal",
+    "ScaleUp",
+    "ServeSupervisor",
+    "signal_from_history",
+    "sustained_s",
+]

@@ -1,0 +1,232 @@
+"""The routing table: backend registry, selection, holds, drains.
+
+:class:`BackendPool` generalizes the PR 13 ``Client(retry=)`` semantics
+across processes: where the in-process client retried one server with a
+backoff, the router retries *another backend* — the pool is the shared
+state that makes that choice (who is up, who is held by a
+``Retry-After``, who is draining, who is least loaded).
+
+Selection is least-loaded and deadline-aware: among backends that are
+``up`` and not under an active hold, pick the one with the fewest
+leased requests + active streams (ties → lowest id, for determinism).
+When every candidate is held, :meth:`pick` raises
+:class:`NoBackendAvailable` stamped with the EARLIEST hold expiry as
+``retry_after_s`` — the router compares that against the request's
+remaining deadline to decide wait-and-retry vs. surface the 503
+(``core/retry.call_with_retry`` honors the same stamp as its sleep
+floor, so in-process callers get the identical contract).
+
+All pool state lives under ONE ``named_lock`` witness
+(``serve.fleet.pool``); every method is a short critical section — no
+network I/O, sleeps, or callbacks ever run under it (CC102/CC105), the
+router does all blocking work between pool calls.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from mmlspark_tpu.obs.lockwitness import named_lock
+from mmlspark_tpu.serve.errors import ServeError
+
+
+class NoBackendAvailable(ServeError):
+    """No backend is currently eligible to take this request (none
+    registered, all down/draining, or every live one is under a
+    ``Retry-After`` hold). ``retry_after_s`` carries the earliest hold
+    expiry when holds are the reason — the router's deadline-aware
+    wait-vs-503 pivot, and the client retry sleep floor."""
+
+    def __init__(self, detail: str, retry_after_s: float | None = None):
+        super().__init__(f"no backend available: {detail}")
+        self.retry_after_s = retry_after_s
+
+
+@dataclasses.dataclass
+class Backend:
+    """One registered backend serve process (mutable pool record)."""
+
+    bid: int
+    host: str
+    port: int
+    generation: int = 0
+    state: str = "up"        # up | draining | down
+    inflight: int = 0        # router-leased predict requests
+    streams: int = 0         # active :generate streams (affinity holds)
+    hold_until: float = 0.0  # monotonic Retry-After hold expiry
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return (self.host, self.port)
+
+    @property
+    def load(self) -> int:
+        return self.inflight + self.streams
+
+
+class _Lease:
+    """Context manager pairing the increment/decrement of one load
+    field; decrement survives the backend being re-registered (same
+    record object) and no-ops if it was removed meanwhile."""
+
+    def __init__(self, pool: "BackendPool", bid: int, field: str):
+        self._pool = pool
+        self._bid = bid
+        self._field = field
+
+    def __enter__(self) -> int:
+        self._pool._bump(self._bid, self._field, +1)
+        return self._bid
+
+    def __exit__(self, *exc) -> None:
+        self._pool._bump(self._bid, self._field, -1)
+
+
+class BackendPool:
+    """Thread-safe registry + selector over the live backends."""
+
+    def __init__(self):
+        self._lock = named_lock("serve.fleet.pool")
+        self._backends: dict[int, Backend] = {}
+
+    # -- membership (the supervisor's side) --
+
+    def add(self, bid: int, host: str, port: int,
+            generation: int = 0) -> None:
+        """Register or refresh a backend. A re-add after a restart (new
+        port/generation) clears the down state and any stale hold; a
+        re-add of a DRAINING backend keeps it draining (a beacon
+        arriving mid-drain must not resurrect it into the candidate
+        set)."""
+        with self._lock:
+            b = self._backends.get(bid)
+            if b is None:
+                self._backends[bid] = Backend(bid, host, port,
+                                              generation)
+                return
+            restarted = (b.port != port or b.generation != generation
+                         or b.host != host)
+            b.host, b.port, b.generation = host, port, generation
+            if b.state == "down" or restarted:
+                b.state = "up" if b.state != "draining" else b.state
+                b.hold_until = 0.0
+
+    def remove(self, bid: int) -> None:
+        with self._lock:
+            self._backends.pop(bid, None)
+
+    def mark_down(self, bid: int) -> bool:
+        """Transport failure evidence from the router. Returns whether
+        the backend was previously routable (so the caller reports each
+        death once, not once per in-flight request)."""
+        with self._lock:
+            b = self._backends.get(bid)
+            if b is None:
+                return False
+            was = b.state == "up"
+            b.state = "down"
+            return was
+
+    def drain(self, bid: int) -> None:
+        """Begin a zero-drop drain: the backend leaves the candidate
+        set for NEW work but keeps its active leases/streams until they
+        finish (:meth:`idle` reports when it is safe to stop the
+        process)."""
+        with self._lock:
+            b = self._backends.get(bid)
+            if b is not None and b.state == "up":
+                b.state = "draining"
+
+    def hold(self, bid: int, retry_after_s: float) -> None:
+        """A backend answered 429/503 with Retry-After: keep it out of
+        selection until the hold expires (monotonic clock)."""
+        with self._lock:
+            b = self._backends.get(bid)
+            if b is not None:
+                b.hold_until = max(b.hold_until,
+                                   time.monotonic()
+                                   + max(0.0, retry_after_s))
+
+    # -- selection + leases (the router's side) --
+
+    def pick(self, exclude: tuple[int, ...] = ()) -> int:
+        """Least-loaded eligible backend id. Raises
+        :class:`NoBackendAvailable` (stamped with the earliest hold
+        expiry when holds are what is blocking) otherwise."""
+        now = time.monotonic()
+        with self._lock:
+            up = [b for b in self._backends.values()
+                  if b.state == "up" and b.bid not in exclude]
+            free = [b for b in up if b.hold_until <= now]
+            if free:
+                best = min(free, key=lambda b: (b.load, b.bid))
+                return best.bid
+            if up:  # all live candidates are held: tell the caller
+                #     when the earliest hold lifts
+                soonest = min(b.hold_until for b in up) - now
+                raise NoBackendAvailable(
+                    f"all {len(up)} live backend(s) held by "
+                    "Retry-After", retry_after_s=max(0.0, soonest))
+        raise NoBackendAvailable("no live backends"
+                                 + (f" (excluded {sorted(exclude)})"
+                                    if exclude else ""))
+
+    def _bump(self, bid: int, field: str, delta: int) -> None:
+        with self._lock:
+            b = self._backends.get(bid)
+            if b is not None:
+                setattr(b, field, max(0, getattr(b, field) + delta))
+
+    def lease(self, bid: int) -> _Lease:
+        """Account one in-flight predict on ``bid`` for its scope."""
+        return _Lease(self, bid, "inflight")
+
+    def stream_lease(self, bid: int) -> _Lease:
+        """Account one active :generate stream on ``bid`` — the
+        affinity hold that keeps a draining backend alive until its
+        streams finish."""
+        return _Lease(self, bid, "streams")
+
+    # -- queries --
+
+    def get(self, bid: int) -> Backend | None:
+        with self._lock:
+            b = self._backends.get(bid)
+            return dataclasses.replace(b) if b is not None else None
+
+    def address(self, bid: int) -> tuple[str, int]:
+        with self._lock:
+            b = self._backends.get(bid)
+            if b is None:
+                raise NoBackendAvailable(f"backend {bid} not registered")
+            return (b.host, b.port)
+
+    def idle(self, bid: int) -> bool:
+        """True when ``bid`` is draining AND its last lease/stream is
+        gone — the zero-drop stop point."""
+        with self._lock:
+            b = self._backends.get(bid)
+            return (b is not None and b.state == "draining"
+                    and b.load == 0)
+
+    def up_count(self) -> int:
+        with self._lock:
+            return sum(1 for b in self._backends.values()
+                       if b.state == "up")
+
+    def ids(self) -> list[int]:
+        with self._lock:
+            return sorted(self._backends)
+
+    def snapshot(self) -> list[dict]:
+        """The routing table as plain dicts (journal / ``/backends``)."""
+        now = time.monotonic()
+        with self._lock:
+            return [{
+                "bid": b.bid, "host": b.host, "port": b.port,
+                "generation": b.generation, "state": b.state,
+                "inflight": b.inflight, "streams": b.streams,
+                "held_s": round(max(0.0, b.hold_until - now), 3),
+            } for b in sorted(self._backends.values(),
+                              key=lambda b: b.bid)]

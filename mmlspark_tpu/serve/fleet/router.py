@@ -1,0 +1,489 @@
+"""The fleet router: HTTP fan-in with re-route-never-drop failover.
+
+A front-end ``ThreadingHTTPServer`` that proxies the serve HTTP
+protocol (``serve/http.py``) over the N backend serve processes in a
+:class:`~mmlspark_tpu.serve.fleet.pool.BackendPool`. The contracts:
+
+**Predict failover — resend, because inference is pure.** A
+``:predict`` is a deterministic pure function of its rows (the whole
+bit-compat discipline of the serving plane), so a transport failure at
+ANY point — connect refused, reset mid-body, a torn response — is
+answered by resending the same request to another backend: the client
+can never observe a dropped answer, and "doubled" has no meaning for a
+side-effect-free computation. A backend that answers 429/503 gets a
+``Retry-After`` hold in the pool (selection skips it until expiry) and
+the request re-routes to a free backend; when EVERY live backend is
+held, the router compares the earliest hold expiry against its wait
+budget — sleep-and-retry if it fits, else surface the typed 503 with
+``Retry-After`` so the client's own retry loop (whose sleep floor
+honors the same stamp) takes over.
+
+**Generate failover — replay minus the delivered prefix.** A
+``:generate`` stream is pinned to one backend (per-stream affinity via
+``pool.stream_lease``: a draining backend finishes its active streams;
+new streams route elsewhere). If the backend dies mid-stream, the
+router replays the SAME request on another backend and discards the
+first ``delivered`` token lines before resuming the client's stream —
+decode is deterministic, so the replayed prefix is bit-identical to
+what the client already holds and the continuation seams exactly:
+strict-prefix preserved, no token dropped, none doubled. A terminal
+``{"error": ...}`` line FROM the engine is relayed as-is (that is the
+backend's typed answer, not a transport fault).
+
+Fault seams (``serve/faults.py``): ``backend_down`` (before connect),
+``backend_slow`` (a ``delay_s`` sleep at the same seam), and
+``backend_torn_response`` (per response/token-line read) make the
+kill/failover chaos replayable.
+
+The router's own telemetry rides the process registry
+(``serve.fleet.router.*`` counters — exported by the fleet telemetry
+plane like any other registry), and each proxied request carries an
+``X-Fleet-Request-Id`` the backend echoes into its trace as a
+``serve/fleet_rx`` event — the span link across the process hop.
+"""
+
+from __future__ import annotations
+
+import http.client
+import itertools
+import json
+import math
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from mmlspark_tpu.core.logging_utils import get_logger
+from mmlspark_tpu.obs.metrics import registry as _obs_registry
+from mmlspark_tpu.obs.spans import span as _obs_span
+from mmlspark_tpu.serve import faults as _faults
+from mmlspark_tpu.serve.fleet.pool import BackendPool, NoBackendAvailable
+
+_log = get_logger(__name__)
+
+ROUTER_THREAD = "ServeFleetRouter"
+
+#: what counts as "the backend hop failed" (vs. the backend answering):
+#: socket-level faults, HTTP protocol tears, and the injected faults
+#: that model them — all safe to re-route
+_TRANSPORT_ERRORS = (OSError, http.client.HTTPException,
+                     _faults.InjectedFault)
+
+
+def _parse_retry_after(headers: dict) -> float | None:
+    v = headers.get("Retry-After")
+    if v is None:
+        return None
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return None
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "mmlspark-tpu-fleet-router"
+
+    @property
+    def _router(self) -> "FleetRouter":
+        return self.server.router  # type: ignore[attr-defined]
+
+    def log_message(self, fmt, *args) -> None:
+        _log.debug("router %s — %s", self.address_string(), fmt % args)
+
+    # -- responses --
+
+    def _send(self, status: int, body: bytes,
+              content_type: str = "application/json",
+              headers: dict | None = None) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for name, value in (headers or {}).items():
+            self.send_header(name, value)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, status: int, payload: dict,
+                   headers: dict | None = None) -> None:
+        self._send(status, json.dumps(payload).encode("utf-8"),
+                   headers=headers)
+
+    def _send_no_backend(self, e: NoBackendAvailable) -> None:
+        self._router._count("no_backend")
+        ra = e.retry_after_s
+        if ra is None:
+            ra = self._router.default_retry_after_s
+        self._send_json(503, {"error": "NoBackendAvailable",
+                              "message": str(e)},
+                        headers={"Retry-After":
+                                 str(max(1, math.ceil(ra)))})
+
+    # -- routes --
+
+    def do_GET(self) -> None:  # noqa: N802 - http.server contract
+        router = self._router
+        if self.path == "/healthz":
+            up = router.pool.up_count()
+            self._send_json(200 if up else 503,
+                            {"ready": up > 0, "backends_up": up},
+                            headers=None if up else
+                            {"Retry-After": str(max(1, math.ceil(
+                                router.default_retry_after_s)))})
+        elif self.path == "/livez":
+            self._send_json(200, {"alive": True})
+        elif self.path == "/backends":
+            self._send_json(200, {"backends": router.pool.snapshot(),
+                                  "counters": router.counters()})
+        else:
+            self._send_json(404, {"error": "NotFound",
+                                  "message": self.path})
+
+    def do_POST(self) -> None:  # noqa: N802 - http.server contract
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        if not (self.path.startswith("/v1/models/")
+                and (self.path.endswith(":predict")
+                     or self.path.endswith(":generate"))):
+            self._send_json(404, {"error": "NotFound",
+                                  "message": self.path})
+            return
+        router = self._router
+        router._count("requests")
+        name = self.path[len("/v1/models/"):].rsplit(":", 1)[0]
+        rid = router._next_request_id()
+        with _obs_span("serve.fleet/route", "serve",
+                       {"model": name, "request_id": rid,
+                        "path": self.path}):
+            if self.path.endswith(":generate"):
+                self._proxy_generate(name, body, rid)
+            else:
+                self._proxy_predict(name, body, rid)
+
+    # -- predict proxy --
+
+    def _backend_headers(self, rid: str) -> dict:
+        hdrs = {"Content-Type":
+                self.headers.get("Content-Type") or "application/json",
+                "X-Fleet-Request-Id": rid}
+        for h in ("Accept", "X-Deadline-Ms"):
+            v = self.headers.get(h)
+            if v:
+                hdrs[h] = v
+        return hdrs
+
+    def _proxy_predict(self, name: str, body: bytes, rid: str) -> None:
+        router = self._router
+        tried: set[int] = set()
+        waited = 0.0
+        attempts = 0
+        while True:
+            try:
+                bid = router.pool.pick(exclude=tuple(tried))
+            except NoBackendAvailable as e:
+                # deadline-aware wait: when every live backend is held
+                # and the earliest hold lifts within the wait budget,
+                # waiting beats bouncing a 503 to a client that asked
+                # for an answer, not an errand
+                ra = e.retry_after_s
+                if (ra is not None
+                        and waited + ra <= router.wait_budget_s):
+                    time.sleep(ra)
+                    waited += ra
+                    continue
+                if ra is None and waited < router.wait_budget_s:
+                    # every backend marked down, none merely held: a
+                    # transient death window. The supervisor's next
+                    # beacon revives a survivor (or lands a respawn)
+                    # within a beat — wait it out and re-admit
+                    # previously tried backends (predict is pure, a
+                    # revived backend may be retried)
+                    step = min(0.05, router.wait_budget_s - waited)
+                    time.sleep(step)
+                    waited += step
+                    tried.clear()
+                    continue
+                self._send_no_backend(e)
+                return
+            attempts += 1
+            with router.pool.lease(bid):
+                try:
+                    status, hdrs, resp = router._forward(
+                        bid, name, self.path, body,
+                        self._backend_headers(rid))
+                except _TRANSPORT_ERRORS as e:
+                    # backend death mid-request: a retriable re-route,
+                    # never a dropped answer (predict is pure — the
+                    # resend recomputes the identical result)
+                    if router.pool.mark_down(bid):
+                        _log.warning("router: backend %d down (%s)",
+                                     bid, e)
+                    router._count("reroutes")
+                    tried.add(bid)
+                    continue
+            if status in (429, 503):
+                ra = _parse_retry_after(hdrs)
+                router.pool.hold(
+                    bid, ra if ra is not None
+                    else router.default_retry_after_s)
+                router._count("held")
+                if attempts < router.max_attempts:
+                    continue  # pick() now skips the held backend
+            router._count("relayed")
+            out = {"X-Fleet-Backend": str(bid)}
+            for h in ("X-Serve-Identity", "Retry-After"):
+                if h in hdrs:
+                    out[h] = hdrs[h]
+            self._send(status, resp,
+                       content_type=hdrs.get("Content-Type",
+                                             "application/json"),
+                       headers=out)
+            return
+
+    # -- generate proxy (streaming, affinity, prefix-skip replay) --
+
+    def _chunk(self, obj: dict) -> None:
+        data = json.dumps(obj).encode("utf-8") + b"\n"
+        self.wfile.write(f"{len(data):X}\r\n".encode("ascii")
+                         + data + b"\r\n")
+        self.wfile.flush()
+
+    def _proxy_generate(self, name: str, body: bytes,
+                        rid: str) -> None:
+        router = self._router
+        tried: set[int] = set()
+        waited = 0.0
+        # replay state OUTLIVES a torn leg: _stream_from mutates these
+        # in place so a tear after the status line / after k delivered
+        # tokens replays with the truth, not a stale snapshot
+        self._g_sent = False       # client status line + headers out?
+        self._g_delivered = 0      # token lines the client holds
+        while True:
+            try:
+                bid = router.pool.pick(exclude=tuple(tried))
+            except NoBackendAvailable as e:
+                # same wait discipline as predict: holds lift, death
+                # windows close at the next supervisor beacon — and a
+                # stream mid-replay would rather stall a beat than die
+                ra = e.retry_after_s
+                if (ra is not None
+                        and waited + ra <= router.wait_budget_s):
+                    time.sleep(ra)
+                    waited += ra
+                    continue
+                if ra is None and waited < router.wait_budget_s:
+                    step = min(0.05, router.wait_budget_s - waited)
+                    time.sleep(step)
+                    waited += step
+                    tried.clear()
+                    continue
+                if not self._g_sent:
+                    self._send_no_backend(e)
+                else:
+                    # mid-stream exhaustion: the status line is gone,
+                    # so the failure arrives as the typed terminal
+                    # line the protocol already defines
+                    router._count("no_backend")
+                    self._chunk({"error": "NoBackendAvailable",
+                                 "message": str(e)})
+                    self.wfile.write(b"0\r\n\r\n")
+                return
+            with router.pool.stream_lease(bid):
+                leg = self._stream_from(router, bid, name, body, rid,
+                                        tried)
+            if leg is None:
+                # torn: replay the SAME request on another backend,
+                # skipping the prefix the client already holds
+                # (deterministic decode → the skipped lines are
+                # bit-identical to what was delivered)
+                tried.add(bid)
+                router._count("stream_replays")
+                continue
+            if leg:
+                return
+
+    def _stream_from(self, router: "FleetRouter", bid: int, name: str,
+                     body: bytes, rid: str,
+                     tried: set) -> bool | None:
+        """One backend's leg of a :generate stream. Returns None on a
+        transport tear (caller replays elsewhere), True when the
+        response is complete, False to re-pick (backpressure reroute).
+        Mutates ``self._g_sent`` / ``self._g_delivered``."""
+        path = f"/v1/models/{name}:generate"
+        try:
+            host, port = router.pool.address(bid)
+            _faults.hit("backend_down", name, bid)
+            _faults.hit("backend_slow", name, bid)
+            conn = http.client.HTTPConnection(
+                host, port, timeout=router.backend_timeout_s)
+        except _TRANSPORT_ERRORS:
+            router.pool.mark_down(bid)
+            return None
+        try:
+            try:
+                conn.request("POST", path, body=body,
+                             headers=self._backend_headers(rid))
+                resp = conn.getresponse()
+            except _TRANSPORT_ERRORS:
+                router.pool.mark_down(bid)
+                return None
+            if resp.status != 200:
+                # typed admission answer (Overloaded/BadRequest/...):
+                # relay it cleanly — unless it is backpressure and
+                # another backend can still take the stream
+                data = resp.read()
+                hdrs = dict(resp.getheaders())
+                if resp.status in (429, 503):
+                    ra = _parse_retry_after(hdrs)
+                    router.pool.hold(
+                        bid, ra if ra is not None
+                        else router.default_retry_after_s)
+                    router._count("held")
+                    if not self._g_sent \
+                            and len(tried) + 1 < router.max_attempts:
+                        tried.add(bid)
+                        return False
+                if self._g_sent:  # stream open: typed terminal line
+                    self._chunk({"error": "BackendRejected",
+                                 "status": resp.status,
+                                 "message": data.decode("utf-8",
+                                                        "replace")})
+                    self.wfile.write(b"0\r\n\r\n")
+                else:
+                    out = {"X-Fleet-Backend": str(bid)}
+                    if "Retry-After" in hdrs:
+                        out["Retry-After"] = hdrs["Retry-After"]
+                    self._send(resp.status, data,
+                               content_type=hdrs.get(
+                                   "Content-Type", "application/json"),
+                               headers=out)
+                return True
+            if not self._g_sent:
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.send_header("X-Fleet-Backend", str(bid))
+                self.end_headers()
+                self._g_sent = True
+            skip = self._g_delivered
+            try:
+                while True:
+                    _faults.hit("backend_torn_response", name, bid)
+                    line = resp.readline()
+                    if not line:
+                        break  # EOF before the terminal line: torn
+                    try:
+                        obj = json.loads(line)
+                    except ValueError:
+                        break  # half a line: torn mid-write
+                    if "token" in obj:
+                        if skip > 0:
+                            skip -= 1  # replayed prefix: the client
+                            continue   # already holds these tokens
+                        self._chunk({"token": obj["token"],
+                                     "index": self._g_delivered})
+                        self._g_delivered += 1
+                    elif "error" in obj:
+                        # the ENGINE's typed mid-stream failure: relay
+                        # as-is — it is the backend's answer, replaying
+                        # it elsewhere could double-deliver work the
+                        # engine already refused
+                        self._chunk(obj)
+                        self.wfile.write(b"0\r\n\r\n")
+                        return True
+                    else:  # the terminal done/summary line
+                        self._chunk(obj)
+                        self.wfile.write(b"0\r\n\r\n")
+                        router._count("relayed")
+                        return True
+            except _TRANSPORT_ERRORS:
+                pass
+            router.pool.mark_down(bid)
+            return None
+        finally:
+            conn.close()
+
+
+class _RouterHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    # socketserver's default backlog of 5 resets connections under a
+    # fan-in burst; the router is the fleet's front door — queue them
+    request_queue_size = 128
+
+    def __init__(self, router: "FleetRouter", address: tuple):
+        self.router = router
+        super().__init__(address, _RouterHandler)
+
+
+class FleetRouter:
+    """The fan-in front end over a :class:`BackendPool` (module
+    docstring has the routing/failover contracts)."""
+
+    def __init__(self, pool: BackendPool, host: str = "127.0.0.1",
+                 port: int = 0, max_attempts: int = 3,
+                 backend_timeout_s: float = 30.0,
+                 wait_budget_s: float = 2.0,
+                 default_retry_after_s: float = 1.0):
+        self.pool = pool
+        self.max_attempts = int(max_attempts)
+        self.backend_timeout_s = float(backend_timeout_s)
+        self.wait_budget_s = float(wait_budget_s)
+        self.default_retry_after_s = float(default_retry_after_s)
+        self._rid = itertools.count()
+        self._httpd = _RouterHTTPServer(self, (host, port))
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle --
+
+    @property
+    def address(self) -> tuple[str, int]:
+        host, port = self._httpd.server_address[:2]
+        return (str(host), int(port))
+
+    def start(self) -> "FleetRouter":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name=ROUTER_THREAD,
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+
+    # -- internals shared with the handler --
+
+    def _next_request_id(self) -> str:
+        return f"{os.getpid():x}-{next(self._rid)}"
+
+    def _count(self, name: str) -> None:
+        _obs_registry().counter(f"serve.fleet.router.{name}").add()
+
+    def counters(self) -> dict:
+        return {m.name: m.value
+                for m in _obs_registry().iter_metrics()
+                if m.name.startswith("serve.fleet.router.")}
+
+    def _forward(self, bid: int, name: str, path: str, body: bytes,
+                 headers: dict) -> tuple[int, dict, bytes]:
+        """One predict hop: connect, send, read the whole answer.
+        Raises a ``_TRANSPORT_ERRORS`` member on any failure — the
+        caller's cue to re-route. Fault seams fire here so chaos
+        schedules can model a dead backend (``backend_down``), a slow
+        one (``backend_slow``), and a response torn mid-read
+        (``backend_torn_response``)."""
+        host, port = self.pool.address(bid)
+        _faults.hit("backend_down", name, bid)
+        _faults.hit("backend_slow", name, bid)
+        conn = http.client.HTTPConnection(
+            host, port, timeout=self.backend_timeout_s)
+        try:
+            conn.request("POST", path, body=body, headers=headers)
+            resp = conn.getresponse()
+            _faults.hit("backend_torn_response", name, bid)
+            data = resp.read()
+            return resp.status, dict(resp.getheaders()), data
+        finally:
+            conn.close()

@@ -1,0 +1,166 @@
+"""Autoscaling as pure policy over burn-rate signals.
+
+The train service split supervision into sensors → policy → actuator
+(``train/service.py``); the fleet autoscaler keeps the same shape. The
+sensors are the PR 14 SLO series every backend already publishes
+(``serve.slo_burn_short`` fast-window burn, ``serve.occupancy_*``) —
+the supervisor aggregates them off the beacons into its
+:class:`~mmlspark_tpu.obs.timeseries.MetricHistory` and condenses one
+poll into a :class:`ScaleSignal`. :class:`ScalePolicy` is the PURE
+decision function from that signal + the :class:`FleetLedger` to a
+typed action — unit-testable without a single process spawned:
+
+==============================================  =====================
+signal                                          action
+==============================================  =====================
+fast burn ≥ ``fast_burn`` for ``burn_sustain_s``  :class:`ScaleUp`
+  (and below ``max_backends``)                    (spawn a backend)
+mean occupancy ≤ ``idle_occupancy`` for           :class:`ScaleDown`
+  ``idle_sustain_s`` (and above ``min_backends``) (zero-drop drain one)
+within ``cooldown_s`` of the last scale action    :class:`Hold`
+anything else                                     :class:`Hold`
+==============================================  =====================
+
+Sustain windows are the flap damper: one burning poll (a single
+deadline storm sample) must not buy a process spawn, and one idle poll
+must not tear a warm backend down. The cooldown guards against
+relay-oscillation — a fresh backend needs at least one beacon interval
+before its effect shows in the signals it was spawned to fix.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+
+def sustained_s(samples: list[tuple[float, float]], now: float,
+                pred) -> float:
+    """Length (seconds, up to ``now``) of the trailing run of samples
+    satisfying ``pred`` — 0.0 when the newest sample fails it or there
+    are no samples. The standard multiwindow-burn trick reduced to what
+    a sustain threshold needs: how long has this been CONTINUOUSLY
+    true."""
+    run_start = None
+    for t, v in samples:  # oldest → newest (MetricHistory.range order)
+        if pred(v):
+            if run_start is None:
+                run_start = t
+        else:
+            run_start = None
+    return (now - run_start) if run_start is not None else 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class ScaleSignal:
+    """One poll's condensed sensor read."""
+
+    backends: int           # live (up, non-draining) backends
+    burn: float = 0.0       # newest max fast-window burn across backends
+    burn_high_s: float = 0.0   # seconds burn has been >= the threshold
+    occupancy: float = 0.0  # newest mean occupancy across backends
+    idle_s: float = 0.0     # seconds occupancy has been <= idle line
+
+
+@dataclasses.dataclass
+class FleetLedger:
+    """The scaling history the policy conditions on."""
+
+    scale_ups: int = 0
+    scale_downs: int = 0
+    since_scale_s: float = math.inf  # seconds since the last scale
+    #                                  action (inf = never scaled)
+
+
+@dataclasses.dataclass(frozen=True)
+class ScaleUp:
+    reason: str
+
+
+@dataclasses.dataclass(frozen=True)
+class ScaleDown:
+    reason: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Hold:
+    reason: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalePolicy:
+    """Signal → action, pure (table in the module docstring)."""
+
+    fast_burn: float = 14.0      # the SLOSpec fast-burn line: burning
+    #                              the monthly budget 14x too fast
+    burn_sustain_s: float = 1.0
+    idle_occupancy: float = 0.02
+    idle_sustain_s: float = 30.0
+    min_backends: int = 1
+    max_backends: int = 4
+    cooldown_s: float = 5.0
+
+    def __post_init__(self) -> None:
+        if self.min_backends < 1:
+            raise ValueError(
+                f"min_backends must be >= 1: {self.min_backends}")
+        if self.max_backends < self.min_backends:
+            raise ValueError(
+                f"max_backends ({self.max_backends}) < min_backends "
+                f"({self.min_backends})")
+
+    def decide(self, sig: ScaleSignal, ledger: FleetLedger):
+        if ledger.since_scale_s < self.cooldown_s:
+            return Hold(f"cooldown ({ledger.since_scale_s:.1f}s < "
+                        f"{self.cooldown_s:g}s since last scale)")
+        if sig.burn_high_s >= self.burn_sustain_s and sig.burn > 0:
+            if sig.backends >= self.max_backends:
+                return Hold(f"fast burn {sig.burn:.1f}x sustained but "
+                            f"already at max_backends "
+                            f"({self.max_backends})")
+            return ScaleUp(f"fast burn {sig.burn:.1f}x sustained "
+                           f"{sig.burn_high_s:.1f}s "
+                           f">= {self.burn_sustain_s:g}s")
+        if sig.idle_s >= self.idle_sustain_s:
+            if sig.backends <= self.min_backends:
+                return Hold("idle but at min_backends "
+                            f"({self.min_backends})")
+            return ScaleDown(f"occupancy {sig.occupancy:.3f} <= "
+                             f"{self.idle_occupancy:g} for "
+                             f"{sig.idle_s:.1f}s")
+        return Hold()
+
+
+#: the aggregated series names the supervisor appends each poll and
+#: :func:`signal_from_history` reads back — ONE derivation, shared by
+#: policy and telemetry (the timeseries sampler persists them too via
+#: the ``serve.fleet.`` default prefix)
+BURN_SERIES = "serve.fleet.burn_max"
+OCCUPANCY_SERIES = "serve.fleet.occupancy_mean"
+
+
+def signal_from_history(history, *, now: float, backends: int,
+                        policy: ScalePolicy,
+                        window_s: float = 60.0) -> ScaleSignal:
+    """Condense the supervisor's :class:`MetricHistory` into one
+    :class:`ScaleSignal`: the newest burn/occupancy values plus the
+    trailing sustain runs against ``policy``'s thresholds."""
+    burn_samples = [s for series in
+                    history.range(BURN_SERIES, now - window_s,
+                                  now).values()
+                    for s in series]
+    occ_samples = [s for series in
+                   history.range(OCCUPANCY_SERIES, now - window_s,
+                                 now).values()
+                   for s in series]
+    burn_samples.sort()
+    occ_samples.sort()
+    return ScaleSignal(
+        backends=backends,
+        burn=burn_samples[-1][1] if burn_samples else 0.0,
+        burn_high_s=sustained_s(burn_samples, now,
+                                lambda v: v >= policy.fast_burn),
+        occupancy=occ_samples[-1][1] if occ_samples else 0.0,
+        idle_s=sustained_s(occ_samples, now,
+                           lambda v: v <= policy.idle_occupancy),
+    )

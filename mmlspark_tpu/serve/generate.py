@@ -360,11 +360,13 @@ class GenerateBatcher:
         with self._cv:
             if self._closed:
                 raise ServerClosed(
-                    f"model {self.name!r} is shutting down")
+                    f"model {self.name!r} is shutting down",
+                    retry_after_s=self.config.retry_after_s)
             if len(self._queue) >= self.config.max_queue:
                 self.stats.record_rejected()
                 raise Overloaded(self.name, len(self._queue),
-                                 self.config.max_queue)
+                                 self.config.max_queue,
+                                 retry_after_s=self.config.retry_after_s)
             self._queue.append(req)
             self.stats.record_generate_admitted(len(prompt))
             self._cv.notify()

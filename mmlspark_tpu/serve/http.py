@@ -159,6 +159,11 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        identity = getattr(self.server, "identity", None)
+        if identity:
+            # which backend answered — the fleet router surfaces this so
+            # affinity/failover behavior is assertable end to end
+            self.send_header("X-Serve-Identity", identity)
         for name, value in (headers or {}).items():
             self.send_header(name, value)
         self.end_headers()
@@ -169,14 +174,18 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(status, json.dumps(payload).encode("utf-8"),
                    headers=headers)
 
-    def _retry_after(self) -> dict:
+    def _retry_after(self, exc: BaseException | None = None) -> dict:
         """The backpressure hint: ``errors.py`` tells clients to "retry
         with backoff", so the 429/503 responses must carry something a
         generic HTTP client can act on. Whole seconds (the header's
-        unit), rounded up, from ``ServeConfig.retry_after_s``."""
+        unit), rounded up. The error's own stamped ``retry_after_s``
+        wins when present (it came from the rejecting model's config);
+        the server-wide ``ServeConfig.retry_after_s`` is the fallback."""
         import math
-        return {"Retry-After":
-                str(max(1, math.ceil(self._ms.config.retry_after_s)))}
+        hint = getattr(exc, "retry_after_s", None)
+        if hint is None:
+            hint = self._ms.config.retry_after_s
+        return {"Retry-After": str(max(1, math.ceil(hint)))}
 
     def _send_error_typed(self, exc: BaseException) -> None:
         status = 500
@@ -189,7 +198,7 @@ class _Handler(BaseHTTPRequestHandler):
             # both are "come back later", not "give up": a full queue
             # drains, and a draining/swapping server is replaced by a
             # ready one behind the same balancer
-            headers = self._retry_after()
+            headers = self._retry_after(exc)
         self._send_json(status, {"error": type(exc).__name__,
                                  "message": str(exc)}, headers=headers)
 
@@ -301,6 +310,15 @@ class _Handler(BaseHTTPRequestHandler):
             # the leftover body would parse as the next request line
             length = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(length)
+            rid = self.headers.get("X-Fleet-Request-Id")
+            if rid:
+                # span link across the process hop: the fleet router
+                # stamps each proxied request with its id; the matching
+                # router-side span carries the same id, so a trace
+                # reader can join the two processes' timelines
+                from mmlspark_tpu.obs.spans import event as _obs_event
+                _obs_event("serve/fleet_rx", "serve",
+                           {"request_id": rid, "path": self.path})
             if self.path.startswith("/v1/models/") \
                     and self.path.endswith(":generate"):
                 name = self.path[len("/v1/models/"):-len(":generate")]
@@ -398,6 +416,9 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(200)
         self.send_header("Content-Type", "application/x-ndjson")
         self.send_header("Transfer-Encoding", "chunked")
+        identity = getattr(self.server, "identity", None)
+        if identity:
+            self.send_header("X-Serve-Identity", identity)
         self.end_headers()
 
         def chunk(obj: dict) -> None:
@@ -444,23 +465,34 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class ServeHTTPServer(ThreadingHTTPServer):
-    """ThreadingHTTPServer bound to a :class:`ModelServer`."""
+    """ThreadingHTTPServer bound to a :class:`ModelServer`.
+
+    ``identity``, when set, is echoed on every response as
+    ``X-Serve-Identity`` — the fleet tier names each backend so which
+    process answered is observable through the router hop.
+    """
 
     daemon_threads = True
+    # socketserver's default backlog of 5 resets connections when the
+    # fleet router fans a burst in; admission control belongs to the
+    # ModelServer's queue (429), not the kernel's SYN queue
+    request_queue_size = 128
 
-    def __init__(self, model_server: ModelServer, address: tuple):
+    def __init__(self, model_server: ModelServer, address: tuple,
+                 identity: str | None = None):
         self.model_server = model_server
+        self.identity = identity
         super().__init__(address, _Handler)
 
 
 def start_http_server(model_server: ModelServer, host: str = "0.0.0.0",
-                      port: int = 8000,
-                      background: bool = True) -> ServeHTTPServer:
+                      port: int = 8000, background: bool = True,
+                      identity: str | None = None) -> ServeHTTPServer:
     """Bind and start serving. ``background=True`` runs ``serve_forever``
     on a daemon thread and returns the bound server (``.server_address``
     has the ephemeral port when 0 was requested); shut down with
     ``httpd.shutdown(); httpd.server_close()``."""
-    httpd = ServeHTTPServer(model_server, (host, port))
+    httpd = ServeHTTPServer(model_server, (host, port), identity=identity)
     if background:
         t = threading.Thread(target=httpd.serve_forever,
                              name="ServeHTTP", daemon=True)

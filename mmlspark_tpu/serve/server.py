@@ -403,7 +403,8 @@ class ModelServer:
             # and holding the server-wide lock across those joins would
             # stall every concurrent submit/snapshot (CC102)
             entry.batcher.close(drain=False)
-            raise ServerClosed("server is closed")
+            raise ServerClosed("server is closed",
+                               retry_after_s=self.config.retry_after_s)
         if old is not None:
             if canary is not None:
                 canary.batcher.close(drain=True)

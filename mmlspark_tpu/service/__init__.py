@@ -1,0 +1,25 @@
+"""Shared process-supervision machinery.
+
+The supervision core that ``train/service.py`` (PR 11) and the serve
+fleet tier (``serve/fleet/supervisor.py``) both run on: atomic beacon
+I/O, the decisions journal (disk always, obs mirror when the tracer is
+on), the supervised-child wrapper with its output pump, and the
+SIGTERM-grace-kill teardown helpers. Policy types stay with their
+domains — ``RecoveryPolicy`` lives in ``train/service.py`` (the fleet
+supervisor imports it), ``ScalePolicy`` in ``serve/fleet/scale.py`` —
+this package is only the actuator plumbing they share.
+"""
+
+from mmlspark_tpu.service.core import (
+    SupervisedProcess, SupervisorJournal, atomic_write_json, join_pumps,
+    read_beacon, terminate_processes,
+)
+
+__all__ = [
+    "SupervisedProcess",
+    "SupervisorJournal",
+    "atomic_write_json",
+    "join_pumps",
+    "read_beacon",
+    "terminate_processes",
+]

@@ -1,0 +1,168 @@
+"""Supervisor core: journal, beacon I/O, child wrapper, teardown.
+
+Factored out of ``train/service.py`` so the serve fleet supervisor
+(``serve/fleet/supervisor.py``) shares ONE implementation of the
+mechanics every out-of-process supervisor needs:
+
+* :func:`atomic_write_json` / :func:`read_beacon` — the beacon
+  transport. Workers publish liveness as one JSON file per rank,
+  written atomically (tmp + ``os.replace``); the supervisor reads it
+  back generation-checked, so a stale file from a previous generation
+  never masquerades as the current worker.
+* :class:`SupervisorJournal` — every supervisor decision is an event:
+  appended to an on-disk ``decisions.jsonl`` ALWAYS (supervision
+  forensics must not depend on telemetry being on), mirrored as an obs
+  ``<prefix>/<kind>`` event plus ``<counter_prefix><kind>s`` counters
+  when the tracer is enabled.
+* :class:`SupervisedProcess` — one child process plus its stdout pump
+  thread (tail-bounded, prefixed relay to the supervisor's stdout) and
+  the progress/exit bookkeeping the watch loops condition on.
+* :func:`terminate_processes` / :func:`join_pumps` — SIGTERM, a shared
+  grace deadline, then kill; and the pump joins that keep teardown
+  thread-clean (CC104).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal as _signal
+import subprocess
+import sys
+import threading
+import time
+
+from mmlspark_tpu.core.logging_utils import get_logger
+from mmlspark_tpu.obs import runtime as _obs_rt
+from mmlspark_tpu.obs.metrics import registry as _obs_registry
+from mmlspark_tpu.obs.spans import event as _obs_event
+
+_log = get_logger(__name__)
+
+
+def atomic_write_json(path: str, payload: dict) -> None:
+    """Write ``payload`` as JSON with no torn-read window: stage to a
+    pid-suffixed temp file, then ``os.replace`` (atomic on POSIX)."""
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+
+
+def read_beacon(service_dir: str, rank: int,
+                generation: int) -> dict | None:
+    """``beacon_<rank>.json`` if readable AND stamped with this
+    generation — a stale file from the previous generation is not this
+    worker. Torn/absent reads are None, never an exception (the watch
+    loop polls this on every tick)."""
+    path = os.path.join(service_dir, f"beacon_{rank}.json")
+    try:
+        with open(path, encoding="utf-8") as f:
+            b = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return b if b.get("generation") == generation else None
+
+
+class SupervisorJournal:
+    """The decision journal: disk always, obs mirror when enabled.
+
+    ``record(kind, payload)`` appends ``{"ts", "kind", **payload}`` to
+    ``path`` (jsonl), logs it, and — tracer on — emits an obs event
+    ``<event_prefix>/<kind>`` (category ``cat``) plus bumps the counter
+    ``<counter_prefix><kind>s`` when ``kind`` is in ``counter_kinds``.
+    """
+
+    def __init__(self, path: str, *, event_prefix: str, cat: str,
+                 counter_prefix: str,
+                 counter_kinds: tuple[str, ...] = (),
+                 log_label: str | None = None):
+        self.path = path
+        self.event_prefix = event_prefix
+        self.cat = cat
+        self.counter_prefix = counter_prefix
+        self.counter_kinds = tuple(counter_kinds)
+        self.log_label = log_label or event_prefix
+
+    def record(self, kind: str, payload: dict) -> None:
+        entry = {"ts": time.time(), "kind": kind, **payload}
+        with open(self.path, "a", encoding="utf-8") as f:
+            f.write(json.dumps(entry) + "\n")
+        _log.info("%s: %s %s", self.log_label, kind, payload)
+        if _obs_rt._enabled:
+            _obs_event(f"{self.event_prefix}/{kind}", self.cat,
+                       {k: str(v) for k, v in payload.items()})
+            if kind in self.counter_kinds:
+                _obs_registry().counter(
+                    f"{self.counter_prefix}{kind}s").add()
+
+
+class SupervisedProcess:
+    """One supervised child process + its output pump and progress
+    tracking.
+
+    The pump thread relays the child's combined stdout/stderr to the
+    supervisor's stdout line-prefixed (``[<log_prefix> <rank>] ...``)
+    and keeps a bounded tail for post-mortems. Progress bookkeeping
+    (``last_progress``/``progress_ts``) is what hang deadlines measure
+    against; ``counter_last`` is the per-(name, labels) delta baseline
+    for beacon counter re-aggregation (a value that went BACKWARD means
+    the worker restarted and its registry reset).
+    """
+
+    TAIL_LINES = 40
+
+    def __init__(self, rank: int, proc: subprocess.Popen, *,
+                 log_prefix: str = "worker",
+                 thread_name: str | None = None):
+        self.rank = rank
+        self.proc = proc
+        self.tail: list[str] = []
+        self._log_prefix = log_prefix
+        self.thread = threading.Thread(
+            target=self._pump,
+            name=thread_name or f"SupervisedPump[{rank}]", daemon=True)
+        self.thread.start()
+        self.last_progress = -1
+        self.progress_ts = time.monotonic()  # doubles as the no-beacon
+        #                                      deadline baseline
+        self.straggler_hits = 0
+        self.exit_recorded = False
+        self.counter_last: dict[tuple, float] = {}
+
+    def _pump(self) -> None:
+        for line in self.proc.stdout:
+            self.tail.append(line)
+            if len(self.tail) > self.TAIL_LINES:
+                del self.tail[0]
+            sys.stdout.write(f"[{self._log_prefix} {self.rank}] {line}")
+            sys.stdout.flush()
+
+
+def terminate_processes(workers: list, grace_s: float,
+                        poll_s: float = 0.05) -> None:
+    """SIGTERM every live child, give them ONE shared grace deadline to
+    drain, then kill stragglers. ``workers`` are
+    :class:`SupervisedProcess`; every child is reaped (``wait``) before
+    return."""
+    deadline = time.monotonic() + grace_s
+    for w in workers:
+        if w.proc.poll() is None:
+            try:
+                w.proc.send_signal(_signal.SIGTERM)
+            except OSError:  # pragma: no cover - already gone
+                pass
+    for w in workers:
+        while w.proc.poll() is None and time.monotonic() < deadline:
+            time.sleep(poll_s)
+        if w.proc.poll() is None:
+            w.proc.kill()
+        w.proc.wait()
+
+
+def join_pumps(workers: list, timeout_s: float = 2.0) -> None:
+    """Join the output pump threads (no stray threads after teardown —
+    the pump ends when the child's stdout hits EOF)."""
+    for w in workers:
+        if w.thread.is_alive():
+            w.thread.join(timeout=timeout_s)

@@ -48,12 +48,9 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
-import json
 import os
 import shutil
-import signal as _signal
 import subprocess
-import sys
 import threading
 import time
 from typing import Any, Callable, Iterator, Sequence
@@ -67,7 +64,10 @@ from mmlspark_tpu.obs import flight as _obs_flight
 from mmlspark_tpu.obs import runtime as _obs_rt
 from mmlspark_tpu.obs.metrics import Counter as _ObsCounter
 from mmlspark_tpu.obs.metrics import registry as _obs_registry
-from mmlspark_tpu.obs.spans import event as _obs_event
+from mmlspark_tpu.service.core import (
+    SupervisedProcess, SupervisorJournal, atomic_write_json, join_pumps,
+    read_beacon, terminate_processes,
+)
 
 _log = get_logger(__name__)
 
@@ -90,11 +90,10 @@ WATCH_THREAD = "ServiceWatch"
 BEACON_THREAD = "ServiceBeacon"
 
 
-def _atomic_write_json(path: str, payload: dict) -> None:
-    tmp = f"{path}.tmp-{os.getpid()}"
-    with open(tmp, "w", encoding="utf-8") as f:
-        json.dump(payload, f)
-    os.replace(tmp, path)
+# the beacon transport lives in the shared supervisor core
+# (mmlspark_tpu/service/core.py) — kept under the historical name for
+# in-repo callers
+_atomic_write_json = atomic_write_json
 
 
 # ---------------------------------------------------------------------------
@@ -537,35 +536,14 @@ class ServiceReport:
                 if self.generations else None)
 
 
-class _Worker:
+class _Worker(SupervisedProcess):
     """One supervised worker process + its output pump and progress
-    tracking."""
+    tracking (the shared :class:`SupervisedProcess` core under the
+    train service's pump naming)."""
 
     def __init__(self, rank: int, proc: subprocess.Popen):
-        self.rank = rank
-        self.proc = proc
-        self.tail: list[str] = []
-        self.thread = threading.Thread(
-            target=self._pump, name=f"{WATCH_THREAD}[pump{rank}]",
-            daemon=True)
-        self.thread.start()
-        self.last_progress = -1
-        self.progress_ts = time.monotonic()  # doubles as the no-beacon
-        #                                      deadline baseline
-        self.straggler_hits = 0
-        self.exit_recorded = False
-        # last-seen beacon counter values, keyed (name, labels): the
-        # fleet-aggregation delta baseline (a value that went BACKWARD
-        # means the worker restarted and its registry reset)
-        self.counter_last: dict[tuple, float] = {}
-
-    def _pump(self) -> None:
-        for line in self.proc.stdout:
-            self.tail.append(line)
-            if len(self.tail) > 40:
-                del self.tail[0]
-            sys.stdout.write(f"[service worker {self.rank}] {line}")
-            sys.stdout.flush()
+        super().__init__(rank, proc, log_prefix="service worker",
+                         thread_name=f"{WATCH_THREAD}[pump{rank}]")
 
 
 class TrainSupervisor:
@@ -579,26 +557,23 @@ class TrainSupervisor:
         os.makedirs(cfg.service_dir, exist_ok=True)
         self._decisions_path = os.path.join(cfg.service_dir,
                                             "decisions.jsonl")
+        # every supervisor decision is an event: appended to the on-disk
+        # decisions.jsonl ALWAYS (supervision forensics must not depend
+        # on telemetry being on), mirrored as an obs service/<kind>
+        # event + train.service.* counters when the tracer is enabled —
+        # the shared SupervisorJournal discipline (service/core.py)
+        self._journal = SupervisorJournal(
+            self._decisions_path, event_prefix="service", cat="service",
+            counter_prefix="train.service.",
+            counter_kinds=("restart", "rescale", "evict", "worker_exit",
+                           "hang"),
+            log_label="train service")
         self._straggler_total = 0  # global verdict windows this generation
 
     # -- observability of the supervisor itself --
 
     def _record(self, kind: str, payload: dict) -> None:
-        """Every supervisor decision is an event: appended to the on-disk
-        ``decisions.jsonl`` ALWAYS (supervision forensics must not depend
-        on telemetry being on), mirrored as an obs ``service/<kind>``
-        event + ``train.service.*`` counters when the tracer is
-        enabled."""
-        entry = {"ts": time.time(), "kind": kind, **payload}
-        with open(self._decisions_path, "a", encoding="utf-8") as f:
-            f.write(json.dumps(entry) + "\n")
-        _log.info("train service: %s %s", kind, payload)
-        if _obs_rt._enabled:
-            _obs_event(f"service/{kind}", "service",
-                       {k: str(v) for k, v in payload.items()})
-            if kind in ("restart", "rescale", "evict", "worker_exit",
-                        "hang"):
-                _obs_registry().counter(f"train.service.{kind}s").add()
+        self._journal.record(kind, payload)
 
     def _gauges(self, generation: int, topo: Topology) -> None:
         if _obs_rt._enabled:
@@ -678,19 +653,7 @@ class TrainSupervisor:
         return workers
 
     def _terminate(self, workers: list[_Worker]) -> None:
-        deadline = time.monotonic() + self.cfg.grace_seconds
-        for w in workers:
-            if w.proc.poll() is None:
-                try:
-                    w.proc.send_signal(_signal.SIGTERM)
-                except OSError:  # pragma: no cover - already gone
-                    pass
-        for w in workers:
-            while w.proc.poll() is None and time.monotonic() < deadline:
-                time.sleep(0.05)
-            if w.proc.poll() is None:
-                w.proc.kill()
-            w.proc.wait()
+        terminate_processes(workers, self.cfg.grace_seconds)
         self._forget(workers)
 
     def _forget(self, workers: list[_Worker]) -> None:
@@ -703,20 +666,14 @@ class TrainSupervisor:
         for w in workers:
             if rec is not None:
                 rec.forget(f"service/worker{w.rank}")
-            if w.thread.is_alive():
-                w.thread.join(timeout=2.0)
+        join_pumps(workers)
 
     # -- sensor reads --
 
     def _read_beacon(self, generation: int, rank: int) -> dict | None:
-        path = os.path.join(self.cfg.service_dir, f"beacon_{rank}.json")
-        try:
-            with open(path, encoding="utf-8") as f:
-                b = json.load(f)
-        except (OSError, ValueError):
-            return None
-        # a stale file from the previous generation is not this worker
-        return b if b.get("generation") == generation else None
+        # generation-checked (a stale file from the previous generation
+        # is not this worker) — shared with the fleet supervisor
+        return read_beacon(self.cfg.service_dir, rank, generation)
 
     def _poll_sensors(self, generation: int,
                       workers: list[_Worker]) -> Signal | None:

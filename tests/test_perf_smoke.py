@@ -16,8 +16,8 @@ from perf_smoke import (  # noqa: E402
     check_compile_cache, check_concurrency_clean, check_fleet_obs,
     check_fused_crossings, check_flight_recorder, check_obs_overhead,
     check_obs_request_tracing, check_serve_batching,
-    check_serve_generate, check_serve_lifecycle, check_serve_lowprec,
-    check_serve_sharded,
+    check_serve_fleet, check_serve_generate, check_serve_lifecycle,
+    check_serve_lowprec, check_serve_sharded,
     check_spmd_clean, check_train_device_preprocess, check_train_elastic,
     check_train_prefetch,
 )
@@ -110,6 +110,27 @@ def test_fleet_obs_merges_bit_equal_and_renders_aligned_timeline():
     for gauge, series in result["burn_gauge_history"].items():
         assert series and all(n >= 3 for n in series.values()), (
             f"{gauge}: {series}")
+
+
+def test_serve_fleet_survives_kill_and_scales_bit_identical():
+    """Fleet serving tier (round 19): two supervised serve backends
+    behind the router, warmed from the compile cache the single-process
+    reference published; kill -9 one mid-burst — zero dropped requests
+    and every router answer bit-identical to single-process serving; an
+    induced fast-burn scales a third backend up whose beacon proves
+    zero fresh XLA compiles (pure cache warm); restart + scale_up land
+    in decisions.jsonl; the router's counters merge bit-equal into the
+    fleet view; no router/supervisor/exporter threads leak."""
+    result = check_serve_fleet()
+    assert result["burst_errors"] == 0
+    assert result["bit_identical"] is True
+    assert result["scaled_backend_cache"]["compiles"] == 0
+    assert result["scaled_backend_cache"]["hits"] >= 1
+    assert "restart" in result["journal_kinds"]
+    assert "scale_up" in result["journal_kinds"]
+    assert result["scale_ups"] >= 1
+    assert result["router_counters"]["reroutes"] >= 1
+    assert result["fleet_processes"] >= 2
 
 
 def test_flight_recorder_dumps_on_crash_and_hang():

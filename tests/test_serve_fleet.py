@@ -1,0 +1,795 @@
+"""Serve fleet tier (serve/fleet/): pool selection + holds + drains,
+router failover (predict resend, generate prefix-skip replay, the
+seeded backend_* fault points), pure ScalePolicy decisions, supervisor
+restart/drain/scale mechanics on fake beacon workers, the Retry-After
+sleep floor in core/retry, and the fleet-telemetry merge across the
+router hop. The full JAX end-to-end (kill -9 + scale-up under induced
+burn, bit-identical answers, compile-cache-warm spawn) is the
+``check_serve_fleet`` tier-1 gate in tools/perf_smoke.py."""
+
+import http.client
+import json
+import os
+import signal
+import socket
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mmlspark_tpu.core.retry import RetryPolicy, call_with_retry
+from mmlspark_tpu.serve import faults as serve_faults
+from mmlspark_tpu.serve.errors import Overloaded
+from mmlspark_tpu.serve.faults import FaultPlan, FaultSpec
+from mmlspark_tpu.serve.fleet import (
+    BackendPool, FleetConfig, FleetLedger, FleetRouter, Hold,
+    NoBackendAvailable, ScaleDown, ScalePolicy, ScaleSignal, ScaleUp,
+    ServeSupervisor, signal_from_history, sustained_s,
+)
+from mmlspark_tpu.obs.timeseries import MetricHistory
+from mmlspark_tpu.serve.fleet.scale import BURN_SERIES, OCCUPANCY_SERIES
+from mmlspark_tpu.train.service import RecoveryPolicy
+
+
+# ---------------------------------------------------------------------------
+# stub backends: the serve HTTP wire protocol without a ModelServer
+# ---------------------------------------------------------------------------
+
+
+class _StubHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def do_POST(self):  # noqa: N802 - http.server contract
+        stub = self.server.stub
+        body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        stub.requests.append((self.path, body,
+                              self.headers.get("X-Fleet-Request-Id")))
+        if self.path.endswith(":predict"):
+            self._predict(stub)
+        elif self.path.endswith(":generate"):
+            self._generate(stub)
+        else:
+            self._json(404, {"error": "NotFound"})
+
+    def _json(self, status, payload, headers=None):
+        data = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _die(self):
+        # vanish without a status line: the client sees a torn
+        # connection, exactly like a kill -9 with bytes in flight.
+        # shutdown(), not close(): rfile/wfile hold io-refs on the
+        # socket, so close() alone never sends the FIN.
+        self.close_connection = True
+        try:
+            self.connection.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+
+    def _predict(self, stub):
+        if stub.mode == "die":
+            self._die()
+            return
+        if stub.mode == "reject":
+            self._json(429, {"error": "Overloaded"},
+                       headers={"Retry-After": str(stub.retry_after)})
+            return
+        stub.served += 1
+        self._json(200, {"model": "m", "port": stub.port,
+                         "rows": [{"scores": [1.0, 2.0]}]})
+
+    def _chunk(self, obj):
+        data = json.dumps(obj).encode() + b"\n"
+        self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+        self.wfile.flush()
+
+    def _generate(self, stub):
+        if stub.mode == "reject":
+            self._json(429, {"error": "Overloaded"},
+                       headers={"Retry-After": str(stub.retry_after)})
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        stub.streaming += 1
+        try:
+            for i in range(stub.tokens):
+                if stub.tear_after is not None and i >= stub.tear_after:
+                    self._die()  # mid-stream kill
+                    return
+                self._chunk({"token": f"tok{i}", "index": i})
+                if stub.token_delay:
+                    time.sleep(stub.token_delay)
+            self._chunk({"done": True, "model": "m",
+                         "tokens": stub.tokens, "cancelled": False})
+            self.wfile.write(b"0\r\n\r\n")
+            stub.streams_finished += 1
+        finally:
+            stub.streaming -= 1
+
+
+class _Stub:
+    """One fake backend process (in-process HTTP server). Deterministic
+    token stream — every stub emits the same sequence, the stand-in for
+    deterministic decode that makes prefix-skip replay exact."""
+
+    def __init__(self, mode="ok", tokens=6, token_delay=0.0,
+                 tear_after=None, retry_after=0.2):
+        self.mode = mode
+        self.tokens = tokens
+        self.token_delay = token_delay
+        self.tear_after = tear_after
+        self.retry_after = retry_after
+        self.requests = []
+        self.served = 0
+        self.streaming = 0
+        self.streams_finished = 0
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), _StubHandler)
+        self._httpd.daemon_threads = True
+        self._httpd.stub = self
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name=f"Stub[{self.port}]",
+            daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+
+@pytest.fixture
+def fleet_pair():
+    """Two healthy stubs registered in a pool behind a started router."""
+    from mmlspark_tpu.obs.metrics import registry
+    registry().reset()  # router counters live in the global registry
+    stubs = [_Stub(), _Stub()]
+    pool = BackendPool()
+    for bid, s in enumerate(stubs):
+        pool.add(bid, "127.0.0.1", s.port)
+    router = FleetRouter(pool, wait_budget_s=2.0,
+                         default_retry_after_s=0.2).start()
+    yield stubs, pool, router
+    router.close()
+    for s in stubs:
+        s.close()
+    serve_faults.clear()
+
+
+def _predict(router, body=b'{"rows": [{"x": 1}]}', timeout=10):
+    host, port = router.address
+    req = urllib.request.Request(
+        f"http://{host}:{port}/v1/models/m:predict", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, dict(r.headers), json.loads(r.read())
+
+
+def _generate(router, timeout=10):
+    """Stream :generate through the router; returns (headers, lines)."""
+    host, port = router.address
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("POST", "/v1/models/m:generate",
+                     body=b'{"prompt": "p"}',
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        lines = []
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            lines.append(json.loads(line))
+            if "done" in lines[-1] or "error" in lines[-1]:
+                break
+        return dict(resp.getheaders()), lines
+    finally:
+        conn.close()
+
+
+# ---------------------------------------------------------------------------
+# BackendPool
+# ---------------------------------------------------------------------------
+
+
+class TestBackendPool:
+    def test_pick_least_loaded_ties_to_lowest_bid(self):
+        pool = BackendPool()
+        for bid in (0, 1, 2):
+            pool.add(bid, "h", 9000 + bid)
+        assert pool.pick() == 0
+        with pool.lease(0):
+            assert pool.pick() == 1
+            with pool.stream_lease(1):
+                assert pool.pick() == 2
+        assert pool.pick() == 0
+
+    def test_pick_skips_down_draining_excluded(self):
+        pool = BackendPool()
+        for bid in (0, 1, 2):
+            pool.add(bid, "h", 9000 + bid)
+        assert pool.mark_down(0) is True
+        assert pool.mark_down(0) is False  # reported once
+        pool.drain(1)
+        assert pool.pick() == 2
+        with pytest.raises(NoBackendAvailable):
+            pool.pick(exclude=(2,))
+
+    def test_all_held_raises_with_earliest_expiry(self):
+        pool = BackendPool()
+        pool.add(0, "h", 9000)
+        pool.add(1, "h", 9001)
+        pool.hold(0, 5.0)
+        pool.hold(1, 0.2)
+        with pytest.raises(NoBackendAvailable) as exc:
+            pool.pick()
+        assert exc.value.retry_after_s == pytest.approx(0.2, abs=0.1)
+        time.sleep(0.25)
+        assert pool.pick() == 1  # the short hold expired
+
+    def test_readd_after_restart_revives_but_never_unrains(self):
+        pool = BackendPool()
+        pool.add(0, "h", 9000, generation=0)
+        pool.mark_down(0)
+        pool.add(0, "h", 9100, generation=1)  # restarted: new port/gen
+        assert pool.pick() == 0
+        assert pool.address(0) == ("h", 9100)
+        pool.drain(0)
+        pool.add(0, "h", 9100, generation=1)  # a beacon mid-drain
+        with pytest.raises(NoBackendAvailable):
+            pool.pick()  # still draining: never resurrected
+
+    def test_idle_is_the_zero_drop_stop_point(self):
+        pool = BackendPool()
+        pool.add(0, "h", 9000)
+        lease = pool.stream_lease(0)
+        with lease:
+            pool.drain(0)
+            assert not pool.idle(0)  # active stream holds it
+        assert pool.idle(0)
+        assert not pool.idle(99)  # unregistered is not "safe to stop"
+
+
+# ---------------------------------------------------------------------------
+# ScalePolicy (pure) + signal condensation
+# ---------------------------------------------------------------------------
+
+
+class TestScalePolicy:
+    POLICY = ScalePolicy(fast_burn=14.0, burn_sustain_s=1.0,
+                         idle_occupancy=0.02, idle_sustain_s=30.0,
+                         min_backends=1, max_backends=4, cooldown_s=5.0)
+
+    def test_sustained_s_measures_the_trailing_run(self):
+        pred = lambda v: v >= 14.0  # noqa: E731
+        samples = [(0.0, 20.0), (1.0, 1.0), (2.0, 15.0), (3.0, 18.0)]
+        assert sustained_s(samples, 4.0, pred) == pytest.approx(2.0)
+        assert sustained_s([(0.0, 1.0)], 4.0, pred) == 0.0
+        assert sustained_s([], 4.0, pred) == 0.0
+
+    def test_sustained_burn_scales_up_until_max(self):
+        act = self.POLICY.decide(
+            ScaleSignal(backends=2, burn=20.0, burn_high_s=1.5),
+            FleetLedger())
+        assert isinstance(act, ScaleUp)
+        act = self.POLICY.decide(
+            ScaleSignal(backends=4, burn=20.0, burn_high_s=1.5),
+            FleetLedger())
+        assert isinstance(act, Hold) and "max_backends" in act.reason
+
+    def test_momentary_burn_holds(self):
+        act = self.POLICY.decide(
+            ScaleSignal(backends=2, burn=20.0, burn_high_s=0.3),
+            FleetLedger())
+        assert isinstance(act, Hold)
+
+    def test_sustained_idle_scales_down_until_min(self):
+        act = self.POLICY.decide(
+            ScaleSignal(backends=2, occupancy=0.0, idle_s=31.0),
+            FleetLedger())
+        assert isinstance(act, ScaleDown)
+        act = self.POLICY.decide(
+            ScaleSignal(backends=1, occupancy=0.0, idle_s=31.0),
+            FleetLedger())
+        assert isinstance(act, Hold) and "min_backends" in act.reason
+
+    def test_cooldown_gates_everything(self):
+        act = self.POLICY.decide(
+            ScaleSignal(backends=2, burn=99.0, burn_high_s=9.0),
+            FleetLedger(since_scale_s=1.0))
+        assert isinstance(act, Hold) and "cooldown" in act.reason
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            ScalePolicy(min_backends=0)
+        with pytest.raises(ValueError):
+            ScalePolicy(min_backends=3, max_backends=2)
+
+    def test_signal_from_history_condenses_both_series(self):
+        h = MetricHistory()
+        for t in range(5):
+            h.append(100.0 + t, BURN_SERIES,
+                     20.0 if t >= 2 else 1.0)
+            h.append(100.0 + t, OCCUPANCY_SERIES, 0.01)
+        sig = signal_from_history(h, now=105.0, backends=2,
+                                  policy=self.POLICY, window_s=60.0)
+        assert sig.burn == 20.0
+        assert sig.burn_high_s == pytest.approx(3.0)
+        assert sig.occupancy == 0.01
+        assert sig.idle_s == pytest.approx(5.0)
+
+
+# ---------------------------------------------------------------------------
+# satellite: client retry backoff honors Retry-After as a sleep FLOOR
+# ---------------------------------------------------------------------------
+
+
+class TestRetryAfterFloor:
+    def _run(self, policy, exc):
+        sleeps = []
+        calls = [0]
+
+        def fn():
+            calls[0] += 1
+            if calls[0] == 1:
+                raise exc
+            return "ok"
+
+        out = call_with_retry(fn, policy, sleep=sleeps.append)
+        assert out == "ok"
+        return sleeps
+
+    def test_hint_longer_than_backoff_floors_the_sleep(self):
+        sleeps = self._run(
+            RetryPolicy(max_attempts=3, base_delay_s=0.01, jitter=0.0,
+                        retry_on=(Overloaded,)),
+            Overloaded("m", queued=1, max_queue=1, retry_after_s=5.0))
+        assert sleeps == [5.0]
+
+    def test_hint_shorter_than_backoff_keeps_the_backoff(self):
+        # the hint is a FLOOR, never a cap: a server begging "come back
+        # in 1ms" must not collapse the client's own pacing
+        sleeps = self._run(
+            RetryPolicy(max_attempts=3, base_delay_s=1.0, jitter=0.0,
+                        retry_on=(Overloaded,)),
+            Overloaded("m", queued=1, max_queue=1,
+                       retry_after_s=0.001))
+        assert sleeps == [1.0]
+
+    def test_unstamped_error_keeps_pure_backoff(self):
+        sleeps = self._run(
+            RetryPolicy(max_attempts=3, base_delay_s=0.25, jitter=0.0,
+                        retry_on=(Overloaded,)),
+            Overloaded("m", queued=1, max_queue=1))
+        assert sleeps == [0.25]
+
+
+# ---------------------------------------------------------------------------
+# FleetRouter: predict failover
+# ---------------------------------------------------------------------------
+
+
+class TestRouterPredict:
+    def test_relay_carries_backend_identity(self, fleet_pair):
+        stubs, _pool, router = fleet_pair
+        status, headers, body = _predict(router)
+        assert status == 200
+        bid = int(headers["X-Fleet-Backend"])
+        assert body["port"] == stubs[bid].port
+        # the proxied request carried the span-link id to the backend
+        assert stubs[bid].requests[-1][2] is not None
+
+    def test_dead_backend_reroutes_never_drops(self, fleet_pair):
+        stubs, pool, router = fleet_pair
+        stubs[0].mode = "die"
+        for _ in range(4):
+            status, _h, body = _predict(router)
+            assert status == 200
+            assert body["port"] == stubs[1].port
+        snap = {s["bid"]: s["state"] for s in pool.snapshot()}
+        assert snap[0] == "down"
+        assert router.counters()["serve.fleet.router.reroutes"] >= 1
+
+    def test_backpressure_hold_moves_traffic_over(self, fleet_pair):
+        stubs, pool, router = fleet_pair
+        stubs[0].mode = "reject"
+        stubs[1].mode = "reject"
+        # both reject with Retry-After=0.2: the router holds each, then
+        # waits out the earliest hold (within its budget) and retries —
+        # flip the stubs healthy meanwhile so the wait pays off
+        def _recover():
+            for s in stubs:
+                s.mode = "ok"
+        t = threading.Timer(0.15, _recover)
+        t.start()
+        try:
+            status, _headers, _body = _predict(router)
+        finally:
+            t.join()
+        assert status == 200
+        assert router.counters()["serve.fleet.router.held"] >= 2
+
+    def test_no_live_backends_is_typed_503_with_retry_after(self):
+        pool = BackendPool()
+        # wait_budget_s bounds how long the router stalls hoping for a
+        # revival beacon before conceding the typed 503
+        router = FleetRouter(pool, wait_budget_s=0.2,
+                             default_retry_after_s=3.0).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _predict(router)
+            assert exc.value.code == 503
+            assert exc.value.headers["Retry-After"] == "3"
+            assert json.loads(exc.value.read())["error"] == \
+                "NoBackendAvailable"
+        finally:
+            router.close()
+
+    def test_seeded_fault_points_drive_failover(self, fleet_pair):
+        stubs, _pool, router = fleet_pair
+        # backend_down raises before the slow seam is reached, so the
+        # slow spec's first hit is already on the rerouted attempt
+        plan = FaultPlan([
+            FaultSpec(point="backend_down", times=1),
+            FaultSpec(point="backend_slow", delay_s=0.2, times=1),
+        ], seed=7)
+        serve_faults.install(plan)
+        t0 = time.monotonic()
+        status, _h, _b = _predict(router)
+        elapsed = time.monotonic() - t0
+        assert status == 200
+        assert plan.counts() == {"backend_down": 1, "backend_slow": 1}
+        assert elapsed >= 0.2  # the slow seam actually slept
+        assert router.counters()["serve.fleet.router.reroutes"] == 1
+
+    def test_torn_response_fault_resends_elsewhere(self, fleet_pair):
+        stubs, pool, router = fleet_pair
+        serve_faults.install(FaultPlan([
+            FaultSpec(point="backend_torn_response", times=1)]))
+        status, _h, _b = _predict(router)
+        assert status == 200
+        assert sum(s.served for s in stubs) == 2  # one wasted + resend
+        assert router.counters()["serve.fleet.router.reroutes"] == 1
+
+
+# ---------------------------------------------------------------------------
+# FleetRouter: generate streams (affinity, prefix-skip replay)
+# ---------------------------------------------------------------------------
+
+
+class TestRouterGenerate:
+    def test_stream_relays_tokens_and_done(self, fleet_pair):
+        stubs, _pool, router = fleet_pair
+        headers, lines = _generate(router)
+        assert headers["Content-Type"] == "application/x-ndjson"
+        assert [ln["token"] for ln in lines[:-1]] == \
+            [f"tok{i}" for i in range(6)]
+        assert [ln["index"] for ln in lines[:-1]] == list(range(6))
+        assert lines[-1]["done"] is True
+
+    def test_torn_stream_replays_minus_delivered_prefix(self,
+                                                        fleet_pair):
+        stubs, pool, router = fleet_pair
+        # force the stream onto stub 0, which tears after 3 tokens;
+        # the replay leg on stub 1 must skip the delivered prefix:
+        # the client sees tok0..tok5 exactly once, indexes contiguous
+        stubs[0].tear_after = 3
+        stubs[1].tokens = 6
+        _headers, lines = _generate(router)
+        assert [ln.get("token") for ln in lines[:-1]] == \
+            [f"tok{i}" for i in range(6)]
+        assert [ln["index"] for ln in lines[:-1]] == list(range(6))
+        assert lines[-1]["done"] is True
+        assert router.counters()["serve.fleet.router.stream_replays"] \
+            == 1
+        assert {s["bid"]: s["state"] for s in pool.snapshot()}[0] == \
+            "down"
+
+    def test_drain_keeps_active_streams_routes_new_elsewhere(
+            self, fleet_pair):
+        """Satellite: backend affinity across scale-down. The draining
+        backend finishes its in-flight :generate stream (strict-prefix
+        — in fact complete); new streams route to the survivor; the
+        drained backend reaches the zero-drop idle point only after
+        its last stream ends."""
+        stubs, pool, router = fleet_pair
+        for s in stubs:
+            s.token_delay = 0.08
+        first = {}
+
+        def run_first():
+            first["result"] = _generate(router, timeout=30)
+
+        t = threading.Thread(target=run_first)
+        t.start()
+        # wait until the stream is in flight on some backend
+        deadline = time.monotonic() + 5
+        while not any(s.streaming for s in stubs):
+            assert time.monotonic() < deadline, "stream never started"
+            time.sleep(0.005)
+        active = 0 if stubs[0].streaming else 1
+        pool.drain(active)
+        assert not pool.idle(active)  # the stream lease pins it
+        # a NEW stream must route to the survivor
+        headers2, lines2 = _generate(router, timeout=30)
+        assert int(headers2["X-Fleet-Backend"]) == 1 - active
+        t.join(timeout=30)
+        headers1, lines1 = first["result"]
+        assert int(headers1["X-Fleet-Backend"]) == active
+        assert [ln["index"] for ln in lines1[:-1]] == list(range(6))
+        assert lines1[-1]["done"] is True
+        assert stubs[active].streams_finished == 1
+        assert pool.idle(active)  # now safe to stop the process
+
+
+# ---------------------------------------------------------------------------
+# ServeSupervisor on fake beacon workers (no JAX, fast)
+# ---------------------------------------------------------------------------
+
+
+_FAKE_WORKER = r"""
+import json, os, signal, threading, time
+stop = threading.Event()
+signal.signal(signal.SIGTERM, lambda *a: stop.set())
+d = os.environ["MMLSPARK_TPU_SERVICE_DIR"]
+rank = int(os.environ["MMLSPARK_TPU_SERVICE_RANK"])
+gen = int(os.environ["MMLSPARK_TPU_SERVICE_GENERATION"])
+path = os.path.join(d, "beacon_%d.json" % rank)
+time.sleep(float(os.environ.get("FAKE_START_DELAY", "0")))
+def write(status):
+    tmp = path + ".tmp%d" % os.getpid()
+    with open(tmp, "w") as f:
+        json.dump({"rank": rank, "generation": gen, "ts": time.time(),
+                   "status": status, "host": "127.0.0.1",
+                   "port": 40000 + 100 * gen + rank,
+                   "burn_short": float(os.environ.get("FAKE_BURN", "0")),
+                   "occupancy": float(os.environ.get("FAKE_OCC", "0.5"))},
+                  f)
+    os.replace(tmp, path)
+while not stop.wait(0.05):
+    write("running")
+write("draining")
+write("exited")
+"""
+
+
+def _fake_cfg(tmp_path, **kw):
+    kw.setdefault("cmd", (sys.executable, "-c", _FAKE_WORKER))
+    kw.setdefault("initial_backends", 2)
+    kw.setdefault("poll_s", 0.05)
+    kw.setdefault("grace_s", 5.0)
+    kw.setdefault("policy", RecoveryPolicy(
+        max_restarts=2,
+        restart_backoff=RetryPolicy(base_delay_s=0.05, max_delay_s=0.1,
+                                    jitter=0.0),
+        rescale_on_exhausted=False, preempt_exit_codes=()))
+    kw.setdefault("scale", ScalePolicy(idle_sustain_s=3600.0,
+                                       burn_sustain_s=3600.0))
+    kw.setdefault("worker_obs", False)
+    kw.setdefault("worker_fleet", False)
+    return FleetConfig(service_dir=str(tmp_path), **kw)
+
+
+def _wait(pred, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        assert time.monotonic() < deadline, f"timed out: {msg}"
+        time.sleep(0.02)
+
+
+def _kinds(tmp_path):
+    with open(os.path.join(str(tmp_path), "decisions.jsonl")) as f:
+        return [json.loads(line)["kind"] for line in f]
+
+
+class TestServeSupervisor:
+    def test_beacons_register_backends_and_kill_restarts(self,
+                                                         tmp_path):
+        sup = ServeSupervisor(_fake_cfg(tmp_path))
+        try:
+            sup.start()
+            _wait(lambda: sup.pool.up_count() == 2, msg="fleet up")
+            ports = {s["bid"]: s["port"] for s in sup.pool.snapshot()}
+            assert ports == {0: 40000, 1: 40001}  # beacon-carried
+            victim = sup._backends[0]
+            os.kill(victim.proc.pid, signal.SIGKILL)
+            # the pool loses it, the policy respawns generation 1
+            _wait(lambda: any(s["bid"] == 0 and s["generation"] == 1
+                              and s["state"] == "up"
+                              for s in sup.pool.snapshot()),
+                  msg="restarted backend routable")
+            assert sup.pool.address(0) == ("127.0.0.1", 40100)
+            kinds = _kinds(tmp_path)
+            assert "backend_exit" in kinds and "restart" in kinds
+        finally:
+            sup.close()
+        assert "stop" in _kinds(tmp_path)
+        leaked = [t.name for t in threading.enumerate()
+                  if t.name.startswith("ServeFleetWatch")]
+        assert not leaked, leaked
+
+    def test_restart_budget_exhaustion_fails_the_backend(self,
+                                                         tmp_path):
+        sup = ServeSupervisor(_fake_cfg(
+            tmp_path, initial_backends=1,
+            policy=RecoveryPolicy(
+                max_restarts=0, rescale_on_exhausted=False,
+                preempt_exit_codes=())))
+        try:
+            sup.start()
+            _wait(lambda: sup.pool.up_count() == 1, msg="fleet up")
+            os.kill(sup._backends[0].proc.pid, signal.SIGKILL)
+            _wait(lambda: "fail" in _kinds(tmp_path), msg="fail entry")
+            _wait(lambda: sup.pool.up_count() == 0
+                  and not sup.pool.ids(), msg="pool forgot it")
+        finally:
+            sup.close()
+
+    def test_slow_boot_gets_start_grace_not_hang(self, tmp_path):
+        # cold backends pay jax import + compile before the FIRST
+        # beacon: the stall deadline must not shoot a booting worker
+        sup = ServeSupervisor(_fake_cfg(
+            tmp_path, extra_env={"FAKE_START_DELAY": "0.5"},
+            beacon_timeout_s=0.2, start_grace_s=10.0))
+        try:
+            sup.start()
+            _wait(lambda: sup.pool.up_count() == 2, msg="slow boot up")
+            assert "hang" not in _kinds(tmp_path)
+        finally:
+            sup.close()
+
+    def test_start_grace_expiry_hangs_all_without_crash(self, tmp_path):
+        # both backends miss the first-beacon deadline in the SAME read
+        # pass: each hang verdict mutates _backends mid-scan (regression
+        # for the dict-changed-size crash), and the stall deadline takes
+        # over normally once a restarted worker has beaconed
+        sup = ServeSupervisor(_fake_cfg(
+            tmp_path, extra_env={"FAKE_START_DELAY": "60"},
+            beacon_timeout_s=5.0, start_grace_s=0.2,
+            policy=RecoveryPolicy(
+                max_restarts=0, rescale_on_exhausted=False,
+                preempt_exit_codes=())))
+        try:
+            sup.start()
+            _wait(lambda: _kinds(tmp_path).count("hang") >= 2,
+                  msg="both boots declared hung")
+            _wait(lambda: _kinds(tmp_path).count("fail") >= 2,
+                  msg="budget-exhausted fails")
+            # the watch loop survived the double verdict
+            assert any(t.name.startswith("ServeFleetWatch")
+                       for t in threading.enumerate())
+        finally:
+            sup.close()
+
+    def test_manual_scale_down_drains_zero_drop(self, tmp_path):
+        sup = ServeSupervisor(_fake_cfg(tmp_path))
+        try:
+            sup.start()
+            _wait(lambda: sup.pool.up_count() == 2, msg="fleet up")
+            sup.scale_down()
+            # drain → (idle, no leases) → SIGTERM → clean exit, reaped
+            _wait(lambda: "drained" in _kinds(tmp_path), msg="drained")
+            assert sup.pool.up_count() == 1
+            kinds = _kinds(tmp_path)
+            assert "scale_down" in kinds
+            # the drained worker exited 0 (SIGTERM honored, no kill)
+            exits = [json.loads(line) for line in
+                     open(os.path.join(str(tmp_path),
+                                       "decisions.jsonl"))
+                     if json.loads(line)["kind"] == "backend_exit"]
+            assert exits and exits[-1]["code"] == 0
+            assert exits[-1]["draining"] is True
+        finally:
+            sup.close()
+
+    def test_sustained_burn_autoscales_up(self, tmp_path):
+        sup = ServeSupervisor(_fake_cfg(
+            tmp_path, initial_backends=1,
+            extra_env={"FAKE_BURN": "100.0"},
+            scale=ScalePolicy(fast_burn=14.0, burn_sustain_s=0.3,
+                              idle_sustain_s=3600.0, min_backends=1,
+                              max_backends=2, cooldown_s=60.0)))
+        try:
+            sup.start()
+            _wait(lambda: "scale_up" in _kinds(tmp_path),
+                  msg="burn-driven scale_up")
+            _wait(lambda: sup.pool.up_count() == 2,
+                  msg="scaled backend routable")
+            assert sup.status()["scale_ups"] == 1
+            # cooldown_s=60 pins it at 2 — no flapping past max
+            assert _kinds(tmp_path).count("scale_up") == 1
+        finally:
+            sup.close()
+
+    def test_sustained_idle_autoscales_down(self, tmp_path):
+        sup = ServeSupervisor(_fake_cfg(
+            tmp_path, initial_backends=2,
+            extra_env={"FAKE_OCC": "0.0"},
+            scale=ScalePolicy(fast_burn=1e9, burn_sustain_s=3600.0,
+                              idle_occupancy=0.02, idle_sustain_s=0.3,
+                              min_backends=1, max_backends=2,
+                              cooldown_s=60.0)))
+        try:
+            sup.start()
+            _wait(lambda: "drained" in _kinds(tmp_path),
+                  msg="idle-driven drain")
+            assert sup.pool.up_count() == 1  # min_backends floor
+            assert "scale_down" in _kinds(tmp_path)
+        finally:
+            sup.close()
+
+
+# ---------------------------------------------------------------------------
+# satellite: fleet telemetry across the router hop
+# ---------------------------------------------------------------------------
+
+
+class TestFleetTelemetryMerge:
+    def test_router_counters_merge_bit_equal(self, tmp_path):
+        """The router registers with the fleet plane like any serve
+        process: after a burst, the FleetCollector-merged
+        ``serve.fleet.router.*`` counters are bit-equal to the router's
+        live registry AND to the backend-observed request count — the
+        pin that the merged view survives the router hop intact."""
+        from mmlspark_tpu import obs
+        from mmlspark_tpu.obs import fleet as obs_fleet
+        from mmlspark_tpu.obs.metrics import (
+            Counter, format_series, registry,
+        )
+
+        stub = _Stub()
+        pool = BackendPool()
+        pool.add(0, "127.0.0.1", stub.port)
+        registry().reset()
+        obs_fleet.enable(str(tmp_path), interval_s=0.1)
+        router = FleetRouter(pool).start()
+        try:
+            for _ in range(5):
+                status, _h, _b = _predict(router)
+                assert status == 200
+            expected = {
+                format_series(m.name, m.labels): m.value
+                for m in registry().iter_metrics()
+                if isinstance(m, Counter)
+                and m.name.startswith("serve.fleet.router.")}
+            obs_fleet.disable()  # final exit snapshot
+            view = obs_fleet.FleetCollector(
+                str(tmp_path)).collect(include_ring=False)
+            merged = {
+                format_series(m.name, m.labels): m.value
+                for m in view.registry.iter_metrics()
+                if isinstance(m, Counter)
+                and m.name.startswith("serve.fleet.router.")}
+            assert merged == expected
+            assert merged["serve.fleet.router.requests"] == 5.0
+            assert merged["serve.fleet.router.relayed"] == 5.0
+            assert stub.served == 5  # across the hop: nothing lost
+        finally:
+            router.close()
+            stub.close()
+            obs_fleet.disable()
+            obs.disable()
+            obs.clear()
+            registry().reset()
+            leaked = [t.name for t in threading.enumerate()
+                      if t.name in ("FleetExporter",
+                                    "TimeSeriesSampler")]
+            assert not leaked, f"fleet threads leaked: {leaked}"

@@ -18,6 +18,13 @@ latency — plus :data:`LATENCY_GATED_P50` names median-latency keys
 too: a median is far less weather-prone than a tail, so a 1.25x drift
 there is a real regression, not a loaded box.
 
+The round-19 fleet-serving keys ride them too:
+``serve_fleet_rows_per_s_1b``/``serve_fleet_rows_per_s_2b``
+(router-hop throughput at 1 and 2 supervised backends) gate as
+throughput, and ``serve_fleet_kill_p99_ms`` — the client-observed tail
+across a kill -9 mid-burst, failover included — gates as tail latency;
+a drift there means the re-route path got slower, not the model.
+
 and exits **2 with a named-regressions report** when any gated metric
 falls outside its band (``tools/trace.py``'s typed exit-2 discipline).
 Metrics present only in the current line are reported as *new* (a

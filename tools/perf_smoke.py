@@ -2411,6 +2411,270 @@ def check_concurrency_clean(min_confirmed: int = 5,
     }
 
 
+def check_serve_fleet() -> dict:
+    """The fleet serving tier (serve/fleet/) end-to-end on REAL serve
+    workers: two supervised backend processes behind the router, each
+    warmed from the persistent compile cache the single-process
+    reference published. kill -9 one backend mid-burst — every request
+    in the burst still answers, bit-identical to the single-process
+    reference (the router re-routes torn requests, the supervisor
+    journals the exit and respawns generation 1). Then an induced
+    fast-burn (tiny-deadline volley against tightened SLO windows)
+    drives the autoscaler to spawn a THIRD backend, whose beacon proves
+    it warmed from the cache with zero fresh XLA compiles. The fleet
+    telemetry plane merges the router's counters bit-equal across the
+    process set, and teardown leaks no router/supervisor/exporter
+    threads."""
+    import shutil
+    import signal as _signal
+    import tempfile
+    import threading
+    import time
+    import urllib.error
+    import urllib.request
+
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.core import compile_cache as _cc
+    from mmlspark_tpu.data.table import DataTable
+    from mmlspark_tpu.models.jax_model import JaxModel
+    from mmlspark_tpu.obs import fleet as obs_fleet
+    from mmlspark_tpu.obs.metrics import Counter, format_series, registry
+    from mmlspark_tpu.serve import ModelServer, ServeConfig
+    from mmlspark_tpu.serve.fleet import (
+        BackendPool, FleetConfig, FleetRouter, ScalePolicy,
+        ServeSupervisor,
+    )
+    from mmlspark_tpu.serve.fleet.worker import (
+        MODEL_NAME, SELFTEST_BUCKETS, selftest_bundle, selftest_rows,
+    )
+    from mmlspark_tpu.service.core import read_beacon
+    from mmlspark_tpu.train.service import RecoveryPolicy
+
+    tmp = tempfile.mkdtemp(prefix="mmlspark-fleet-serve-")
+    service_dir = os.path.join(tmp, "fleet")
+    cache_dir = os.path.join(tmp, "cache")
+    obs_dir = os.path.join(tmp, "obs")
+    rows = selftest_rows(8)
+
+    # -- 1. single-process reference: the same seeded model served in
+    #       process. Publishes every bucket program into the cache all
+    #       three backends must warm from, and fixes the answer every
+    #       router response is compared against (exact — the JSON float
+    #       round trip is lossless for float32-derived doubles) --
+    _cc.reset()
+    ref_server = ModelServer(ServeConfig(
+        buckets=SELFTEST_BUCKETS, deadline_ms=None,
+        compile_cache=cache_dir))
+    try:
+        jm = JaxModel(model=selftest_bundle(), input_col="image",
+                      output_col="scores")
+        ref_server.add_model(MODEL_NAME, jm,
+                             example=DataTable({"image": [rows[0]]}))
+        out = ref_server.submit(
+            MODEL_NAME,
+            DataTable({"image": list(rows)})).result(timeout=300)
+        ref_scores = [[float(v) for v in r] for r in out["scores"]]
+        published = dict(_cc.active().stats)
+    finally:
+        ref_server.close()
+        _cc.reset()
+    assert published["puts"] >= 1, (
+        f"reference serve published no programs to warm from: "
+        f"{published}")
+
+    obs.enable()
+    obs.clear()
+    registry().reset()
+    obs_fleet.enable(obs_dir, interval_s=0.2)
+    pool = BackendPool()
+    sup = ServeSupervisor(FleetConfig(
+        service_dir=service_dir, initial_backends=2,
+        compile_cache=cache_dir,
+        policy=RecoveryPolicy(max_restarts=2,
+                              rescale_on_exhausted=False,
+                              preempt_exit_codes=()),
+        scale=ScalePolicy(fast_burn=5.0, burn_sustain_s=0.5,
+                          min_backends=1, max_backends=3,
+                          cooldown_s=2.0, idle_sustain_s=3600.0),
+        # tight SLO windows so induced burn shows within a beacon or two
+        slo={"window_s": 2.0, "long_window_s": 4.0, "min_requests": 1},
+    ), pool=pool)
+    router = FleetRouter(pool)
+
+    def _journal_kinds():
+        path = os.path.join(service_dir, "decisions.jsonl")
+        if not os.path.exists(path):
+            return []
+        with open(path) as f:
+            return [json.loads(line) for line in f]
+
+    def _wait(pred, timeout, what):
+        deadline = time.monotonic() + timeout
+        while not pred():
+            assert time.monotonic() < deadline, f"timed out: {what}"
+            time.sleep(0.1)
+
+    try:
+        sup.start()
+        router.start()
+        host, port = router.address
+        base = f"http://{host}:{port}"
+        body = json.dumps({"rows": [{"image": r.tolist()} for r in rows],
+                           "dtype": "uint8"}).encode()
+        burn_body = json.dumps(
+            {"rows": [{"image": rows[0].tolist()}], "dtype": "uint8",
+             "deadline_ms": 1}).encode()
+
+        def predict(payload=body, timeout=60.0):
+            req = urllib.request.Request(
+                f"{base}/v1/models/{MODEL_NAME}:predict", data=payload,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return (int(r.headers["X-Fleet-Backend"]),
+                        json.loads(r.read()))
+
+        _wait(lambda: pool.up_count() == 2, 180.0,
+              "initial backends routable")
+
+        # -- 2. kill -9 one backend mid-burst: zero drops, every answer
+        #       bit-identical to the single-process reference --
+        results, errors = [], []
+
+        def burst_one():
+            try:
+                results.append(predict())
+            except Exception as e:  # any error here IS the failure
+                errors.append(repr(e))
+
+        n_burst = 24
+        threads = [threading.Thread(target=burst_one)
+                   for _ in range(n_burst)]
+        for t in threads[:n_burst // 2]:
+            t.start()
+        victim_bid, victim = next(iter(sup._backends.items()))
+        os.kill(victim.proc.pid, _signal.SIGKILL)
+        for t in threads[n_burst // 2:]:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, (
+            f"{len(errors)}/{n_burst} requests dropped across the "
+            f"kill: {errors[:3]}")
+        assert len(results) == n_burst
+        backends_seen = {bid for bid, _ in results}
+        for _bid, resp in results:
+            got = [r["scores"] for r in resp["rows"]]
+            assert got == ref_scores, (
+                "router answer diverged from single-process serving "
+                f"(via backend {_bid})")
+
+        # the supervisor noticed the kill and respawned generation 1
+        _wait(lambda: any(e["kind"] == "restart"
+                          for e in _journal_kinds()), 60.0,
+              "restart journaled after kill -9")
+        _wait(lambda: pool.up_count() == 2, 180.0,
+              "killed backend respawned and routable")
+
+        # -- 3. induced fast-burn: tiny-deadline volley → sustained
+        #       burn in the beacons → autoscaler spawns backend 3 --
+        deadline = time.monotonic() + 120.0
+        burn_statuses = []
+        while pool.up_count() < 3:
+            assert time.monotonic() < deadline, (
+                f"autoscaler never spawned a third backend; journal="
+                f"{[e['kind'] for e in _journal_kinds()]}")
+            try:
+                predict(burn_body, timeout=30.0)
+                burn_statuses.append(200)
+            except urllib.error.HTTPError as e:
+                burn_statuses.append(e.code)  # 504s are the point
+            time.sleep(0.05)
+        scale_ups = [e for e in _journal_kinds()
+                     if e["kind"] == "scale_up"]
+        assert scale_ups, "third backend up but no scale_up journaled"
+        new_bid = scale_ups[0]["bid"]
+        assert new_bid not in (victim_bid,)
+
+        # the scaled-up backend warmed from the compile cache: its
+        # beacon carries the worker's own cache stats — zero fresh XLA
+        # compiles, every program deserialized
+        beacon = read_beacon(service_dir, new_bid, 0)
+        assert beacon is not None, "no beacon from the scaled backend"
+        cc_stats = beacon.get("compile_cache")
+        assert cc_stats is not None, (
+            "scaled-up backend beacon has no compile-cache stats — "
+            "MMLSPARK_TPU_COMPILE_CACHE did not reach the worker")
+        assert cc_stats["compiles"] == 0 and cc_stats["hits"] >= 1, (
+            f"scaled-up backend paid fresh XLA compiles: {cc_stats}")
+
+        # and it serves the SAME answers (clean request, no deadline)
+        post_bid, resp = predict()
+        assert [r["scores"] for r in resp["rows"]] == ref_scores
+
+        # -- 4. the telemetry plane: the router's counters merge into
+        #       the fleet view bit-equal, alongside the worker exports --
+        expected = {
+            format_series(m.name, m.labels): m.value
+            for m in registry().iter_metrics()
+            if isinstance(m, Counter)
+            and m.name.startswith("serve.fleet.router.")}
+        assert expected.get("serve.fleet.router.reroutes", 0) >= 1, (
+            "kill -9 mid-burst never exercised the re-route path")
+        obs_fleet.disable()  # final exit snapshot before collecting
+        view = obs_fleet.FleetCollector(obs_dir).collect(
+            include_ring=False)
+        merged = {
+            format_series(m.name, m.labels): m.value
+            for m in view.registry.iter_metrics()
+            if isinstance(m, Counter)
+            and m.name.startswith("serve.fleet.router.")}
+        assert merged == expected, (
+            "fleet-merged router counters are not bit-equal to the "
+            f"router registry: missing/changed "
+            f"{dict(set(expected.items()) - set(merged.items()))}, "
+            f"extra {dict(set(merged.items()) - set(expected.items()))}")
+        worker_snaps = [p for p in view.processes
+                        if p.pid != os.getpid()]
+        assert worker_snaps, (
+            "no backend process exported to the fleet dir — "
+            "MMLSPARK_TPU_FLEET did not reach the workers")
+
+        journal = _journal_kinds()
+        kinds = [e["kind"] for e in journal]
+        status = sup.status()
+        return {
+            "burst_requests": n_burst,
+            "burst_errors": 0,
+            "burst_backends": sorted(backends_seen),
+            "killed_bid": victim_bid,
+            "bit_identical": True,
+            "burn_statuses": {s: burn_statuses.count(s)
+                              for s in sorted(set(burn_statuses))},
+            "scale_up_reason": scale_ups[0]["reason"],
+            "scaled_bid": new_bid,
+            "scaled_backend_cache": {k: cc_stats[k] for k in
+                                     ("hits", "compiles")},
+            "journal_kinds": sorted(set(kinds)),
+            "scale_ups": status["scale_ups"],
+            "router_counters": {k.rsplit(".", 1)[-1]: v
+                                for k, v in expected.items()},
+            "fleet_processes": len(view.processes),
+        }
+    finally:
+        router.close()
+        sup.close()
+        obs_fleet.disable()
+        obs.disable()
+        obs.clear()
+        registry().reset()
+        leaked = [t.name for t in threading.enumerate()
+                  if t.name.startswith(("ServeFleetRouter",
+                                        "ServeFleetWatch"))
+                  or t.name in ("FleetExporter", "TimeSeriesSampler")]
+        assert not leaked, f"fleet threads leaked: {leaked}"
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _timed_once(pm, table, time_mod) -> float:
     t0 = time_mod.perf_counter()
     pm.transform(table)
@@ -2438,6 +2702,7 @@ def main() -> int:
         obs_overhead = check_obs_overhead()
         obs_tracing = check_obs_request_tracing()
         fleet_obs = check_fleet_obs()
+        serve_fleet = check_serve_fleet()
         flight_rec = check_flight_recorder()
         spmd = check_spmd_clean()
         concurrency = check_concurrency_clean()
@@ -2457,6 +2722,7 @@ def main() -> int:
                       "obs_overhead": obs_overhead,
                       "obs_request_tracing": obs_tracing,
                       "fleet_obs": fleet_obs,
+                      "serve_fleet": serve_fleet,
                       "flight_recorder": flight_rec, "spmd": spmd,
                       "concurrency": concurrency}))
     return 0

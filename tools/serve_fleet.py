@@ -1,0 +1,137 @@
+"""serve_fleet — run the serve fleet tier: N supervised backends behind
+one router.
+
+Launches the :class:`ServeSupervisor` (backend serve processes with
+restart-with-backoff recovery and SLO-burn autoscaling) and the
+:class:`FleetRouter` HTTP fan-in over the shared backend pool
+(docs/serving.md §fleet tier). Clients talk to the router exactly like
+a single serve process — ``POST /v1/models/<name>:predict`` and
+chunked ``:generate`` streams — and never observe a backend death or a
+scale event: failovers re-route, drains are zero-drop.
+
+Usage::
+
+    # two self-test backends behind a router on :8100, warming from a
+    # shared compile cache, autoscaling 1..4 on SLO burn
+    python tools/serve_fleet.py --dir ./fleet --port 8100 \\
+        --backends 2 --compile-cache ./cc --min-backends 1 \\
+        --max-backends 4
+
+    # run AS one backend worker (what the supervisor launches)
+    python tools/serve_fleet.py worker
+
+Every supervisor decision (spawn/restart/scale_up/scale_down/...)
+lands in ``<dir>/decisions.jsonl``; with ``MMLSPARK_TPU_OBS=1`` the
+same decisions are obs ``fleet/*`` events + ``serve.fleet.*``
+counters, and ``--fleet-dir`` exports router + supervisor telemetry
+into the obs fleet plane (``python tools/fleet.py status`` merges it
+with the backends' own exports).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+from typing import Sequence
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "worker":
+        from mmlspark_tpu.serve.fleet.worker import run_backend_worker
+        return run_backend_worker()
+
+    ap = argparse.ArgumentParser(
+        prog="serve_fleet",
+        description="Run N supervised serve backends behind one router "
+                    "(see module docstring)")
+    ap.add_argument("--dir", required=True, dest="service_dir",
+                    help="fleet run directory: beacons, decisions.jsonl")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8100,
+                    help="router port (0 = ephemeral)")
+    ap.add_argument("--backends", type=int, default=2,
+                    help="initial backend count")
+    ap.add_argument("--compile-cache", default=None,
+                    help="shared AOT compile cache dir — restarts and "
+                         "scale-ups warm from it (zero fresh compiles)")
+    ap.add_argument("--max-restarts", type=int, default=2,
+                    help="per-backend restart budget")
+    ap.add_argument("--min-backends", type=int, default=1)
+    ap.add_argument("--max-backends", type=int, default=4)
+    ap.add_argument("--fast-burn", type=float, default=14.0,
+                    help="SLO fast-burn multiple that triggers scale-up")
+    ap.add_argument("--burn-sustain", type=float, default=1.0,
+                    help="seconds the burn must persist before scaling")
+    ap.add_argument("--idle-sustain", type=float, default=30.0,
+                    help="seconds of idle occupancy before scale-down")
+    ap.add_argument("--cooldown", type=float, default=5.0,
+                    help="seconds between scale actions")
+    ap.add_argument("--slo", default=None,
+                    help="JSON SLOSpec field overrides for the backends")
+    ap.add_argument("--fleet-dir", default=None,
+                    help="obs fleet plane dir: export router+supervisor "
+                         "telemetry there and propagate to backends")
+    ap.add_argument("--cmd", nargs=argparse.REMAINDER, default=[],
+                    help="backend worker command (default: the built-in "
+                         "self-test serve worker; prefix with --)")
+    args = ap.parse_args(argv)
+
+    from mmlspark_tpu.obs import fleet as obs_fleet
+    from mmlspark_tpu.serve.fleet import (
+        BackendPool, FleetConfig, FleetRouter, ScalePolicy,
+        ServeSupervisor,
+    )
+    from mmlspark_tpu.train.service import RecoveryPolicy
+
+    if args.fleet_dir:
+        obs_fleet.enable(args.fleet_dir)
+    cmd = list(args.cmd)
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    pool = BackendPool()
+    sup = ServeSupervisor(FleetConfig(
+        service_dir=args.service_dir, cmd=cmd or None,
+        initial_backends=args.backends,
+        policy=RecoveryPolicy(max_restarts=args.max_restarts,
+                              rescale_on_exhausted=False,
+                              preempt_exit_codes=()),
+        scale=ScalePolicy(fast_burn=args.fast_burn,
+                          burn_sustain_s=args.burn_sustain,
+                          idle_sustain_s=args.idle_sustain,
+                          min_backends=args.min_backends,
+                          max_backends=args.max_backends,
+                          cooldown_s=args.cooldown),
+        compile_cache=args.compile_cache,
+        slo=json.loads(args.slo) if args.slo else None), pool=pool)
+    router = FleetRouter(pool, host=args.host, port=args.port)
+    sup.start()
+    router.start()
+    host, port = router.address
+    print(json.dumps({"router": f"http://{host}:{port}",
+                      "backends": args.backends,
+                      "service_dir": args.service_dir}), flush=True)
+    # SIGTERM must take the same clean path as ^C: without this the
+    # supervisor dies silently and ORPHANS its backend processes
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        router.close()
+        sup.close()
+        if args.fleet_dir:
+            obs_fleet.disable()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
