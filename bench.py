@@ -814,6 +814,88 @@ def bench_serve_fleet(rng, n_total: int = 64, conc: int = 8) -> dict:
     return out
 
 
+def bench_deploy(rng) -> dict:
+    """Checkpoint→serving wall through the lifecycle deployer
+    (mmlspark_tpu/lifecycle, docs/lifecycle.md): the time from
+    ``start_rollout`` on an already-published version to PROMOTED —
+    shadow warmup, canary ramp under a trickle of live traffic, repo
+    ``CURRENT`` flip — cold (empty compile cache: every candidate
+    bucket program XLA-compiles during the shadow deploy) vs warm (the
+    same rollout against the cache the cold pass populated). Fresh
+    server/bundle objects per pass, same repo artifacts; bench_check
+    gates warm <= cold WITHIN this line — absolute deploy walls are box
+    weather, the cache either cuts the candidate warmup or it doesn't."""
+    import shutil
+    import tempfile
+
+    from mmlspark_tpu.core import compile_cache as cc
+    from mmlspark_tpu.data.table import DataTable
+    from mmlspark_tpu.lifecycle import Deployer, RolloutPolicy, ServerTarget
+    from mmlspark_tpu.models.bundle import ModelBundle
+    from mmlspark_tpu.models.repo import ModelRepo
+    from mmlspark_tpu.models.zoo import MLP
+    from mmlspark_tpu.serve import Client, ModelServer, ServeConfig
+
+    import jax
+
+    d_in = 32
+    module = MLP(features=(64, 64), num_outputs=8)
+    rows = rng.normal(size=(8, d_in)).astype(np.float32)
+    example = DataTable({"input": list(rows[:1])})
+    tmp = tempfile.mkdtemp(prefix="bench-deploy-")
+    out: dict = {}
+    try:
+        repo = ModelRepo(f"{tmp}/repo")
+        for seed in (0, 1):
+            params = module.init(
+                jax.random.PRNGKey(seed),
+                np.zeros((1, d_in), np.float32))["params"]
+            repo.publish("m", ModelBundle(
+                module=module,
+                params=jax.tree_util.tree_map(np.asarray, params),
+                input_spec=(d_in,), output_names=("logits",), name="m"))
+        for label in ("cold", "warm"):
+            cc.reset()
+            repo.set_current("m", 1)
+            server = ModelServer(ServeConfig(
+                buckets=(1, 8), deadline_ms=None,
+                compile_cache=f"{tmp}/cc"))
+            server.add_model_from_repo(repo, "m", version=1,
+                                       example=example)
+            client = Client(server)
+            deployer = Deployer(
+                f"{tmp}/lifecycle_{label}", repo,
+                ServerTarget(server, "m", example=example),
+                policy=RolloutPolicy(advance_after=1))
+            t0 = time.perf_counter()
+            rollout = deployer.start_rollout("m", version=2)
+            while not rollout.done:
+                # the trickle of live traffic every ramp stage needs
+                # for a verdict (no canary evidence ⇒ the policy holds)
+                for _ in range(2):
+                    client.predict("m", DataTable({"input": list(rows)}),
+                                   timeout=30)
+                deployer.tick(rollout)
+            wall = time.perf_counter() - t0
+            stats = dict(cc.active().stats)
+            server.close()
+            out[label] = {
+                "deploy_wall_s": round(wall, 3),
+                "outcome": rollout.outcome,
+                "ticks": rollout.ledger.ticks,
+                "xla_compiles": stats["compiles"],
+                "cache_hits": stats["hits"],
+            }
+        cold_w = out["cold"]["deploy_wall_s"]
+        if cold_w:
+            out["speedup"] = round(cold_w / max(
+                out["warm"]["deploy_wall_s"], 1e-9), 2)
+    finally:
+        cc.reset()
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def main() -> int:
     import jax
 
@@ -1255,6 +1337,16 @@ def main() -> int:
     except Exception as e:  # best-effort metric; label failures accurately
         serve_fleet = {"error": f"{type(e).__name__}: {e}"}
 
+    # continuous deployment (round 20): checkpoint→serving wall through
+    # the lifecycle deployer, cold vs compile-cache-warm candidate
+    # warmup — the promotion latency a fleet rollout actually pays
+    # (docs/lifecycle.md); bench_check gates warm <= cold within-line
+    deploy: dict | None = None
+    try:
+        deploy = bench_deploy(rng)
+    except Exception as e:  # best-effort metric; label failures accurately
+        deploy = {"error": f"{type(e).__name__}: {e}"}
+
     # BASELINE configs 3-5 (flagship models); skip with BENCH_FAST=1
     import os
     extra: dict = {}
@@ -1362,6 +1454,11 @@ def main() -> int:
             "kill", {}).get("p99_ms"),
         "serve_fleet_kill_errors": (serve_fleet or {}).get(
             "kill", {}).get("errors"),
+        "deploy": deploy,
+        "deploy_wall_cold_s": (deploy or {}).get(
+            "cold", {}).get("deploy_wall_s"),
+        "deploy_wall_warm_s": (deploy or {}).get(
+            "warm", {}).get("deploy_wall_s"),
         "serve_precision_ab": serve_precision,
         **{f"serve_rows_per_s_{p}": (serve_precision or {}).get(
             p, {}).get("serve_rows_per_s") for p in ("f32", "bf16",

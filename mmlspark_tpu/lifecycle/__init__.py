@@ -1,0 +1,57 @@
+"""The train→serve deployment plane (ROADMAP item 3).
+
+The reference's whole point was that ``CNTKLearner`` output flowed
+straight into ``CNTKModel`` serving inside one pipeline; this package
+closes the same loop for the reproduction: a supervised fine-tune run
+*ends with the new version serving traffic*, and a degraded run ends
+rolled back — the whole journey journaled and visible as one fleet
+timeline.
+
+Three layers, each in the repo's sensors → pure policy → actuator
+discipline (PR 11/13/19):
+
+* :mod:`mmlspark_tpu.lifecycle.evalgate` — which checkpoints deserve
+  to ship: a pure :class:`EvalGate` judges the worker's eval (loss)
+  series against an :class:`EvalLedger` of what already shipped.
+* :mod:`mmlspark_tpu.lifecycle.publish` — the train-side half: the
+  :class:`Publisher` the :class:`~mmlspark_tpu.train.service.TrainSupervisor`
+  drives on clean generation completion (and optionally every K
+  checkpoints), dark-publishing passing checkpoints to the
+  :class:`~mmlspark_tpu.models.repo.ModelRepo` with provenance stamped
+  in the manifest.
+* :mod:`mmlspark_tpu.lifecycle.rollout` /
+  :mod:`mmlspark_tpu.lifecycle.deployer` — the serve-side half: a
+  :class:`Deployer` supervises ``published → shadow → canary →
+  promoted`` per version over a single :class:`ModelServer` or the
+  PR 19 fleet, with the pure :class:`RolloutPolicy` deciding every
+  transition and parity drift / fast-burn at any stage auto-rolling
+  back repo-side AND serve-side.
+
+Every decision lands in ``<dir>/decisions.jsonl`` (the shared
+``service/core.py`` journal machinery) cross-referencing the train and
+serve supervisors' journals, plus obs ``lifecycle/*`` events,
+``lifecycle.rollouts``/``lifecycle.rollbacks`` counters, and the
+``deploy.wall_s`` gauge. See docs/lifecycle.md.
+"""
+
+from mmlspark_tpu.lifecycle.deployer import (  # noqa: F401
+    Deployer, FleetTarget, Rollout, ServerTarget, replay_decisions,
+)
+from mmlspark_tpu.lifecycle.evalgate import (  # noqa: F401
+    EvalGate, EvalLedger, Publish, Reject,
+)
+from mmlspark_tpu.lifecycle.publish import (  # noqa: F401
+    PUBLISH_FENCE_SPAN, Publisher, PublishPolicy, bundle_from_npz,
+    lifecycle_journal,
+)
+from mmlspark_tpu.lifecycle.rollout import (  # noqa: F401
+    Abort, Advance, Hold, RolloutLedger, RolloutPolicy, RolloutSignal,
+)
+
+__all__ = [
+    "Abort", "Advance", "Deployer", "EvalGate", "EvalLedger",
+    "FleetTarget", "Hold", "PUBLISH_FENCE_SPAN", "Publish", "Publisher",
+    "PublishPolicy", "Reject", "Rollout", "RolloutLedger",
+    "RolloutPolicy", "RolloutSignal", "ServerTarget", "bundle_from_npz",
+    "lifecycle_journal", "replay_decisions",
+]

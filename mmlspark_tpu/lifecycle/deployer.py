@@ -1,0 +1,480 @@
+"""The rollout driver: ``published → shadow → canary → promoted`` per
+version, over a single server or the PR 19 fleet.
+
+The :class:`Deployer` is the serve-side half of the deployment plane —
+the supervisor pattern once more: each :meth:`tick` samples its target
+into one typed :class:`~mmlspark_tpu.lifecycle.rollout.RolloutSignal`,
+the pure :class:`~mmlspark_tpu.lifecycle.rollout.RolloutPolicy`
+decides, and the deployer actuates:
+
+* :class:`ServerTarget` drives one in-process
+  :class:`~mmlspark_tpu.serve.server.ModelServer` through the PR 13
+  machinery — ``deploy_canary`` per stage (the server's own burn
+  engine stays armed as a safety net, but promotion is the
+  *deployer's* decision, via the new ``ModelServer.promote``).
+* :class:`FleetTarget` fans out over a PR 19 serve fleet by writing a
+  ``deploy.json`` command file the backend workers watch: each backend
+  hot-swaps the version from the shared
+  :class:`~mmlspark_tpu.models.repo.ModelRepo` and reports its served
+  ``(model, version)`` map in its beacon — promotion blocks until
+  every backend has converged (a lagging backend holds the rollout).
+
+Parity drift or fast-burn at any stage auto-rolls back **repo-side**
+(``ModelRepo.set_current`` back to the prior version) *and*
+serve-side, journaled. Every transition lands in
+``<dir>/decisions.jsonl`` (shared ``service/core.py`` journal
+machinery) cross-referencing the train and serve supervisors' own
+journals; obs mirrors them as ``lifecycle/*`` events with
+``lifecycle.rollouts``/``lifecycle.rollbacks`` counters and the
+``deploy.wall_s`` gauge stamped on promotion.
+:func:`replay_decisions` reconstructs every rollout's trajectory from
+the journal alone — the forensic contract the tests pin.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import time
+from typing import Any
+
+from mmlspark_tpu.core.logging_utils import get_logger
+from mmlspark_tpu.lifecycle.publish import lifecycle_journal
+from mmlspark_tpu.lifecycle.rollout import (
+    Abort, Advance, Hold, RolloutLedger, RolloutPolicy, RolloutSignal,
+)
+from mmlspark_tpu.obs import runtime as _obs_rt
+from mmlspark_tpu.obs.metrics import registry as _obs_registry
+from mmlspark_tpu.serve.lifecycle import CanarySignal
+
+_log = get_logger(__name__)
+
+DEPLOY_FILE = "deploy.json"
+
+_BEACON_RE = re.compile(r"^beacon_(\d+)\.json$")
+
+
+@dataclasses.dataclass
+class Rollout:
+    """One version's journey through the deployer (mutable state the
+    ticks advance). ``version`` is None until a publish-stage rollout
+    has actually published its bundle."""
+
+    model: str
+    version: int | None = None
+    bundle: Any = None
+    provenance: dict | None = None
+    prior_version: int | None = None
+    ledger: RolloutLedger = dataclasses.field(
+        default_factory=RolloutLedger)
+    started: float = dataclasses.field(default_factory=time.monotonic)
+    outcome: str | None = None  # "promoted" | "rolled_back"
+
+    @property
+    def done(self) -> bool:
+        return self.outcome is not None
+
+
+class ServerTarget:
+    """Drive one in-process :class:`ModelServer` (PR 13 canary
+    machinery). ``wrap`` optionally maps the repo artifact to the
+    served transformer (default: serve it as loaded — a raw
+    ``ModelBundle`` becomes a ``JaxModel`` on columns
+    ``input``/``scores``, the server's own convention)."""
+
+    def __init__(self, server: Any, model: str, wrap: Any = None,
+                 schema: Any = None, example: Any = None):
+        self.server = server
+        self.model = model
+        self.wrap = wrap
+        self.schema = schema
+        self.example = example
+        self._artifacts: dict[int, Any] = {}
+        self._tolerance: float | None = None
+
+    def _materialize(self, repo: Any, version: int) -> Any:
+        if version not in self._artifacts:
+            model, _info = repo.load(self.model, version)
+            if self.wrap is not None:
+                model = self.wrap(model)
+            self._artifacts[version] = model
+        return self._artifacts[version]
+
+    def begin(self, repo: Any, rollout: Rollout, stage: str,
+              fraction: float, tolerance: float | None,
+              fast_burn: float) -> None:
+        from mmlspark_tpu.serve.lifecycle import PromotionPolicy
+        self._tolerance = tolerance if stage == "shadow" else None
+        # the server's own burn engine stays armed (fast rollback even
+        # between deployer ticks) but may never promote: promotion is
+        # the deployer's decision, gated on policy + convergence
+        self.server.deploy_canary(
+            self.model, self._materialize(repo, rollout.version),
+            mode=stage, fraction=fraction, version=rollout.version,
+            schema=self.schema, example=self.example,
+            policy=PromotionPolicy(fast_burn=fast_burn,
+                                   promote_after=10 ** 9),
+            parity_tolerance=self._tolerance)
+
+    def observe(self, rollout: Rollout, stage: str) -> dict:
+        if stage == "promoting":
+            snap = self.server.snapshot().get(self.model) or {}
+            converged = snap.get("version") == rollout.version
+            return {"serve": None, "action": None,
+                    "converged": converged,
+                    "lagging": () if converged else (self.model,),
+                    "healthy": True}
+        detail = self.server.lifecycle_tick(self.model)
+        if detail is None:
+            # no canary attached: either the server's own burn engine
+            # already rolled it back (honor that) or a racing close
+            for rec in self.server.lifecycle_decisions("rollback"):
+                if rec.get("version") == rollout.version:
+                    return {"serve": None, "action": "rollback",
+                            "converged": False, "lagging": (),
+                            "healthy": False}
+            return {"serve": None, "action": None, "converged": False,
+                    "lagging": (), "healthy": False}
+        serve = CanarySignal(
+            burn_short=detail.get("burn_short"),
+            burn_long=detail.get("burn_long"),
+            terminal_window=int(detail.get("terminal_window") or 0),
+            parity_drift=detail.get("parity_drift"),
+            parity_tolerance=self._tolerance)
+        return {"serve": serve, "action": detail.get("action"),
+                "converged": True, "lagging": (), "healthy": True}
+
+    def promote(self, rollout: Rollout) -> None:
+        self.server.promote(self.model, reason="deployer promotion")
+
+    def rollback(self, rollout: Rollout, reason: str) -> None:
+        self.server.rollback(self.model, reason=reason)
+
+
+class FleetTarget:
+    """Fan a rollout out over a PR 19 serve fleet.
+
+    Actuation is a ``deploy.json`` command file in the fleet service
+    dir (``{"seq", "model", "version", "repo", "backends"}``) that the
+    backend workers watch: each in-scope backend hot-swaps the version
+    from the shared repo (``ModelServer.add_model_from_repo`` — digest
+    verify first, zero-drop flip) and reports its served
+    ``(model, version)`` map in its beacon. On a fleet, both ramp
+    stages are subset rollouts (``canary_backends`` backends first;
+    cross-process shadow mirroring does not exist), and promotion
+    re-targets ``"all"`` — convergence is read back off the beacons,
+    so a lagging backend blocks promotion visibly."""
+
+    def __init__(self, service_dir: str, repo_root: str,
+                 canary_backends: int = 1):
+        self.service_dir = service_dir
+        self.repo_root = repo_root
+        self.canary_backends = max(1, int(canary_backends))
+        self._scope: Any = ()
+        self._seq = self._load_seq()
+
+    def _load_seq(self) -> int:
+        try:
+            with open(os.path.join(self.service_dir, DEPLOY_FILE),
+                      encoding="utf-8") as f:
+                return int(json.load(f).get("seq", 0))
+        except (OSError, ValueError):
+            return 0
+
+    def _command(self, model: str, version: int,
+                 backends: Any) -> None:
+        from mmlspark_tpu.service.core import atomic_write_json
+        self._seq += 1
+        atomic_write_json(
+            os.path.join(self.service_dir, DEPLOY_FILE),
+            {"seq": self._seq, "model": model, "version": version,
+             "repo": self.repo_root, "backends": backends})
+
+    def _beacons(self) -> dict[int, dict]:
+        out: dict[int, dict] = {}
+        try:
+            names = os.listdir(self.service_dir)
+        except OSError:
+            return out
+        for fname in names:
+            m = _BEACON_RE.match(fname)
+            if not m:
+                continue
+            try:
+                with open(os.path.join(self.service_dir, fname),
+                          encoding="utf-8") as f:
+                    out[int(m.group(1))] = json.load(f)
+            except (OSError, ValueError):
+                continue
+        return out
+
+    def _running(self) -> dict[int, dict]:
+        return {bid: b for bid, b in self._beacons().items()
+                if b.get("status") == "running"}
+
+    def begin(self, repo: Any, rollout: Rollout, stage: str,
+              fraction: float, tolerance: float | None,
+              fast_burn: float) -> None:
+        running = sorted(self._running())
+        self._scope = tuple(running[:self.canary_backends])
+        self._command(rollout.model, rollout.version,
+                      list(self._scope))
+
+    def observe(self, rollout: Rollout, stage: str) -> dict:
+        running = self._running()
+        scope = (sorted(running) if self._scope == "all"
+                 else list(self._scope))
+        lagging = tuple(
+            bid for bid in scope
+            if (running.get(bid) or {}).get("versions", {})
+            .get(rollout.model) != rollout.version)
+        healthy = bool(scope) and all(bid in running for bid in scope)
+        burns = [float(running[bid].get("burn_short", 0.0))
+                 for bid in scope if bid in running]
+        serve = None
+        if healthy and not lagging and stage != "promoting":
+            serve = CanarySignal(burn_short=max(burns, default=0.0))
+        return {"serve": serve, "action": None,
+                "converged": healthy and not lagging,
+                "lagging": lagging, "healthy": healthy}
+
+    def promote(self, rollout: Rollout) -> None:
+        self._scope = "all"
+        self._command(rollout.model, rollout.version, "all")
+
+    def rollback(self, rollout: Rollout, reason: str) -> None:
+        if rollout.prior_version is not None:
+            self._scope = "all"
+            self._command(rollout.model, rollout.prior_version, "all")
+
+
+class Deployer:
+    """Supervise rollouts end to end (see module docstring).
+
+    ``refs`` carries the cross-journal pointers (e.g.
+    ``{"train_journal": ..., "serve_journal": ...}``) stamped into the
+    ``rollout`` record so one journey reads across all three
+    journals."""
+
+    def __init__(self, directory: str, repo: Any, target: Any,
+                 policy: RolloutPolicy | None = None,
+                 refs: dict | None = None, run_id: str | None = None):
+        from mmlspark_tpu.models.repo import ModelRepo
+        self.directory = directory
+        self.repo = (ModelRepo(repo) if isinstance(repo, str) else repo)
+        self.target = target
+        self.policy = policy or RolloutPolicy()
+        self.refs = dict(refs or {})
+        self.run_id = run_id or f"deploy-{os.getpid()}"
+        self.journal = lifecycle_journal(directory)
+
+    # -- rollout admission --
+
+    def start_rollout(self, model: str, version: int | None = None,
+                      bundle: Any = None,
+                      provenance: dict | None = None) -> Rollout:
+        """Admit one rollout: either a published ``version`` (from the
+        train-side Publisher) or a ``bundle`` the deployer publishes
+        itself on its first tick (so a torn publish is retried by the
+        next tick, never dropped)."""
+        if (version is None) == (bundle is None):
+            raise ValueError(
+                "start_rollout needs exactly one of version= (already "
+                "published) or bundle= (publish on first tick)")
+        versions = self.repo.versions(model)
+        prior = self.repo.current_version(model) if versions else None
+        rollout = Rollout(model=model, version=version, bundle=bundle,
+                          provenance=provenance, prior_version=prior)
+        if version is not None:
+            self.repo.verify(model, version)
+            rollout.ledger.stage = "published"
+        self.journal.record("rollout", {
+            "model": model, "version": version,
+            "prior_version": prior, "run_id": self.run_id,
+            "stages": list(self.policy.stages), **self.refs})
+        return rollout
+
+    # -- one tick --
+
+    def tick(self, rollout: Rollout) -> dict:
+        """Advance ``rollout`` by at most one transition; returns what
+        happened (mirrors the journal record)."""
+        if rollout.done:
+            return {"stage": rollout.ledger.stage, "action": "done"}
+        ledger = rollout.ledger
+        ledger.ticks += 1
+        ledger.stage_ticks += 1
+        if ledger.stage == "publish":
+            return self._tick_publish(rollout)
+        if ledger.stage == "published":
+            return self._enter_next_stage(rollout)
+        sig_bits = self.target.observe(rollout, ledger.stage)
+        sig = RolloutSignal(stage=ledger.stage, **sig_bits)
+        action = self.policy.decide(sig, ledger)
+        if isinstance(action, Abort):
+            return self._rollback(rollout, action.reason)
+        if isinstance(action, Advance):
+            if ledger.stage == "promoting":
+                return self._promote(rollout, action.reason)
+            return self._enter_next_stage(rollout)
+        ledger.clean_ticks = (ledger.clean_ticks + 1 if action.clean
+                              else 0)
+        detail = {"model": rollout.model, "version": rollout.version,
+                  "stage": ledger.stage, "reason": action.reason,
+                  "clean_ticks": ledger.clean_ticks,
+                  "ticks": ledger.ticks}
+        serve = sig.serve
+        if serve is not None:
+            detail["burn_short"] = serve.burn_short
+            detail["parity_drift"] = serve.parity_drift
+        if sig.lagging:
+            detail["lagging"] = list(sig.lagging)
+        self.journal.record("hold", detail)
+        return {"action": "hold", **detail}
+
+    def _tick_publish(self, rollout: Rollout) -> dict:
+        try:
+            version = self.repo.publish(
+                rollout.model, rollout.bundle,
+                provenance=rollout.provenance, set_current=False)
+        except Exception as e:
+            # staging discipline: nothing partial became visible and
+            # CURRENT never moved — hold the stage, next tick retries
+            detail = {"model": rollout.model,
+                      "stage": "publish",
+                      "error": f"{type(e).__name__}: {e}"}
+            self.journal.record("publish_torn", detail)
+            return {"action": "publish_torn", **detail}
+        rollout.version = version
+        self._set_stage(rollout, "published")
+        detail = {"model": rollout.model, "version": version,
+                  "prior_version": rollout.prior_version, "dark": True}
+        self.journal.record("publish", detail)
+        return {"action": "publish", **detail}
+
+    # -- transitions --
+
+    def _set_stage(self, rollout: Rollout, stage: str) -> None:
+        rollout.ledger.stage = stage
+        rollout.ledger.stage_ticks = 0
+        rollout.ledger.clean_ticks = 0
+
+    def _enter_next_stage(self, rollout: Rollout) -> dict:
+        stages = list(self.policy.stages)
+        current = rollout.ledger.stage
+        if current in stages and stages.index(current) + 1 < len(stages):
+            nxt = stages[stages.index(current) + 1]
+        elif current == "published" and stages:
+            nxt = stages[0]
+        else:
+            nxt = "promoting"
+        detail: dict = {"model": rollout.model,
+                        "version": rollout.version, "stage": nxt}
+        if nxt == "promoting":
+            # serve-side flip first; repo CURRENT flips only once the
+            # target reports every backend converged
+            self.target.promote(rollout)
+        else:
+            fraction = self.policy.fraction(nxt)
+            detail["fraction"] = fraction
+            self.target.begin(self.repo, rollout, nxt, fraction,
+                              self.policy.parity_tolerance,
+                              self.policy.fast_burn)
+        self._set_stage(rollout, nxt)
+        self.journal.record("stage", detail)
+        return {"action": "stage", **detail}
+
+    def _promote(self, rollout: Rollout, reason: str) -> dict:
+        self.repo.set_current(rollout.model, rollout.version)
+        wall = round(time.monotonic() - rollout.started, 6)
+        self._set_stage(rollout, "promoted")
+        rollout.outcome = "promoted"
+        if _obs_rt._enabled:
+            _obs_registry().gauge("deploy.wall_s",
+                                  model=rollout.model).set(wall)
+        detail = {"model": rollout.model, "version": rollout.version,
+                  "prior_version": rollout.prior_version,
+                  "reason": reason, "wall_s": wall,
+                  "ticks": rollout.ledger.ticks}
+        self.journal.record("promote", detail)
+        return {"action": "promote", **detail}
+
+    def _rollback(self, rollout: Rollout, reason: str) -> dict:
+        stage = rollout.ledger.stage
+        try:
+            self.target.rollback(rollout, reason)
+        except Exception as e:  # pragma: no cover - serve side already
+            _log.warning("lifecycle: serve-side rollback failed: %s", e)
+        if rollout.prior_version is not None:
+            # repo-side rollback: CURRENT pinned back to the prior
+            # version (idempotent when it never moved)
+            self.repo.set_current(rollout.model, rollout.prior_version)
+        self._set_stage(rollout, "rolled_back")
+        rollout.outcome = "rolled_back"
+        detail = {"model": rollout.model, "version": rollout.version,
+                  "prior_version": rollout.prior_version,
+                  "stage": stage, "reason": reason,
+                  "ticks": rollout.ledger.ticks}
+        self.journal.record("rollback", detail)
+        return {"action": "rollback", **detail}
+
+    # -- the driver loop --
+
+    def run(self, rollout: Rollout, tick_s: float = 0.25,
+            timeout_s: float = 120.0) -> str:
+        """Tick until the rollout terminates; a rollout that cannot
+        terminate inside ``timeout_s`` is rolled back (a deploy that
+        hangs is a failed deploy). Returns the outcome."""
+        deadline = time.monotonic() + timeout_s
+        while not rollout.done:
+            self.tick(rollout)
+            if rollout.done:
+                break
+            if time.monotonic() > deadline:
+                self._rollback(rollout, f"deploy timed out after "
+                                        f"{timeout_s:g}s in stage "
+                                        f"{rollout.ledger.stage!r}")
+                break
+            time.sleep(tick_s)
+        return rollout.outcome or rollout.ledger.stage
+
+
+def replay_decisions(path: str) -> list[dict]:
+    """Reconstruct every rollout's trajectory from ``decisions.jsonl``
+    alone: one dict per ``rollout`` record with the stages it entered,
+    the version it (eventually) carried, and its terminal outcome.
+    The forensic contract: a live :class:`Rollout`'s journey and the
+    replay of its journal must agree."""
+    rollouts: list[dict] = []
+    open_by_model: dict[str, dict] = {}
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            kind = rec.get("kind")
+            model = rec.get("model")
+            if kind == "rollout":
+                entry = {"model": model, "version": rec.get("version"),
+                         "prior_version": rec.get("prior_version"),
+                         "stages": [], "outcome": None, "reason": None}
+                rollouts.append(entry)
+                open_by_model[model] = entry
+                continue
+            entry = open_by_model.get(model)
+            if entry is None or entry["outcome"] is not None:
+                continue
+            if kind == "publish" and entry["version"] is None:
+                entry["version"] = rec.get("version")
+            elif kind == "stage":
+                entry["stages"].append(rec.get("stage"))
+            elif kind == "promote":
+                entry["outcome"] = "promoted"
+                entry["reason"] = rec.get("reason")
+            elif kind == "rollback":
+                entry["outcome"] = "rolled_back"
+                entry["reason"] = rec.get("reason")
+    return rollouts

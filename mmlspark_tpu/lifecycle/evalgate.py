@@ -1,0 +1,123 @@
+"""Eval-gated publication: which checkpoints deserve to become versions.
+
+The train supervisor sees every worker's eval (loss) series through the
+beacons and the result files; this module is the *pure* judgement over
+that series — no filesystem, no repo, no clock — in the same
+signal → action discipline as :class:`RecoveryPolicy` (PR 11) and
+:class:`PromotionPolicy` (PR 13). The decision table
+(docs/lifecycle.md):
+
+=====================================  ==============================
+series evidence                        decision
+=====================================  ==============================
+fewer than ``min_points`` points       reject (not enough evidence)
+a non-finite value anywhere            reject (diverged / NaN'd runs
+                                       never ship)
+tail mean above ``max_metric``         reject (absolute quality floor)
+tail did not improve on the head by    reject (training went nowhere —
+``min_improvement``                    or backward)
+tail worse than the best published     reject (a regression vs what
+metric + ``regress_tolerance``         already shipped)
+otherwise                              publish, metric = tail mean
+=====================================  ==============================
+
+Metrics are losses: **lower is better**. The ledger is the cross-run
+memory (what already shipped and at what metric); the caller mutates it
+on the action it takes, never the gate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Sequence
+
+
+@dataclasses.dataclass
+class EvalLedger:
+    """What the gate conditions on across decisions: every
+    ``(step, metric)`` it has already published — the regression
+    baseline — and how many candidates it turned away."""
+
+    published: list = dataclasses.field(default_factory=list)
+    rejects: int = 0
+
+    @property
+    def best(self) -> float | None:
+        """The best (lowest) metric that ever shipped, or None."""
+        return min((m for _step, m in self.published), default=None)
+
+
+@dataclasses.dataclass(frozen=True)
+class Publish:
+    """Ship it: ``metric`` is the tail mean the manifest will carry."""
+
+    metric: float
+    reason: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Reject:
+    reason: str
+
+
+Decision = Any  # Publish | Reject
+
+
+@dataclasses.dataclass(frozen=True)
+class EvalGate:
+    """Pure eval-series → publish/reject policy (see module table).
+
+    ``tail`` is the smoothing window: the candidate's quality is the
+    mean of the last ``tail`` points, judged for improvement against
+    the mean of the *first* ``tail`` points of the same series."""
+
+    min_points: int = 4
+    tail: int = 4
+    max_metric: float | None = None
+    min_improvement: float = 0.0
+    regress_tolerance: float = 0.0
+
+    def __post_init__(self) -> None:
+        if self.min_points < 1:
+            raise ValueError(f"min_points must be >= 1: {self.min_points}")
+        if self.tail < 1:
+            raise ValueError(f"tail must be >= 1: {self.tail}")
+        if self.min_improvement < 0 or self.regress_tolerance < 0:
+            raise ValueError("min_improvement and regress_tolerance "
+                             "must be >= 0")
+
+    def decide(self, series: Sequence[float],
+               ledger: EvalLedger) -> Decision:
+        values = [float(v) for v in series]
+        need = max(self.min_points, self.tail)
+        if len(values) < need:
+            return Reject(f"eval series has {len(values)} point(s), "
+                          f"need >= {need}")
+        if not all(math.isfinite(v) for v in values):
+            return Reject("eval series contains non-finite values "
+                          "(diverged run)")
+        tail_mean = sum(values[-self.tail:]) / self.tail
+        head = values[:self.tail]
+        head_mean = sum(head) / len(head)
+        if self.max_metric is not None and tail_mean > self.max_metric:
+            return Reject(f"tail metric {tail_mean:.4g} above the "
+                          f"quality floor {self.max_metric:g}")
+        improved = head_mean - tail_mean
+        required = self.min_improvement * abs(head_mean)
+        if improved < required:
+            return Reject(
+                f"tail metric {tail_mean:.4g} did not improve on the "
+                f"head {head_mean:.4g} by {self.min_improvement:g} "
+                f"(improved {improved:.4g}, need >= {required:.4g})")
+        best = ledger.best
+        if best is not None and tail_mean > best + self.regress_tolerance:
+            return Reject(f"tail metric {tail_mean:.4g} regresses on "
+                          f"the best published {best:.4g} "
+                          f"(+{self.regress_tolerance:g} tolerance)")
+        return Publish(
+            metric=tail_mean,
+            reason=(f"tail metric {tail_mean:.4g} over {self.tail} "
+                    f"point(s), improved {improved:.4g} on the head"
+                    + ("" if best is None
+                       else f", best published {best:.4g}")))

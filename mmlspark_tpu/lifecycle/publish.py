@@ -1,0 +1,291 @@
+"""The train-side half of the deployment plane: eval-gated publication.
+
+The :class:`Publisher` is the runtime the
+:class:`~mmlspark_tpu.train.service.TrainSupervisor` owns when its
+:class:`~mmlspark_tpu.train.service.ServiceConfig` carries a
+:class:`PublishPolicy`:
+
+* **on clean generation completion** the worker's result file (loss
+  history + final params) is judged by the pure
+  :class:`~mmlspark_tpu.lifecycle.evalgate.EvalGate`; a passing
+  checkpoint is converted to a ``ModelBundle`` (the policy's
+  ``bundle_from_result`` builder) and **dark-published** to the
+  :class:`~mmlspark_tpu.models.repo.ModelRepo` — the atomic publish +
+  digest verify already exist there — with provenance (source
+  checkpoint step, eval excerpt, publisher run/generation id) stamped
+  in the VERSION.json manifest. ``CURRENT`` does not move: flipping the
+  pointer is the :class:`~mmlspark_tpu.lifecycle.deployer.Deployer`'s
+  decision, on promotion.
+* **optionally every K checkpoints** (``every_k_checkpoints``) the
+  supervisor's sensor poll feeds the beacon eval series through the
+  same gate mid-run; publication then needs the policy's
+  ``bundle_from_checkpoint`` builder (an Orbax restore needs the
+  caller's target pytree — the supervisor cannot invent one).
+
+Every decision is journaled through :func:`lifecycle_journal` — the
+shared ``service/core.py`` journal discipline: ``decisions.jsonl`` on
+disk always, obs ``lifecycle/*`` events and ``lifecycle.rollouts`` /
+``lifecycle.rollbacks`` counters when the tracer is on.
+
+The publish itself is wrapped in the :data:`PUBLISH_FENCE_SPAN` obs
+span — the train→deployment-plane handoff fence. The worker emits the
+same span around its result write (``MMLSPARK_TPU_SERVICE_PUBLISH_FENCE``
+set by the supervisor when a publish policy is configured), so the two
+processes' fleet exports stitch into one Perfetto flow at exactly the
+moment the checkpoint changed hands (obs/fleet.py
+``FENCE_SPAN_NAMES``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+from typing import Any, Callable
+
+from mmlspark_tpu.core.logging_utils import get_logger
+from mmlspark_tpu.lifecycle.evalgate import (
+    EvalGate, EvalLedger, Publish, Reject,
+)
+from mmlspark_tpu.service.core import SupervisorJournal
+
+_log = get_logger(__name__)
+
+# the train→deployment-plane handoff fence (obs/fleet.py stitches
+# cross-process flows at this span name)
+PUBLISH_FENCE_SPAN = "lifecycle/publish_fence"
+
+# how many trailing eval points the manifest provenance carries
+EVAL_EXCERPT = 6
+
+
+def lifecycle_journal(directory: str) -> SupervisorJournal:
+    """The deployment plane's decision journal: one ``decisions.jsonl``
+    under ``directory`` (created), obs ``lifecycle/*`` events plus the
+    ``lifecycle.rollouts``/``lifecycle.rollbacks`` counters when the
+    tracer is enabled — the shared SupervisorJournal discipline
+    (service/core.py). The Publisher and the Deployer both write
+    through this, so pointing them at the same directory yields the
+    single cross-referenced journey the fleet timeline stitches."""
+    os.makedirs(directory, exist_ok=True)
+    return SupervisorJournal(
+        os.path.join(directory, "decisions.jsonl"),
+        event_prefix="lifecycle", cat="lifecycle",
+        counter_prefix="lifecycle.",
+        counter_kinds=("rollout", "rollback"),
+        log_label="lifecycle")
+
+
+def _fence_span():
+    """The publish-fence span when the tracer is on, else a no-op."""
+    from mmlspark_tpu import obs
+    if obs.enabled():
+        return obs.span(PUBLISH_FENCE_SPAN, "lifecycle")
+    return contextlib.nullcontext()
+
+
+def bundle_from_npz(result: dict, module: Any, input_spec: tuple,
+                    output_names: tuple = ("logits",)) -> Any:
+    """Rebuild a ``ModelBundle`` from a worker result file's params
+    export (``params_npz``: flat arrays keyed by ``/``-joined tree
+    paths, exactly what ``run_selftest_worker`` writes). The caller
+    supplies the module + IO contract — params files carry weights,
+    not architecture."""
+    import numpy as np
+
+    from mmlspark_tpu.models.bundle import ModelBundle
+
+    params: dict = {}
+    with np.load(result["params_npz"]) as npz:
+        for key in npz.files:
+            node = params
+            *parents, leaf = key.split("/")
+            for part in parents:
+                node = node.setdefault(part, {})
+            node[leaf] = np.asarray(npz[key])
+    return ModelBundle(module=module, params=params,
+                       input_spec=tuple(input_spec),
+                       output_names=tuple(output_names))
+
+
+@dataclasses.dataclass
+class PublishPolicy:
+    """What the supervisor publishes, where, and under which gate
+    (``ServiceConfig.publish``). ``bundle_from_result`` maps a worker
+    result dict to a publishable ``ModelBundle``;
+    ``bundle_from_checkpoint(checkpoint_dir, step)`` is the optional
+    mid-run builder for the every-K path. ``set_current=False`` (the
+    default) publishes dark — promotion flips ``CURRENT``."""
+
+    model: str
+    repo_root: str
+    gate: EvalGate = dataclasses.field(default_factory=EvalGate)
+    bundle_from_result: Callable[[dict], Any] | None = None
+    every_k_checkpoints: int | None = None
+    bundle_from_checkpoint: Callable[[str, int], Any] | None = None
+    set_current: bool = False
+    notes: str = ""
+    lifecycle_dir: str | None = None  # default: <service_dir>/lifecycle
+
+    def __post_init__(self) -> None:
+        if not self.model:
+            raise ValueError("publish policy needs a model name")
+        if self.every_k_checkpoints is not None \
+                and self.every_k_checkpoints < 1:
+            raise ValueError("every_k_checkpoints must be >= 1: "
+                             f"{self.every_k_checkpoints}")
+
+
+class Publisher:
+    """The supervisor-owned actuator over one :class:`PublishPolicy`:
+    holds the repo, the cross-decision :class:`EvalLedger`, and the
+    lifecycle journal. A publish that tears (the ``repo_torn_publish``
+    fault class) is journaled and kept pending — the repo's staging
+    discipline guarantees nothing partial became visible, so the next
+    :meth:`retry_pending` re-attempts cleanly."""
+
+    def __init__(self, policy: PublishPolicy, service_dir: str, *,
+                 run_id: str, train_journal: str | None = None):
+        from mmlspark_tpu.models.repo import ModelRepo
+        self.policy = policy
+        self.run_id = run_id
+        self.train_journal = train_journal
+        self.repo = ModelRepo(policy.repo_root)
+        self.ledger = EvalLedger()
+        self.directory = policy.lifecycle_dir or os.path.join(
+            service_dir, "lifecycle")
+        self.journal = lifecycle_journal(self.directory)
+        self.published: list[dict] = []
+        self._pending: tuple | None = None
+        self._gated_steps: set[int] = set()  # every-K bookkeeping
+
+    # -- completion-time publication --
+
+    def on_complete(self, generation: int, result: dict) -> dict | None:
+        """Judge a clean generation's eval series and publish the
+        result-file params on a pass. Returns the publication record
+        (also journaled) or None."""
+        with _fence_span():
+            series = [float(v) for v in (result.get("history") or ())]
+            step = int(result.get("steps", 0))
+            decision = self.policy.gate.decide(series, self.ledger)
+            if isinstance(decision, Reject):
+                return self._reject(generation, step, decision)
+            if self.policy.bundle_from_result is None:
+                self.journal.record("publish_skip", {
+                    "model": self.policy.model, "generation": generation,
+                    "step": step, "run_id": self.run_id,
+                    "reason": "no bundle_from_result builder"})
+                return None
+            bundle = self.policy.bundle_from_result(result)
+            return self._publish(bundle, generation, step, series,
+                                 decision)
+
+    # -- mid-run (every K checkpoints) publication --
+
+    def on_checkpoint_poll(self, generation: int,
+                           checkpoint_dir: str | None,
+                           series: list) -> dict | None:
+        """The supervisor's sensor-poll hook: when ``every_k_checkpoints``
+        is set and K new checkpoints have landed since the last
+        judgement, gate the beacon eval series; publish only when the
+        policy has a checkpoint builder."""
+        k = self.policy.every_k_checkpoints
+        if not k or not checkpoint_dir:
+            return None
+        from mmlspark_tpu.train.checkpoint import TrainCheckpointer
+        try:
+            steps = TrainCheckpointer(checkpoint_dir).steps()
+        except Exception:  # pragma: no cover - mid-write manifest
+            return None
+        new = [s for s in steps if s not in self._gated_steps]
+        if len(new) < k:
+            return None
+        step = new[-1]
+        self._gated_steps.update(new)
+        with _fence_span():
+            values = [float(v) for v in (series or ())]
+            decision = self.policy.gate.decide(values, self.ledger)
+            if isinstance(decision, Reject):
+                return self._reject(generation, step, decision,
+                                    mid_run=True)
+            if self.policy.bundle_from_checkpoint is None:
+                self.journal.record("publish_skip", {
+                    "model": self.policy.model, "generation": generation,
+                    "step": step, "run_id": self.run_id, "mid_run": True,
+                    "reason": "no bundle_from_checkpoint builder"})
+                return None
+            bundle = self.policy.bundle_from_checkpoint(checkpoint_dir,
+                                                        step)
+            return self._publish(bundle, generation, step, values,
+                                 decision, mid_run=True)
+
+    # -- the actuator --
+
+    def _reject(self, generation: int, step: int, decision: Reject,
+                mid_run: bool = False) -> None:
+        self.ledger.rejects += 1
+        payload = {"model": self.policy.model, "generation": generation,
+                   "step": step, "reason": decision.reason,
+                   "run_id": self.run_id}
+        if mid_run:
+            payload["mid_run"] = True
+        if self.train_journal:
+            payload["train_journal"] = self.train_journal
+        self.journal.record("publish_reject", payload)
+        return None
+
+    def _publish(self, bundle: Any, generation: int, step: int,
+                 series: list, decision: Publish,
+                 mid_run: bool = False) -> dict | None:
+        provenance = {
+            "checkpoint_step": step,
+            "eval": {"metric": decision.metric,
+                     "series_tail": [round(float(v), 6) for v in
+                                     series[-EVAL_EXCERPT:]],
+                     "points": len(series)},
+            "run_id": self.run_id,
+            "generation": generation,
+        }
+        if self.train_journal:
+            provenance["train_journal"] = self.train_journal
+        try:
+            version = self.repo.publish(
+                self.policy.model, bundle, notes=self.policy.notes,
+                provenance=provenance,
+                set_current=self.policy.set_current)
+        except Exception as e:
+            # the repo's staging discipline means nothing partial became
+            # visible — keep the candidate and let the next poll retry
+            self.journal.record("publish_torn", {
+                "model": self.policy.model, "generation": generation,
+                "step": step, "run_id": self.run_id,
+                "error": f"{type(e).__name__}: {e}"})
+            self._pending = (bundle, generation, step, series, decision,
+                             mid_run)
+            return None
+        self._pending = None
+        self.ledger.published.append((step, decision.metric))
+        record = {
+            "model": self.policy.model, "version": version,
+            "generation": generation, "step": step,
+            "metric": round(float(decision.metric), 6),
+            "dark": not self.policy.set_current,
+            "run_id": self.run_id, "reason": decision.reason,
+        }
+        if mid_run:
+            record["mid_run"] = True
+        if self.train_journal:
+            record["train_journal"] = self.train_journal
+        self.published.append(record)
+        self.journal.record("publish", record)
+        return record
+
+    def retry_pending(self) -> dict | None:
+        """Re-attempt a torn publish (None when nothing is pending)."""
+        if self._pending is None:
+            return None
+        bundle, generation, step, series, decision, mid_run = \
+            self._pending
+        return self._publish(bundle, generation, step, series, decision,
+                             mid_run=mid_run)

@@ -1,0 +1,161 @@
+"""Pure rollout policy: one version's ``published → shadow → canary →
+promoted`` progression as signal → action decisions.
+
+Same discipline as :class:`~mmlspark_tpu.train.service.RecoveryPolicy`
+(PR 11) and :class:`~mmlspark_tpu.serve.lifecycle.PromotionPolicy`
+(PR 13): the :class:`Deployer` samples its target (a single
+``ModelServer`` or the PR 19 fleet) into one typed
+:class:`RolloutSignal` per tick, the frozen :class:`RolloutPolicy`
+decides, and the deployer actuates — ledger mutation happens at the
+call site, never in the policy. The decision table
+(docs/lifecycle.md):
+
+==========================================  ========================
+signal                                      action
+==========================================  ========================
+serve side already rolled the canary back   abort (the burn engine
+(``action == "rollback"``)                  fired first — honor it)
+parity drift above tolerance                abort
+short-window burn ≥ ``fast_burn``           abort
+stage tick budget exhausted                 abort (a rollout that
+                                            cannot converge is a
+                                            failed rollout)
+unhealthy / no verdict                      hold, streak reset
+clean tick                                  bank it; ``advance_after``
+                                            consecutive clean ticks
+                                            advance the stage
+promoting stage, a backend still on the     hold (promotion blocks on
+old version                                 fleet convergence)
+promoting stage, every backend converged    advance → promoted
+==========================================  ========================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from mmlspark_tpu.serve.lifecycle import CanarySignal
+
+
+@dataclasses.dataclass(frozen=True)
+class RolloutSignal:
+    """One deployer tick's sensor reading: which stage the rollout is
+    in, the serve plane's canary sensors (None before any deploy), the
+    serve side's own lifecycle verdict this tick (``"hold"`` /
+    ``"rollback"`` / ``"promote"`` / None), and — for fleet targets —
+    whether every in-scope backend serves the target version yet."""
+
+    stage: str
+    serve: CanarySignal | None = None
+    action: str | None = None
+    converged: bool = True
+    lagging: tuple = ()
+    healthy: bool = True
+
+
+@dataclasses.dataclass
+class RolloutLedger:
+    """What the policy conditions on across ticks (mutated by the
+    deployer, never the policy)."""
+
+    stage: str = "publish"
+    ticks: int = 0
+    stage_ticks: int = 0
+    clean_ticks: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class Advance:
+    reason: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Hold:
+    reason: str = ""
+    clean: bool = False  # this tick banks toward advance_after
+
+
+@dataclasses.dataclass(frozen=True)
+class Abort:
+    reason: str
+
+
+Action = Any  # Advance | Hold | Abort
+
+
+@dataclasses.dataclass(frozen=True)
+class RolloutPolicy:
+    """Signal → action, pure (see module table). ``stages`` is the
+    traffic ramp between ``published`` and ``promoting``; fractions map
+    each stage to its mirror/split share of stable traffic."""
+
+    stages: tuple = ("shadow", "canary")
+    advance_after: int = 2
+    fast_burn: float = 14.0
+    parity_tolerance: float | None = None
+    shadow_fraction: float = 1.0
+    canary_fraction: float = 0.5
+    max_stage_ticks: int = 240
+
+    def __post_init__(self) -> None:
+        if self.advance_after < 1:
+            raise ValueError(
+                f"advance_after must be >= 1: {self.advance_after}")
+        if self.fast_burn <= 0:
+            raise ValueError(f"fast_burn must be > 0: {self.fast_burn}")
+        if self.max_stage_ticks < 1:
+            raise ValueError(
+                f"max_stage_ticks must be >= 1: {self.max_stage_ticks}")
+        for stage in self.stages:
+            if stage not in ("shadow", "canary"):
+                raise ValueError(f"unknown rollout stage {stage!r} "
+                                 "(stages are 'shadow' and 'canary')")
+
+    def fraction(self, stage: str) -> float:
+        return (self.shadow_fraction if stage == "shadow"
+                else self.canary_fraction)
+
+    def decide(self, sig: RolloutSignal, ledger: RolloutLedger) -> Action:
+        if sig.action == "rollback":
+            return Abort("serve-side lifecycle rolled the candidate "
+                         "back (burn/parity verdict)")
+        serve = sig.serve
+        if serve is not None:
+            if (serve.parity_drift is not None
+                    and serve.parity_tolerance is not None
+                    and serve.parity_drift > serve.parity_tolerance):
+                return Abort(
+                    f"parity drift {serve.parity_drift:.4g} exceeds "
+                    f"tolerance {serve.parity_tolerance:g} in "
+                    f"{sig.stage}")
+            if (serve.burn_short is not None
+                    and serve.burn_short >= self.fast_burn):
+                return Abort(
+                    f"fast-burn {serve.burn_short:.1f}x >= "
+                    f"{self.fast_burn:g}x in {sig.stage} "
+                    f"({serve.terminal_window} terminal)")
+        if ledger.stage_ticks >= self.max_stage_ticks:
+            return Abort(f"stage {sig.stage!r} exhausted its "
+                         f"{self.max_stage_ticks}-tick budget without "
+                         "converging")
+        if not sig.healthy:
+            return Hold(f"{sig.stage}: target unhealthy, streak reset")
+        if sig.stage == "promoting":
+            if not sig.converged:
+                lag = ",".join(str(b) for b in sig.lagging) or "?"
+                return Hold(f"promotion blocked: backend(s) {lag} "
+                            "still on the old version")
+            return Advance("every backend serves the target version")
+        if serve is None or (serve.burn_short is None
+                             and serve.parity_drift is None):
+            # mirrors PR 13's "no traffic ≠ healthy": a tick with no
+            # canary evidence neither banks nor advances
+            return Hold(f"{sig.stage}: no canary evidence yet, "
+                        "streak reset")
+        if ledger.clean_ticks + 1 >= self.advance_after:
+            return Advance(
+                f"{ledger.clean_ticks + 1} consecutive clean tick(s) "
+                f"in {sig.stage}")
+        return Hold(f"clean tick {ledger.clean_ticks + 1}/"
+                    f"{self.advance_after} in {sig.stage}", clean=True)

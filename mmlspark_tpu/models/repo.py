@@ -98,6 +98,38 @@ class RepoCorruptError(ModelRepoError):
         self.detail = detail
 
 
+def _provenance_error(prov: Any) -> str | None:
+    """Why ``prov`` is not a valid provenance stamp (None when it is).
+
+    The contract the lifecycle Publisher writes and every reader may
+    rely on: source checkpoint step, publisher run/generation id, and
+    (optionally) an eval metric excerpt. Checked at publish time (a
+    typed :class:`ModelRepoError` — never stage a bad manifest) and
+    re-checked on every :meth:`ModelRepo.verify`/``load`` (a
+    hand-edited manifest is :class:`RepoCorruptError`, same as a bad
+    digest)."""
+    if not isinstance(prov, dict):
+        return f"not an object ({type(prov).__name__})"
+    step = prov.get("checkpoint_step")
+    if not isinstance(step, int) or isinstance(step, bool) or step < 0:
+        return f"checkpoint_step missing or not a step: {step!r}"
+    run_id = prov.get("run_id")
+    if not isinstance(run_id, str) or not run_id:
+        return f"run_id missing or empty: {run_id!r}"
+    generation = prov.get("generation")
+    if not isinstance(generation, int) or isinstance(generation, bool) \
+            or generation < 0:
+        return f"generation missing or not an int: {generation!r}"
+    ev = prov.get("eval")
+    if ev is not None:
+        if not isinstance(ev, dict):
+            return f"eval excerpt not an object ({type(ev).__name__})"
+        metric = ev.get("metric")
+        if metric is not None and not isinstance(metric, (int, float)):
+            return f"eval.metric not a number: {metric!r}"
+    return None
+
+
 def _sha256_file(path: str) -> str:
     h = hashlib.sha256()
     with open(path, "rb") as f:
@@ -129,11 +161,16 @@ class ModelVersion:
     created: float
     digests: dict
     notes: str = ""
+    provenance: dict | None = None  # publisher-stamped: checkpoint
+    #                                 step, eval excerpt, run/generation
 
     def describe(self) -> dict:
-        return {"name": self.name, "version": self.version,
-                "kind": self.kind, "created": self.created,
-                "files": len(self.digests), "notes": self.notes}
+        out = {"name": self.name, "version": self.version,
+               "kind": self.kind, "created": self.created,
+               "files": len(self.digests), "notes": self.notes}
+        if self.provenance is not None:
+            out["provenance"] = dict(self.provenance)
+        return out
 
 
 class ModelRepo:
@@ -205,7 +242,8 @@ class ModelRepo:
     # -- publish --
 
     def publish(self, name: str, model: Any, notes: str = "",
-                set_current: bool = True) -> int:
+                set_current: bool = True,
+                provenance: dict | None = None) -> int:
         """Publish ``model`` (a ``ModelBundle``, or any stage with
         ``.save``) as the next version; returns the version number.
 
@@ -214,7 +252,10 @@ class ModelRepo:
         version or none of it. ``set_current=True`` (default) then
         repoints ``CURRENT`` atomically; ``False`` publishes a dark
         version (for canary-from-repo flows that flip the pointer only
-        on promotion)."""
+        on promotion). ``provenance`` stamps the publisher's identity
+        into the manifest — source checkpoint step, eval metric
+        excerpt, run/generation id (the lifecycle Publisher's contract,
+        docs/lifecycle.md) — re-validated on every :meth:`verify`."""
         from mmlspark_tpu.models.bundle import ModelBundle
         with self._lock:
             mdir = self._model_dir(name)
@@ -243,6 +284,13 @@ class ModelRepo:
                 manifest = {"name": name, "version": version,
                             "kind": kind, "created": time.time(),
                             "notes": notes, "files": digests}
+                if provenance is not None:
+                    err = _provenance_error(provenance)
+                    if err:
+                        raise ModelRepoError(
+                            f"model {name!r}: unpublishable "
+                            f"provenance — {err}")
+                    manifest["provenance"] = provenance
                 with open(os.path.join(tmp, VERSION_MANIFEST), "w",
                           encoding="utf-8") as f:
                     json.dump(manifest, f, indent=1)
@@ -318,11 +366,18 @@ class ModelRepo:
                     name, version,
                     f"digest mismatch on {rel!r} (manifest "
                     f"{want[:12]}…, got {got[:12]}…)")
+        provenance = manifest.get("provenance")
+        if provenance is not None:
+            err = _provenance_error(provenance)
+            if err:
+                raise RepoCorruptError(
+                    name, version, f"invalid provenance stamp — {err}")
         return ModelVersion(
             name=name, version=version, path=vdir,
             kind=manifest.get("kind", "bundle"),
             created=float(manifest.get("created", 0.0)),
-            digests=dict(files), notes=manifest.get("notes", ""))
+            digests=dict(files), notes=manifest.get("notes", ""),
+            provenance=provenance)
 
     def load(self, name: str, version: int | None = None
              ) -> tuple[Any, ModelVersion]:

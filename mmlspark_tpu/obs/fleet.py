@@ -86,7 +86,11 @@ EXPORTER_THREAD = "FleetExporter"
 #: participating process exits the underlying collective at the same
 #: real instant, which is what makes these spans both the skew
 #: CORRECTION anchor and the cross-process flow STITCH points
-FENCE_SPAN_NAMES = ("train/liveness_sync", "serve/lockstep_agree")
+# lifecycle/publish_fence is the train→deployment-plane handoff: the
+# worker brackets its result write, the supervisor's Publisher brackets
+# its read+gate+publish (mmlspark_tpu/lifecycle/publish.py)
+FENCE_SPAN_NAMES = ("train/liveness_sync", "serve/lockstep_agree",
+                    "lifecycle/publish_fence")
 
 _PROC_DIR_RE = re.compile(r"^proc_(?P<host>.+)_(?P<pid>\d+)$")
 _SNAP_RE = re.compile(r"^snap_(?P<seq>\d{6})\.json$")

@@ -55,6 +55,9 @@ class Backend:
     inflight: int = 0        # router-leased predict requests
     streams: int = 0         # active :generate streams (affinity holds)
     hold_until: float = 0.0  # monotonic Retry-After hold expiry
+    versions: dict = dataclasses.field(default_factory=dict)
+    #   served {model: repo version} from the beacon — the rollout-
+    #   convergence surface the lifecycle deployer blocks promotion on
 
     @property
     def address(self) -> tuple[str, int]:
@@ -93,7 +96,8 @@ class BackendPool:
     # -- membership (the supervisor's side) --
 
     def add(self, bid: int, host: str, port: int,
-            generation: int = 0) -> None:
+            generation: int = 0,
+            versions: dict | None = None) -> None:
         """Register or refresh a backend. A re-add after a restart (new
         port/generation) clears the down state and any stale hold; a
         re-add of a DRAINING backend keeps it draining (a beacon
@@ -102,12 +106,15 @@ class BackendPool:
         with self._lock:
             b = self._backends.get(bid)
             if b is None:
-                self._backends[bid] = Backend(bid, host, port,
-                                              generation)
+                self._backends[bid] = Backend(
+                    bid, host, port, generation,
+                    versions=dict(versions or {}))
                 return
             restarted = (b.port != port or b.generation != generation
                          or b.host != host)
             b.host, b.port, b.generation = host, port, generation
+            if versions is not None:
+                b.versions = dict(versions)
             if b.state == "down" or restarted:
                 b.state = "up" if b.state != "draining" else b.state
                 b.hold_until = 0.0
@@ -228,5 +235,6 @@ class BackendPool:
                 "generation": b.generation, "state": b.state,
                 "inflight": b.inflight, "streams": b.streams,
                 "held_s": round(max(0.0, b.hold_until - now), 3),
+                "versions": dict(b.versions),
             } for b in sorted(self._backends.values(),
                               key=lambda b: b.bid)]

@@ -84,6 +84,10 @@ WATCH_THREAD = "ServeFleetWatch"
 # does not find the worker module pre-imported by the package __init__)
 ENV_SLO = "MMLSPARK_TPU_SERVE_FLEET_SLO"
 ENV_MAX_QUEUE = "MMLSPARK_TPU_SERVE_FLEET_MAX_QUEUE"
+ENV_REPO = "MMLSPARK_TPU_SERVE_FLEET_REPO"  # model repo root: workers
+#   serve every repo model's CURRENT version at boot and accept
+#   versioned hot-swap commands from the lifecycle deployer's
+#   deploy.json (serve/fleet/worker.py watches it each beacon tick)
 
 
 def _default_worker_cmd() -> list[str]:
@@ -131,6 +135,7 @@ class FleetConfig:
     #   can beacon at all, so startup gets its own allowance — the
     #   beacon_timeout_s stall deadline applies once it has beaconed
     compile_cache: str | None = None       # → MMLSPARK_TPU_COMPILE_CACHE
+    repo: str | None = None                # → ENV_REPO (lifecycle repo)
     slo: dict | None = None                # → worker ServeConfig.slo
     max_queue: int | None = None           # → worker ServeConfig.max_queue
     worker_obs: bool = True
@@ -249,9 +254,23 @@ class ServeSupervisor:
 
     def status(self) -> dict:
         """Point-in-time fleet view (CLI/debugging; the pool snapshot is
-        the authoritative routing table)."""
+        the authoritative routing table). ``rollout`` condenses the
+        beacon-reported served versions into the convergence view the
+        lifecycle deployer blocks fleet-wide promotion on: a model is
+        converged when every up backend serves the same repo version."""
+        backends = self.pool.snapshot()
+        by_model: dict[str, set] = {}
+        for row in backends:
+            if row["state"] != "up":
+                continue
+            for model, version in row["versions"].items():
+                by_model.setdefault(model, set()).add(version)
         return {
-            "backends": self.pool.snapshot(),
+            "backends": backends,
+            "rollout": {
+                model: {"converged": len(vs) == 1,
+                        "versions": sorted(vs)}
+                for model, vs in sorted(by_model.items())},
             "respawns_pending": len(self._respawns),
             "scale_ups": self._fleet_ledger.scale_ups,
             "scale_downs": self._fleet_ledger.scale_downs,
@@ -274,6 +293,8 @@ class ServeSupervisor:
         env[ENV_GENERATION] = str(generation)
         if self.cfg.compile_cache:
             env["MMLSPARK_TPU_COMPILE_CACHE"] = self.cfg.compile_cache
+        if self.cfg.repo:
+            env[ENV_REPO] = self.cfg.repo
         if self.cfg.slo is not None:
             env[ENV_SLO] = json.dumps(self.cfg.slo)
         if self.cfg.max_queue is not None:
@@ -420,7 +441,8 @@ class ServeSupervisor:
                 # resurrected by a late beacon (pool.add preserves it)
                 self.pool.add(bid, str(beacon.get("host", "127.0.0.1")),
                               int(beacon.get("port", 0)),
-                              generation=b.generation)
+                              generation=b.generation,
+                              versions=beacon.get("versions"))
             if not b.draining:
                 burns.append(float(beacon.get("burn_short", 0.0)))
                 occs.append(float(beacon.get("occupancy", 0.0)))

@@ -38,9 +38,12 @@ import time
 import numpy as np
 
 from mmlspark_tpu.core.logging_utils import get_logger
-from mmlspark_tpu.serve.fleet.supervisor import ENV_MAX_QUEUE, ENV_SLO
+from mmlspark_tpu.serve.fleet.supervisor import ENV_MAX_QUEUE, \
+    ENV_REPO, ENV_SLO
 
 _log = get_logger(__name__)
+
+DEPLOY_FILE = "deploy.json"  # the lifecycle deployer's command channel
 
 MODEL_NAME = "cnn"
 SELFTEST_BUCKETS = (1, 8)
@@ -110,12 +113,91 @@ def build_server():
                              slots=4, t_max=GEN_T_MAX,
                              prefill_buckets=(4, 8), prefill_rows=2,
                              max_new_tokens=16, max_queue=64))
+    repo_root = os.environ.get(ENV_REPO)
+    if repo_root:
+        _serve_repo_models(server, repo_root)
     return server
 
 
-def _beacon_sample(info, server, port: int, status: str) -> dict:
+def _serve_repo_models(server, repo_root: str) -> None:
+    """Serve every repo model's CURRENT version (digest-verified by
+    ``add_model_from_repo``; a ModelBundle auto-wraps to a JaxModel with
+    the bundle's own input/output columns). A model that fails to load
+    is skipped with a warning — one corrupt publish must not keep the
+    whole backend from coming up; the beacon's ``versions`` map simply
+    won't list it, which the deployer reads as non-convergence."""
+    from mmlspark_tpu.models.repo import ModelRepo
+
+    repo = ModelRepo(repo_root)
+    for name in repo.models():
+        try:
+            server.add_model_from_repo(repo, name)
+        except Exception as e:
+            _log.warning("fleet backend: repo model %r skipped: %s",
+                         name, e)
+
+
+class _DeployWatcher:
+    """Apply versioned hot-swap commands from the lifecycle deployer.
+
+    The deployer (``lifecycle/deployer.py`` :class:`FleetTarget`) writes
+    ``<service_dir>/deploy.json`` — ``{"seq", "model", "version",
+    "repo", "backends"}`` — atomically; each backend polls it every
+    beacon interval and applies each NEW seq addressed to it (scope
+    ``"all"`` or an explicit bid list) via ``add_model_from_repo``:
+    digests verify before anything deserializes, the flip is the
+    server's own zero-drop swap. A failed apply is reported in the
+    beacon (``deploy_error``) and NOT retried for the same seq — the
+    beacon's ``versions`` map stays on the old version, the deployer
+    reads that as non-convergence and its policy decides (hold until
+    ``max_stage_ticks``, then abort → rollback)."""
+
+    def __init__(self, info, server):
+        self.info = info
+        self.server = server
+        self.path = os.path.join(info.service_dir, DEPLOY_FILE)
+        self.seq = 0
+        self.error: str | None = None
+
+    def poll(self) -> None:
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                cmd = json.load(f)
+            seq = int(cmd.get("seq", 0))
+        except (OSError, ValueError, TypeError):
+            return
+        if seq <= self.seq:
+            return
+        self.seq = seq
+        scope = cmd.get("backends")
+        if scope != "all" and self.info.rank not in (scope or ()):
+            return
+        try:
+            self.server.add_model_from_repo(
+                str(cmd["repo"]), str(cmd["model"]),
+                version=int(cmd["version"]))
+            self.error = None
+            _log.info("fleet backend %d: deploy seq %d → %s v%d",
+                      self.info.rank, seq, cmd["model"],
+                      int(cmd["version"]))
+        except Exception as e:
+            self.error = f"{type(e).__name__}: {e}"
+            _log.warning("fleet backend %d: deploy seq %d failed: %s",
+                         self.info.rank, seq, self.error)
+
+    def describe(self) -> dict:
+        out: dict = {"deploy_seq": self.seq}
+        if self.error:
+            out["deploy_error"] = self.error
+        return out
+
+
+def _beacon_sample(info, server, port: int, status: str,
+                   deploy: _DeployWatcher | None = None) -> dict:
     """One beacon payload: identity + port + the autoscaler's sensors
-    + the fleet-merge counter excerpt + compile-cache stats."""
+    + the fleet-merge counter excerpt + compile-cache stats + the
+    served ``{model: repo version}`` map (the deployer's rollout-
+    convergence sensor)."""
     from mmlspark_tpu.core import compile_cache as _cc
     from mmlspark_tpu.obs.metrics import Counter as _ObsCounter
     from mmlspark_tpu.obs.metrics import registry as _obs_registry
@@ -128,7 +210,17 @@ def _beacon_sample(info, server, port: int, status: str) -> dict:
         "model": MODEL_NAME,
         "burn_short": 0.0, "occupancy": 0.0,
         "counters": [], "compile_cache": None,
+        "versions": {},
     }
+    if deploy is not None:
+        sample.update(deploy.describe())
+    try:
+        sample["versions"] = {
+            name: snap["version"]
+            for name, snap in server.snapshot().items()
+            if isinstance(snap, dict) and "version" in snap}
+    except Exception:  # pragma: no cover - beacon never kills the worker
+        pass
     try:
         # each beacon is one SLO sample per model (registry reads only)
         # — the sampling cadence that feeds the supervisor's
@@ -174,6 +266,7 @@ def run_backend_worker(beacon_interval_s: float = 0.25) -> int:
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
 
     server = build_server()
+    deploy = _DeployWatcher(info, server)
     httpd = start_http_server(server, host="127.0.0.1", port=0,
                               identity=f"backend-{info.rank}")
     port = int(httpd.server_address[1])
@@ -181,16 +274,19 @@ def run_backend_worker(beacon_interval_s: float = 0.25) -> int:
               info.rank, info.generation, port)
     try:
         while not stop.wait(beacon_interval_s):
+            deploy.poll()
             try:
                 atomic_write_json(
                     info.beacon_path(),
-                    _beacon_sample(info, server, port, "running"))
+                    _beacon_sample(info, server, port, "running",
+                                   deploy=deploy))
             except Exception:  # pragma: no cover - beacon never kills
                 pass           # the worker it reports on
         # zero-drop drain: announce, stop admitting, finish what's
         # queued/in flight, then the terminal beacon
         atomic_write_json(info.beacon_path(),
-                          _beacon_sample(info, server, port, "draining"))
+                          _beacon_sample(info, server, port, "draining",
+                                         deploy=deploy))
         server.close(drain=True)
     finally:
         httpd.shutdown()
@@ -198,7 +294,7 @@ def run_backend_worker(beacon_interval_s: float = 0.25) -> int:
         try:
             atomic_write_json(info.beacon_path(),
                               _beacon_sample(info, server, port,
-                                             "exited"))
+                                             "exited", deploy=deploy))
         except Exception:  # pragma: no cover - best-effort terminal
             pass
     return 0

@@ -898,6 +898,23 @@ class ModelServer:
             return {"action": "rollback", **detail}
         return None
 
+    def promote(self, name: str, reason: str = "manual") -> dict | None:
+        """Promote ``name``'s candidate to stable now — the flip an
+        external rollout driver (the lifecycle Deployer) commands once
+        its own policy is satisfied, same atomic entry-swap as a
+        burn-engine promotion; None when no canary is deployed."""
+        entry = self._entry(name)
+        canary = entry.canary
+        if canary is None:
+            return None
+        detail = {"model": name, "version": canary.version,
+                  "mode": canary.mode, "reason": reason}
+        drain = self._promote(entry, canary, detail)
+        if drain is not None:
+            drain.close(drain=True)
+            return {"action": "promote", **detail}
+        return None
+
     def _end_canary(self, entry: _ModelEntry, canary: Any,
                     kind: str, detail: dict) -> Any | None:
         """Atomically detach the canary; returns its batcher for the

@@ -654,6 +654,16 @@ class Trainer:
                   dict(zip(new_mesh.axis_names, new_mesh.devices.shape)))
         return self
 
+    def _note_loss(self, value: float) -> None:
+        """Record one logged loss: appended to ``self.history`` AND
+        published to the windowed ``train.loss`` histogram
+        (tracer-gated) — the eval series the service beacon exports to
+        the supervisor, where the lifecycle ``EvalGate`` judges it
+        (docs/lifecycle.md)."""
+        self.history.append(value)
+        if _obs_rt._enabled:
+            _obs_registry().histogram("train.loss").observe(float(value))
+
     def fit_arrays(self, x: np.ndarray, y: np.ndarray) -> "Trainer":
         """Train on host arrays.
 
@@ -811,15 +821,15 @@ class Trainer:
                                 (time.perf_counter() - t_step) * 1e3)
                     if i % cfg.log_every == 0:
                         if pending is not None:
-                            self.history.append(sentinel.check(
+                            self._note_loss(sentinel.check(
                                 pending[0], float(pending[1])))  # lint-jax: allow(JX105) — one-step-lagged fetch
                         pending = (gs, metrics["loss"])
                     if (ckpt is not None and cfg.checkpoint_every > 0
                             and gs % cfg.checkpoint_every == 0):
                         self.save_checkpoint()
             if pending is not None:
-                self.history.append(sentinel.check(pending[0],
-                                                   float(pending[1])))
+                self._note_loss(sentinel.check(pending[0],
+                                               float(pending[1])))
                 pending = None
         except BaseException as e:
             # the post-mortem happens AT the failure point, before any
@@ -1051,7 +1061,7 @@ class Trainer:
                             straggler.observe(dur_ms)
                     if (gs - 1) % cfg.log_every == 0:
                         if pending is not None:
-                            self.history.append(sentinel.check(
+                            self._note_loss(sentinel.check(
                                 pending[0], float(pending[1])))  # lint-jax: allow(JX105) — one-step-lagged fetch
                         pending = (gs, metrics["loss"])
                     if (ckpt is not None and cfg.checkpoint_every > 0
@@ -1065,8 +1075,8 @@ class Trainer:
                     # checkpoint barrier across processes
                     loader.note_dispatched()
             if pending is not None:
-                self.history.append(sentinel.check(pending[0],
-                                                   float(pending[1])))
+                self._note_loss(sentinel.check(pending[0],
+                                               float(pending[1])))
                 pending = None
         except BaseException as e:
             _obs_flight.on_crash(e, context="Trainer.fit_stream")

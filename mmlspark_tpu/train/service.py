@@ -48,6 +48,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import json
 import os
 import shutil
 import subprocess
@@ -80,6 +81,10 @@ ENV_WORLD = "MMLSPARK_TPU_SERVICE_WORLD"
 ENV_GENERATION = "MMLSPARK_TPU_SERVICE_GENERATION"
 ENV_DEVICES = "MMLSPARK_TPU_SERVICE_DEVICES"
 ENV_CKPT = "MMLSPARK_TPU_SERVICE_CKPT"
+# set when the supervisor carries a publish policy: the worker brackets
+# its result handoff in the lifecycle publish-fence span so worker and
+# publisher stitch into one fleet-timeline flow (obs/fleet.py)
+ENV_PUBLISH_FENCE = "MMLSPARK_TPU_SERVICE_PUBLISH_FENCE"
 
 # the exit code a preempted worker dies with (EX_TEMPFAIL): policy
 # default treats it as PERMANENT capacity loss → immediate re-scale,
@@ -254,6 +259,11 @@ class ServiceBeacon:
                 sample["stragglers"] += int(m.value)
             elif m.name == "train.host_step_ms":
                 sample["host_step_ms"][str(labels.get("host"))] = m.value
+            elif m.name == "train.loss" and hasattr(m, "values"):
+                # the eval series (Trainer._note_loss publishes every
+                # logged loss into this windowed histogram) — what the
+                # supervisor's lifecycle EvalGate judges mid-run
+                sample["eval"] = [float(v) for v in m.values()]
             if isinstance(m, _ObsCounter) \
                     and m.name.startswith("train."):
                 sample["counters"].append([m.name, labels, m.value])
@@ -492,6 +502,12 @@ class ServiceConfig:
     #                                 for audit/bit-compat verification)
     coordinator: str | None = None  # world>1: host:port of rank 0
     extra_env: dict[str, str] = dataclasses.field(default_factory=dict)
+    publish: Any | None = None   # lifecycle.PublishPolicy: eval-gate and
+    #                              dark-publish passing checkpoints to a
+    #                              ModelRepo on clean completion (and
+    #                              optionally every K checkpoints) —
+    #                              the train→serve deployment plane
+    #                              (docs/lifecycle.md)
 
     def __post_init__(self) -> None:
         if not self.topologies:
@@ -569,6 +585,15 @@ class TrainSupervisor:
                            "hang"),
             log_label="train service")
         self._straggler_total = 0  # global verdict windows this generation
+        self._publisher = None
+        if cfg.publish is not None:
+            # lazy import: supervising plain training jobs must not pull
+            # the lifecycle/models planes in
+            from mmlspark_tpu.lifecycle.publish import Publisher
+            self._publisher = Publisher(
+                cfg.publish, cfg.service_dir,
+                run_id=f"train-{os.getpid()}-{int(time.time())}",
+                train_journal=self._decisions_path)
 
     # -- observability of the supervisor itself --
 
@@ -622,6 +647,8 @@ class TrainSupervisor:
                 env["MMLSPARK_TPU_PROCESS_ID"] = str(rank)
             if self.cfg.worker_obs:
                 env.setdefault("MMLSPARK_TPU_OBS", "1")
+            if self._publisher is not None:
+                env.setdefault(ENV_PUBLISH_FENCE, "1")
             if self.cfg.worker_flight:
                 env.setdefault("MMLSPARK_TPU_FLIGHT", os.path.join(
                     self.cfg.service_dir, "flight",
@@ -844,7 +871,59 @@ class TrainSupervisor:
             sig = self._poll_sensors(generation, workers)
             if sig is not None:
                 return sig
+            if self._publisher is not None:
+                self._publish_poll(generation)
             time.sleep(self.cfg.poll_s)
+
+    # -- eval-gated publication (the lifecycle deployment plane) --
+
+    def _publish_poll(self, generation: int) -> None:
+        """Mid-run publication sensors, ridden on the watch loop: retry
+        a torn publish, then feed the every-K-checkpoints gate off
+        rank 0's beacon eval series (docs/lifecycle.md). Never raises —
+        a broken publish hook must not take supervision down."""
+        pub = self._publisher
+        try:
+            record = pub.retry_pending()
+            if record is None:
+                beacon = self._read_beacon(generation, 0) or {}
+                record = pub.on_checkpoint_poll(
+                    generation, self.cfg.checkpoint_dir,
+                    beacon.get("eval") or [])
+            if record:
+                self._record("publish", {
+                    "generation": generation, "model": record["model"],
+                    "version": record["version"],
+                    "lifecycle_journal": pub.journal.path})
+        except Exception as e:  # pragma: no cover - defensive
+            _log.warning("train service: publish poll failed: %s", e)
+
+    def _publish_complete(self, generation: int) -> None:
+        """Clean-completion publication: judge rank 0's result file
+        (the worker bracketed its write in the publish-fence span; the
+        gate + publish here is the other side of that fence). The
+        cross-reference lands in BOTH journals: the lifecycle record
+        carries the train decisions path, this record carries the
+        lifecycle decisions path."""
+        pub = self._publisher
+        if pub is None:
+            return
+        try:
+            pub.retry_pending()
+            path = os.path.join(
+                self.cfg.service_dir,
+                f"result_gen{generation}_rank0.json")
+            with open(path, encoding="utf-8") as f:
+                result = json.load(f)
+            record = pub.on_complete(generation, result)
+            if record:
+                self._record("publish", {
+                    "generation": generation, "model": record["model"],
+                    "version": record["version"],
+                    "lifecycle_journal": pub.journal.path})
+        except Exception as e:
+            _log.warning("train service: completion publish failed: %s",
+                         e)
 
     def _snapshot(self, generation: int) -> str | None:
         """Archive the checkpoint dir at the recovery point — the state
@@ -905,6 +984,7 @@ class TrainSupervisor:
                         self._fleet_aggregates(beacons))
                     self._forget(workers)
                     workers = []
+                    self._publish_complete(generation)
                     report.ok = True
                     report.reason = (
                         f"completed at rung {ledger.rung} "
@@ -1031,7 +1111,11 @@ def run_selftest_worker() -> int:
         mesh = make_mesh(MeshSpec(
             dp=-1, fsdp=2 if n_dev % 2 == 0 else 1))
         cfg = selftest_config(info.checkpoint_dir)
-        x, y = selftest_data()
+        # a non-default data seed degrades the run on purpose (different
+        # data → different trained params): how the lifecycle gate
+        # manufactures a candidate whose answers drift from the fleet's
+        x, y = selftest_data(seed=int(os.environ.get(
+            "MMLSPARK_TPU_SERVICE_SELFTEST_DATA_SEED", "0")))
 
         die_at = int(os.environ.get("MMLSPARK_TPU_SERVICE_DIE_AT_STEP",
                                     "0"))
@@ -1072,14 +1156,25 @@ def run_selftest_worker() -> int:
         np.savez(params_path, **{
             "/".join(str(getattr(k, "key", k)) for k in path):
                 host_full(leaf) for path, leaf in flat})
-        _atomic_write_json(info.result_path(), {
-            "rank": info.rank, "world": info.world,
-            "generation": info.generation, "devices": n_dev,
-            "mesh": {a: int(s) for a, s in
-                     zip(mesh.axis_names, mesh.devices.shape)},
-            "steps": steps,
-            "resumed": steps - len(tr.history),
-            "history": [float(v) for v in tr.history],
-            "params_npz": params_path,
-        })
+        # the result write is the train→deployment-plane handoff: when a
+        # publisher is listening (ENV_PUBLISH_FENCE) and the tracer is
+        # on, bracket it in the publish-fence span — the supervisor's
+        # Publisher brackets its read+gate+publish in the same span, so
+        # the two processes' fleet exports stitch into one flow
+        fence_cm = contextlib.nullcontext()
+        if os.environ.get(ENV_PUBLISH_FENCE) and _obs_rt._enabled:
+            from mmlspark_tpu.obs.spans import span as _obs_span
+            from mmlspark_tpu.lifecycle.publish import PUBLISH_FENCE_SPAN
+            fence_cm = _obs_span(PUBLISH_FENCE_SPAN, "lifecycle")
+        with fence_cm:
+            _atomic_write_json(info.result_path(), {
+                "rank": info.rank, "world": info.world,
+                "generation": info.generation, "devices": n_dev,
+                "mesh": {a: int(s) for a, s in
+                         zip(mesh.axis_names, mesh.devices.shape)},
+                "steps": steps,
+                "resumed": steps - len(tr.history),
+                "history": [float(v) for v in tr.history],
+                "params_npz": params_path,
+            })
     return 0

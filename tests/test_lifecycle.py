@@ -1,0 +1,455 @@
+"""The deployment plane (mmlspark_tpu/lifecycle): eval-gated
+publication, provenance stamps, the rollout state machine, torn-publish
+recovery, fleet convergence, and journal replay (docs/lifecycle.md)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from mmlspark_tpu.lifecycle import (
+    Abort, Advance, Deployer, EvalGate, EvalLedger, FleetTarget, Hold,
+    Publish, Publisher, PublishPolicy, Reject, RolloutLedger,
+    RolloutPolicy, RolloutSignal, bundle_from_npz, replay_decisions,
+)
+from mmlspark_tpu.models import ModelBundle, ModelRepo, RepoCorruptError
+from mmlspark_tpu.models.repo import ModelRepoError
+from mmlspark_tpu.models.zoo import MLP
+from mmlspark_tpu.serve import faults
+from mmlspark_tpu.serve.faults import FaultPlan, FaultSpec
+from mmlspark_tpu.serve.lifecycle import CanarySignal
+
+
+def mlp_bundle(seed=0, in_dim=6):
+    module = MLP(features=(8,), num_outputs=4)
+    params = module.init(jax.random.PRNGKey(seed),
+                         np.zeros((1, in_dim), np.float32))["params"]
+    return ModelBundle(
+        module=module,
+        params=jax.tree_util.tree_map(np.asarray, params),
+        input_spec=(in_dim,), output_names=("features", "logits"),
+        name="mlp")
+
+
+def good_provenance(step=10):
+    return {"checkpoint_step": step, "run_id": "train-1",
+            "generation": 0, "eval": {"metric": 0.25}}
+
+
+def journal_kinds(path):
+    with open(path, encoding="utf-8") as f:
+        return [json.loads(line)["kind"] for line in f if line.strip()]
+
+
+# ---------------------------------------------------------------- gate
+
+class TestEvalGate:
+    def test_needs_enough_evidence(self):
+        gate = EvalGate(min_points=4, tail=4)
+        d = gate.decide([1.0, 0.9], EvalLedger())
+        assert isinstance(d, Reject) and "need >= 4" in d.reason
+
+    def test_diverged_runs_never_ship(self):
+        gate = EvalGate(min_points=2, tail=2)
+        d = gate.decide([1.0, float("nan"), 0.5, 0.4], EvalLedger())
+        assert isinstance(d, Reject) and "non-finite" in d.reason
+
+    def test_quality_floor(self):
+        gate = EvalGate(min_points=2, tail=2, max_metric=0.1)
+        d = gate.decide([1.0, 0.9, 0.5, 0.4], EvalLedger())
+        assert isinstance(d, Reject) and "quality floor" in d.reason
+
+    def test_training_that_went_nowhere(self):
+        gate = EvalGate(min_points=2, tail=2)
+        d = gate.decide([0.5, 0.5, 0.6, 0.7], EvalLedger())
+        assert isinstance(d, Reject) and "did not improve" in d.reason
+
+    def test_regression_vs_best_published(self):
+        gate = EvalGate(min_points=2, tail=2)
+        ledger = EvalLedger(published=[(10, 0.1)])
+        d = gate.decide([1.0, 0.9, 0.5, 0.4], ledger)
+        assert isinstance(d, Reject) and "regresses" in d.reason
+
+    def test_publish_carries_the_tail_mean(self):
+        gate = EvalGate(min_points=2, tail=2)
+        d = gate.decide([1.0, 0.9, 0.5, 0.3], EvalLedger())
+        assert isinstance(d, Publish)
+        assert d.metric == pytest.approx(0.4)
+
+    def test_policy_validation(self):
+        with pytest.raises(ValueError):
+            EvalGate(min_points=0)
+        with pytest.raises(ValueError):
+            EvalGate(min_improvement=-0.1)
+
+
+# -------------------------------------------------------------- policy
+
+def sig(stage="shadow", burn=0.0, drift=None, tol=None, **kw):
+    return RolloutSignal(
+        stage=stage,
+        serve=CanarySignal(burn_short=burn, parity_drift=drift,
+                           parity_tolerance=tol), **kw)
+
+
+class TestRolloutPolicy:
+    def test_serve_side_rollback_is_honored(self):
+        pol = RolloutPolicy()
+        a = pol.decide(RolloutSignal(stage="shadow", action="rollback"),
+                       RolloutLedger(stage="shadow"))
+        assert isinstance(a, Abort)
+
+    def test_parity_drift_aborts(self):
+        a = RolloutPolicy().decide(sig(drift=0.5, tol=1e-3),
+                                   RolloutLedger(stage="shadow"))
+        assert isinstance(a, Abort) and "parity drift" in a.reason
+
+    def test_fast_burn_aborts(self):
+        a = RolloutPolicy(fast_burn=14.0).decide(
+            sig(burn=20.0), RolloutLedger(stage="shadow"))
+        assert isinstance(a, Abort) and "fast-burn" in a.reason
+
+    def test_stage_budget_aborts(self):
+        led = RolloutLedger(stage="shadow", stage_ticks=240)
+        a = RolloutPolicy(max_stage_ticks=240).decide(sig(), led)
+        assert isinstance(a, Abort) and "budget" in a.reason
+
+    def test_unhealthy_holds_and_resets(self):
+        a = RolloutPolicy().decide(sig(healthy=False),
+                                   RolloutLedger(stage="shadow"))
+        assert isinstance(a, Hold) and not a.clean
+
+    def test_no_evidence_neither_banks_nor_advances(self):
+        a = RolloutPolicy().decide(
+            RolloutSignal(stage="shadow", serve=None),
+            RolloutLedger(stage="shadow"))
+        assert isinstance(a, Hold) and not a.clean
+
+    def test_clean_streak_advances(self):
+        pol = RolloutPolicy(advance_after=2)
+        led = RolloutLedger(stage="shadow")
+        a1 = pol.decide(sig(), led)
+        assert isinstance(a1, Hold) and a1.clean
+        led.clean_ticks = 1
+        a2 = pol.decide(sig(), led)
+        assert isinstance(a2, Advance)
+
+    def test_promotion_blocks_on_lagging_backends(self):
+        pol = RolloutPolicy()
+        led = RolloutLedger(stage="promoting")
+        a = pol.decide(RolloutSignal(stage="promoting", converged=False,
+                                     lagging=(1, 2)), led)
+        assert isinstance(a, Hold) and "1,2" in a.reason
+        a = pol.decide(RolloutSignal(stage="promoting", converged=True),
+                       led)
+        assert isinstance(a, Advance)
+
+    def test_stage_names_validated(self):
+        with pytest.raises(ValueError):
+            RolloutPolicy(stages=("blue_green",))
+
+
+# ---------------------------------------------------------- provenance
+
+class TestProvenance:
+    def test_roundtrips_through_the_manifest(self, tmp_path):
+        repo = ModelRepo(str(tmp_path))
+        prov = good_provenance()
+        v = repo.publish("mlp", mlp_bundle(), provenance=prov)
+        _, info = repo.load("mlp", v)
+        assert info.provenance == prov
+        assert info.describe()["provenance"] == prov
+
+    def test_unpublishable_stamp_is_refused(self, tmp_path):
+        repo = ModelRepo(str(tmp_path))
+        for bad in ({"run_id": "x"},                       # no step
+                    {**good_provenance(), "checkpoint_step": -1},
+                    {**good_provenance(), "run_id": ""},
+                    {**good_provenance(), "eval": {"metric": "hi"}}):
+            with pytest.raises(ModelRepoError):
+                repo.publish("mlp", mlp_bundle(), provenance=bad)
+        assert repo.versions("mlp") == []
+
+    def test_tampered_stamp_fails_verification(self, tmp_path):
+        from mmlspark_tpu.models.repo import VERSION_MANIFEST
+        repo = ModelRepo(str(tmp_path))
+        v = repo.publish("mlp", mlp_bundle(),
+                         provenance=good_provenance())
+        mpath = os.path.join(repo._version_dir("mlp", v),
+                             VERSION_MANIFEST)
+        with open(mpath, encoding="utf-8") as f:
+            manifest = json.load(f)
+        manifest["provenance"]["checkpoint_step"] = -5
+        with open(mpath, "w", encoding="utf-8") as f:
+            json.dump(manifest, f)
+        with pytest.raises(RepoCorruptError):
+            repo.load("mlp", v)
+
+
+# ----------------------------------------------------------- publisher
+
+def result_dict(tmp_path, history, steps=16):
+    return {"history": history, "steps": steps,
+            "params_npz": str(tmp_path / "params.npz")}
+
+
+class TestPublisher:
+    def policy(self, tmp_path, **kw):
+        kw.setdefault("gate", EvalGate(min_points=2, tail=2))
+        kw.setdefault("bundle_from_result",
+                      lambda result: mlp_bundle(seed=1))
+        return PublishPolicy(model="mlp", repo_root=str(tmp_path / "repo"),
+                             **kw)
+
+    def test_pass_publishes_dark_with_provenance(self, tmp_path):
+        repo = ModelRepo(str(tmp_path / "repo"))
+        repo.publish("mlp", mlp_bundle(seed=0))  # v1 = CURRENT
+        pub = Publisher(self.policy(tmp_path), str(tmp_path / "svc"),
+                        run_id="train-run", train_journal="tj.jsonl")
+        rec = pub.on_complete(0, result_dict(tmp_path,
+                                             [1.0, 0.8, 0.5, 0.4]))
+        assert rec is not None and rec["version"] == 2 and rec["dark"]
+        assert repo.current_version("mlp") == 1  # dark: CURRENT held
+        _, info = repo.load("mlp", 2)
+        assert info.provenance["checkpoint_step"] == 16
+        assert info.provenance["run_id"] == "train-run"
+        assert info.provenance["eval"]["metric"] == pytest.approx(0.45)
+        assert info.provenance["train_journal"] == "tj.jsonl"
+        assert journal_kinds(pub.journal.path) == ["publish"]
+
+    def test_reject_is_journaled_not_published(self, tmp_path):
+        pub = Publisher(self.policy(tmp_path), str(tmp_path / "svc"),
+                        run_id="r")
+        rec = pub.on_complete(0, result_dict(tmp_path,
+                                             [0.4, 0.4, 0.5, 0.6]))
+        assert rec is None and pub.ledger.rejects == 1
+        assert ModelRepo(str(tmp_path / "repo")).versions("mlp") == []
+        assert journal_kinds(pub.journal.path) == ["publish_reject"]
+
+    def test_torn_publish_is_pending_then_retried(self, tmp_path):
+        pub = Publisher(self.policy(tmp_path), str(tmp_path / "svc"),
+                        run_id="r")
+        plan = FaultPlan([FaultSpec("repo_torn_publish", model="mlp")])
+        with faults.inject(plan):
+            rec = pub.on_complete(0, result_dict(tmp_path,
+                                                 [1.0, 0.8, 0.5, 0.4]))
+        assert rec is None
+        repo = ModelRepo(str(tmp_path / "repo"))
+        assert repo.versions("mlp") == []  # nothing partial visible
+        rec = pub.retry_pending()
+        assert rec is not None and rec["version"] == 1
+        assert journal_kinds(pub.journal.path) == ["publish_torn",
+                                                   "publish"]
+        assert pub.retry_pending() is None
+
+    def test_bundle_from_npz_rebuilds_the_tree(self, tmp_path):
+        src = mlp_bundle(seed=3)
+        flat = {}
+
+        def walk(node, prefix):
+            for k, v in node.items():
+                if isinstance(v, dict):
+                    walk(v, prefix + [k])
+                else:
+                    flat["/".join(prefix + [k])] = np.asarray(v)
+        walk(src.params, [])
+        npz = tmp_path / "params.npz"
+        np.savez(npz, **flat)
+        rebuilt = bundle_from_npz(
+            {"params_npz": str(npz)}, MLP(features=(8,), num_outputs=4),
+            input_spec=(6,), output_names=("features", "logits"))
+        la = jax.tree_util.tree_leaves(src.params)
+        lb = jax.tree_util.tree_leaves(rebuilt.params)
+        assert len(la) == len(lb)
+        assert all(np.array_equal(a, b) for a, b in zip(la, lb))
+
+
+# ------------------------------------------------------------ deployer
+
+class ScriptedTarget:
+    """A rollout target whose observations come off a script — the
+    Deployer's mechanics (stages, journal, repo flips) isolated from
+    any real serve plane."""
+
+    def __init__(self, script=None):
+        self.script = list(script or [])
+        self.calls = []
+
+    def _next(self):
+        return self.script.pop(0) if self.script else {}
+
+    def begin(self, repo, rollout, stage, fraction, tolerance,
+              fast_burn):
+        self.calls.append(("begin", stage, fraction))
+
+    def observe(self, rollout, stage):
+        bits = {"serve": CanarySignal(burn_short=0.0), "action": None,
+                "converged": True, "lagging": (), "healthy": True}
+        bits.update(self._next())
+        return bits
+
+    def promote(self, rollout):
+        self.calls.append(("promote", rollout.version))
+
+    def rollback(self, rollout, reason):
+        self.calls.append(("rollback", rollout.version, reason))
+
+
+class TestDeployer:
+    def deployer(self, tmp_path, target, **kw):
+        kw.setdefault("policy", RolloutPolicy(advance_after=1))
+        return Deployer(str(tmp_path / "lc"), str(tmp_path / "repo"),
+                        target, refs={"train_journal": "tj.jsonl"}, **kw)
+
+    def test_happy_path_promotes_and_flips_current(self, tmp_path):
+        repo = ModelRepo(str(tmp_path / "repo"))
+        repo.publish("mlp", mlp_bundle(seed=0))              # v1 live
+        repo.publish("mlp", mlp_bundle(seed=1),
+                     provenance=good_provenance(),
+                     set_current=False)                      # v2 dark
+        target = ScriptedTarget()
+        dep = self.deployer(tmp_path, target)
+        rollout = dep.start_rollout("mlp", version=2)
+        assert rollout.prior_version == 1
+        outcome = dep.run(rollout, tick_s=0.0, timeout_s=10.0)
+        assert outcome == "promoted"
+        assert repo.current_version("mlp") == 2
+        assert [c[0] for c in target.calls] == ["begin", "begin",
+                                                "promote"]
+        assert journal_kinds(dep.journal.path) == [
+            "rollout", "stage", "stage", "stage", "promote"]
+
+    def test_torn_publish_mid_tick_holds_then_retries(self, tmp_path):
+        repo = ModelRepo(str(tmp_path / "repo"))
+        repo.publish("mlp", mlp_bundle(seed=0))              # v1 live
+        dep = self.deployer(tmp_path, ScriptedTarget())
+        rollout = dep.start_rollout("mlp", bundle=mlp_bundle(seed=1),
+                                    provenance=good_provenance())
+        plan = FaultPlan([FaultSpec("repo_torn_publish", model="mlp")])
+        with faults.inject(plan):
+            out = dep.tick(rollout)
+        # the tear is invisible: no new version, CURRENT untouched,
+        # the rollout holds in the publish stage
+        assert out["action"] == "publish_torn"
+        assert rollout.ledger.stage == "publish"
+        assert rollout.version is None
+        assert repo.versions("mlp") == [1]
+        assert repo.current_version("mlp") == 1
+        # the next tick re-publishes cleanly and the rollout proceeds
+        out = dep.tick(rollout)
+        assert out["action"] == "publish" and out["version"] == 2
+        assert repo.current_version("mlp") == 1  # still dark
+        assert dep.run(rollout, tick_s=0.0, timeout_s=10.0) \
+            == "promoted"
+        assert repo.current_version("mlp") == 2
+
+    def test_burn_aborts_and_rolls_back_both_sides(self, tmp_path):
+        repo = ModelRepo(str(tmp_path / "repo"))
+        repo.publish("mlp", mlp_bundle(seed=0))
+        repo.publish("mlp", mlp_bundle(seed=1), set_current=False)
+        target = ScriptedTarget(script=[
+            {"serve": CanarySignal(burn_short=99.0)}])
+        dep = self.deployer(tmp_path, target)
+        rollout = dep.start_rollout("mlp", version=2)
+        outcome = dep.run(rollout, tick_s=0.0, timeout_s=10.0)
+        assert outcome == "rolled_back"
+        assert repo.current_version("mlp") == 1
+        assert ("rollback", 2) == target.calls[-1][:2]
+        kinds = journal_kinds(dep.journal.path)
+        assert kinds[0] == "rollout" and kinds[-1] == "rollback"
+
+    def test_replay_reconstructs_the_journeys(self, tmp_path):
+        repo = ModelRepo(str(tmp_path / "repo"))
+        repo.publish("mlp", mlp_bundle(seed=0))
+        repo.publish("mlp", mlp_bundle(seed=1), set_current=False)
+        dep = self.deployer(tmp_path, ScriptedTarget())
+        r1 = dep.start_rollout("mlp", version=2)
+        dep.run(r1, tick_s=0.0, timeout_s=10.0)
+        dep2 = self.deployer(
+            tmp_path, ScriptedTarget(
+                script=[{"serve": CanarySignal(burn_short=99.0)}]))
+        r2 = dep2.start_rollout("mlp", bundle=mlp_bundle(seed=2))
+        dep2.run(r2, tick_s=0.0, timeout_s=10.0)
+        replayed = replay_decisions(dep.journal.path)
+        assert [r["outcome"] for r in replayed] == ["promoted",
+                                                    "rolled_back"]
+        assert replayed[0]["version"] == 2
+        assert replayed[0]["stages"] == ["shadow", "canary",
+                                         "promoting"]
+        assert replayed[1]["version"] == 3  # filled by its publish
+        assert replayed[1]["prior_version"] == 2
+
+    def test_admission_needs_exactly_one_source(self, tmp_path):
+        dep = self.deployer(tmp_path, ScriptedTarget())
+        with pytest.raises(ValueError):
+            dep.start_rollout("mlp")
+        with pytest.raises(ValueError):
+            dep.start_rollout("mlp", version=1,
+                              bundle=mlp_bundle())
+
+
+# -------------------------------------------------------- fleet target
+
+def write_beacon(d, bid, versions, status="running", burn=0.0):
+    from mmlspark_tpu.service.core import atomic_write_json
+    atomic_write_json(os.path.join(d, f"beacon_{bid}.json"), {
+        "rank": bid, "status": status, "host": "127.0.0.1",
+        "port": 9000 + bid, "burn_short": burn, "versions": versions})
+
+
+class TestFleetTarget:
+    def test_canary_scope_then_fleet_wide_promotion(self, tmp_path):
+        from mmlspark_tpu.lifecycle import Rollout
+        d = str(tmp_path)
+        write_beacon(d, 0, {"mlp": 1})
+        write_beacon(d, 1, {"mlp": 1})
+        target = FleetTarget(d, "/repo", canary_backends=1)
+        rollout = Rollout(model="mlp", version=2, prior_version=1)
+        target.begin(None, rollout, "canary", 0.5, None, 14.0)
+        with open(os.path.join(d, "deploy.json")) as f:
+            cmd = json.load(f)
+        assert cmd == {"seq": 1, "model": "mlp", "version": 2,
+                       "repo": "/repo", "backends": [0]}
+        # scoped backend still on v1 → lagging, no canary evidence
+        bits = target.observe(rollout, "canary")
+        assert bits["lagging"] == (0,) and bits["serve"] is None
+        # it applies the swap → converged, burn evidence flows
+        write_beacon(d, 0, {"mlp": 2}, burn=0.5)
+        bits = target.observe(rollout, "canary")
+        assert bits["converged"] and bits["lagging"] == ()
+        assert bits["serve"].burn_short == 0.5
+        # promotion re-targets the whole fleet and blocks on backend 1
+        target.promote(rollout)
+        with open(os.path.join(d, "deploy.json")) as f:
+            assert json.load(f)["backends"] == "all"
+        bits = target.observe(rollout, "promoting")
+        assert bits["lagging"] == (1,) and not bits["converged"]
+        write_beacon(d, 1, {"mlp": 2})
+        assert target.observe(rollout, "promoting")["converged"]
+
+    def test_rollback_recommands_the_prior_version(self, tmp_path):
+        from mmlspark_tpu.lifecycle import Rollout
+        d = str(tmp_path)
+        write_beacon(d, 0, {"mlp": 2})
+        target = FleetTarget(d, "/repo")
+        rollout = Rollout(model="mlp", version=2, prior_version=1)
+        target.begin(None, rollout, "canary", 0.5, None, 14.0)
+        target.rollback(rollout, "burn")
+        with open(os.path.join(d, "deploy.json")) as f:
+            cmd = json.load(f)
+        assert cmd["version"] == 1 and cmd["backends"] == "all"
+        assert cmd["seq"] == 2  # monotonic across commands
+
+    def test_dead_backend_reads_unhealthy(self, tmp_path):
+        from mmlspark_tpu.lifecycle import Rollout
+        d = str(tmp_path)
+        write_beacon(d, 0, {"mlp": 1})
+        target = FleetTarget(d, "/repo")
+        rollout = Rollout(model="mlp", version=2, prior_version=1)
+        target.begin(None, rollout, "canary", 0.5, None, 14.0)
+        write_beacon(d, 0, {"mlp": 2}, status="exited")
+        bits = target.observe(rollout, "canary")
+        assert not bits["healthy"] and not bits["converged"]

@@ -19,7 +19,7 @@ from perf_smoke import (  # noqa: E402
     check_serve_fleet, check_serve_generate, check_serve_lifecycle,
     check_serve_lowprec, check_serve_sharded,
     check_spmd_clean, check_train_device_preprocess, check_train_elastic,
-    check_train_prefetch,
+    check_train_prefetch, check_train_to_serve,
 )
 
 
@@ -244,6 +244,29 @@ def test_serve_lifecycle_survives_seeded_chaos():
     assert "rollback" in canary["decision_kinds"]
     assert "swap" in canary["decision_kinds"]
     assert "lane_restart" in canary["decision_kinds"]
+
+
+def test_train_to_serve_deploys_gated_checkpoints_end_to_end():
+    """Continuous deployment (round 20): a supervised fine-tune's
+    eval-gated checkpoint is dark-published with provenance and driven
+    by the deployer through shadow -> canary -> promoted under live
+    traffic (repo CURRENT flipped, every answer bit-identical to a
+    published version's offline transform, zero drops); a degraded run
+    dark-publishes but rolls back on shadow parity drift with CURRENT
+    pinned to the good version; the journey journals across train +
+    serve + lifecycle decisions, replays from the lifecycle journal
+    alone, and stitches >= 1 flow at the publish-fence seam."""
+    result = check_train_to_serve()
+    assert result["outcomes"] == ["promoted", "rolled_back"]
+    assert result["versions"] == [1, 2, 3]
+    assert result["current"] == 2  # promoted v2; v3 rolled back
+    assert result["dropped"] == 0 and result["responses"] > 0
+    assert result["rollouts"] == 2 and result["rollbacks"] == 1
+    assert result["deploy_wall_s"] > 0
+    assert result["provenance_v2"]["checkpoint_step"] == 16
+    assert result["stitched_flows"] >= 1
+    for kind in ("publish", "rollout", "stage", "promote", "rollback"):
+        assert kind in result["lifecycle_kinds"]
 
 
 def test_serve_generate_streams_bit_identical_and_batches():

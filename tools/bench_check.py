@@ -137,6 +137,21 @@ def check_line(current: dict, priors: list[tuple[int, dict]],
         report["checked"].append(row)
         if warm > cold:
             report["regressions"].append(row)
+    # same within-line discipline for the lifecycle deployer's
+    # checkpoint→serving wall: the warm rollout rides the compile cache
+    # the cold rollout populated, minutes apart on the same box
+    d_cold = current.get("deploy_wall_cold_s")
+    d_warm = current.get("deploy_wall_warm_s")
+    if isinstance(d_cold, (int, float)) and not isinstance(d_cold, bool) \
+            and isinstance(d_warm, (int, float)) \
+            and not isinstance(d_warm, bool):
+        row = {"key": "deploy_wall_warm_s", "class": "within-line",
+               "current": d_warm, "best": d_cold, "best_round": None,
+               "ratio": round(d_warm / d_cold, 4) if d_cold else None,
+               "band": "<= deploy_wall_cold_s (same line)"}
+        report["checked"].append(row)
+        if d_warm > d_cold:
+            report["regressions"].append(row)
     if not priors:
         report["verdict"] = ("regressed" if report["regressions"]
                              else "no-priors")

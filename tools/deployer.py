@@ -1,0 +1,136 @@
+"""deployer — drive a published model version through the rollout
+lifecycle against a running serve fleet.
+
+The CLI front-end of ``mmlspark_tpu/lifecycle`` (docs/lifecycle.md):
+``rollout`` admits one repo version into the
+``published → shadow → canary → promoted`` state machine and ticks the
+:class:`Deployer` until it terminates — canary backends hot-swap first,
+promotion blocks until every backend's beacon reports the new version,
+and parity drift / fast burn / a stuck stage rolls back BOTH repo-side
+(``CURRENT`` repointed) and serve-side. Every transition lands in
+``<dir>/decisions.jsonl``; ``replay`` reconstructs the trajectories
+from that journal alone.
+
+Usage::
+
+    # roll the newest published version of "mlp" out over the fleet
+    # running in ./fleet (tools/serve_fleet.py --dir ./fleet --repo R)
+    python tools/deployer.py rollout --repo ./repo --fleet-dir ./fleet \\
+        --model mlp
+
+    # pin an explicit version, widen the canary, slow the ramp
+    python tools/deployer.py rollout --repo ./repo --fleet-dir ./fleet \\
+        --model mlp --version 3 --canary-backends 2 --advance-after 4
+
+    # forensic view: every rollout's journey from the journal
+    python tools/deployer.py replay --journal ./fleet/lifecycle/decisions.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Sequence
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def rollout_main(argv: Sequence[str]) -> int:
+    ap = argparse.ArgumentParser(prog="deployer rollout")
+    ap.add_argument("--repo", required=True,
+                    help="versioned model repo root (models/repo.py)")
+    ap.add_argument("--fleet-dir", required=True,
+                    help="the fleet run dir (tools/serve_fleet.py --dir)"
+                         ": beacons in, deploy.json commands out")
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--version", type=int, default=None,
+                    help="version to roll out (default: newest "
+                         "published)")
+    ap.add_argument("--dir", dest="lifecycle_dir", default=None,
+                    help="lifecycle journal dir (default: "
+                         "<fleet-dir>/lifecycle)")
+    ap.add_argument("--canary-backends", type=int, default=1,
+                    help="backends the ramp stages target before "
+                         "fleet-wide promotion")
+    ap.add_argument("--advance-after", type=int, default=2,
+                    help="consecutive clean ticks per stage before "
+                         "advancing")
+    ap.add_argument("--fast-burn", type=float, default=14.0,
+                    help="SLO fast-burn multiple that aborts the "
+                         "rollout")
+    ap.add_argument("--max-stage-ticks", type=int, default=240,
+                    help="ticks a stage may hold before the rollout "
+                         "aborts (a stuck deploy is a failed deploy)")
+    ap.add_argument("--tick-s", type=float, default=0.25)
+    ap.add_argument("--timeout-s", type=float, default=120.0)
+    args = ap.parse_args(list(argv))
+
+    from mmlspark_tpu.lifecycle import (
+        Deployer, FleetTarget, RolloutPolicy,
+    )
+    from mmlspark_tpu.models.repo import ModelRepo, ModelRepoError
+
+    repo = ModelRepo(args.repo)
+    try:
+        versions = repo.versions(args.model)
+        if not versions:
+            print(f"model {args.model!r}: nothing published in "
+                  f"{args.repo}", file=sys.stderr)
+            return 2
+        version = args.version if args.version is not None \
+            else versions[-1]
+        deployer = Deployer(
+            args.lifecycle_dir
+            or os.path.join(args.fleet_dir, "lifecycle"),
+            repo,
+            FleetTarget(args.fleet_dir, args.repo,
+                        canary_backends=args.canary_backends),
+            policy=RolloutPolicy(
+                advance_after=args.advance_after,
+                fast_burn=args.fast_burn,
+                max_stage_ticks=args.max_stage_ticks),
+            refs={"serve_journal": os.path.join(args.fleet_dir,
+                                                "decisions.jsonl")})
+        rollout = deployer.start_rollout(args.model, version=version)
+        outcome = deployer.run(rollout, tick_s=args.tick_s,
+                               timeout_s=args.timeout_s)
+    except ModelRepoError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    print(json.dumps({
+        "model": args.model, "version": rollout.version,
+        "prior_version": rollout.prior_version, "outcome": outcome,
+        "ticks": rollout.ledger.ticks,
+        "journal": deployer.journal.path,
+    }, indent=2))
+    return 0 if outcome == "promoted" else 1
+
+
+def replay_main(argv: Sequence[str]) -> int:
+    ap = argparse.ArgumentParser(prog="deployer replay")
+    ap.add_argument("--journal", required=True,
+                    help="a lifecycle decisions.jsonl")
+    args = ap.parse_args(list(argv))
+    from mmlspark_tpu.lifecycle import replay_decisions
+    try:
+        print(json.dumps(replay_decisions(args.journal), indent=2))
+    except OSError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    return 0
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "rollout":
+        return rollout_main(argv[1:])
+    if argv and argv[0] == "replay":
+        return replay_main(argv[1:])
+    print(__doc__, file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

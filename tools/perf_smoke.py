@@ -2675,6 +2675,310 @@ def check_serve_fleet() -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def check_train_to_serve() -> dict:
+    """Continuous deployment, checkpoint to fleet-wide promotion
+    (mmlspark_tpu/lifecycle, docs/lifecycle.md): a supervised fine-tune
+    must end with its eval-gated checkpoint SERVING through the
+    deployer — dark-published with provenance, ramped shadow → canary
+    under live traffic, promoted with the repo ``CURRENT`` flipped, and
+    every served answer bit-identical to SOME published version's
+    offline transform with ZERO dropped requests. A degraded run (same
+    workload, shifted data) must dark-publish but ROLL BACK in shadow on
+    parity drift — repo CURRENT and the serving plane both back on the
+    good version. The whole journey is journaled across train + serve +
+    lifecycle decisions with cross-references both ways, replays from
+    the lifecycle journal alone, lands the ``lifecycle.rollouts`` /
+    ``lifecycle.rollbacks`` counters and the ``deploy.wall_s`` gauge,
+    and stitches >= 1 cross-process fleet-timeline flow at the
+    train→deployment publish-fence seam."""
+    import shutil
+    import tempfile
+    import threading
+
+    import jax
+
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.data.table import DataTable
+    from mmlspark_tpu.lifecycle import (
+        Deployer, EvalGate, PublishPolicy, RolloutPolicy, ServerTarget,
+        bundle_from_npz, replay_decisions,
+    )
+    from mmlspark_tpu.models.bundle import ModelBundle
+    from mmlspark_tpu.models.jax_model import JaxModel
+    from mmlspark_tpu.models.repo import ModelRepo
+    from mmlspark_tpu.models.zoo import MLP
+    from mmlspark_tpu.obs import fleet as obs_fleet
+    from mmlspark_tpu.obs.metrics import registry
+    from mmlspark_tpu.serve import (
+        Client, ModelServer, ServeConfig, THREAD_PREFIX,
+    )
+    from mmlspark_tpu.train.service import (
+        RecoveryPolicy, ServiceConfig, Topology, TrainSupervisor,
+    )
+
+    repo_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    workdir = tempfile.mkdtemp(prefix="train_to_serve_")
+    repo_root = os.path.join(workdir, "repo")
+    lifecycle_dir = os.path.join(workdir, "lifecycle")
+    serve_dir = os.path.join(workdir, "serve")
+    fleet_dir = os.path.join(workdir, "fleetobs")
+    d_in, n_rows = 8, 24  # the selftest worker's XOR input width
+    module = MLP(features=(16,), num_outputs=2)  # its architecture
+
+    def train_run(tag: str, extra_env: dict) -> object:
+        """One supervised fine-tune whose clean completion feeds the
+        eval gate; a pass dark-publishes the result params as a new
+        repo version with provenance."""
+        sup = TrainSupervisor(ServiceConfig(
+            cmd=(sys.executable,
+                 os.path.join(repo_dir, "tools", "train_service.py"),
+                 "worker"),
+            service_dir=os.path.join(workdir, f"svc_{tag}"),
+            checkpoint_dir=os.path.join(workdir, f"ckpt_{tag}"),
+            topologies=(Topology(world=1, devices=4),),
+            policy=RecoveryPolicy(max_restarts=0),
+            extra_env=extra_env,
+            publish=PublishPolicy(
+                model="xor", repo_root=repo_root,
+                gate=EvalGate(min_points=4, tail=4),
+                bundle_from_result=lambda r: bundle_from_npz(
+                    r, module, (d_in,)),
+                notes=f"fine-tune {tag}",
+                lifecycle_dir=lifecycle_dir)))
+        report = sup.run()
+        assert report.ok, f"train run {tag} failed: {report.reason}"
+        return sup
+
+    def tbl(sl):
+        return DataTable({"input": list(sl)})
+
+    def sc(out):
+        return np.stack([np.asarray(v) for v in out["scores"]])
+
+    rows = np.random.default_rng(0).normal(
+        size=(n_rows, d_in)).astype(np.float32)
+
+    # bit-identity discipline: every request is exactly the largest
+    # bucket (8 rows — no padding, no coalescing with foreign rows),
+    # and the offline references are computed in the SAME 8-row chunks,
+    # so served and offline answers run the identical program shape —
+    # on the multi-device CPU mesh XLA's partitioning is shape-
+    # dependent, so a (24, d) offline batch vs a bucket-padded (4, d)
+    # serve batch differ by 1 ULP and would mask real corruption checks
+    req = 8
+    assert n_rows % req == 0
+    req_offsets = tuple(range(0, n_rows, req))
+
+    def offline(version):
+        jm = JaxModel(model=repo.load("xor", version)[0],
+                      input_col="input", output_col="scores")
+        return np.concatenate([sc(jm.transform(tbl(rows[o:o + req])))
+                               for o in req_offsets])
+
+    obs.enable()
+    obs.clear()
+    registry().reset()
+    obs_fleet.enable(fleet_dir, interval_s=0.2)
+    server = None
+    try:
+        # -- v1: the pre-trained baseline in production ---------------
+        repo = ModelRepo(repo_root)
+        params = module.init(jax.random.PRNGKey(0),
+                             np.zeros((1, d_in), np.float32))["params"]
+        v1 = repo.publish("xor", ModelBundle(
+            module=module,
+            params=jax.tree_util.tree_map(np.asarray, params),
+            input_spec=(d_in,), output_names=("logits",), name="xor"))
+        assert repo.current_version("xor") == v1
+
+        server = ModelServer(ServeConfig(
+            buckets=(1, 4, 8), max_queue=512, deadline_ms=None,
+            lifecycle_dir=serve_dir,
+            slo={"objective": 0.99, "min_requests": 4,
+                 "window_s": 30.0, "long_window_s": 60.0}))
+        server.add_model_from_repo(repo, "xor", example=tbl(rows[:1]))
+        off = {v1: offline(v1)}
+
+        # -- run 1: healthy fine-tune → dark v2 with provenance -------
+        sup1 = train_run("good", {})
+        v2 = v1 + 1
+        assert repo.versions("xor") == [v1, v2], (
+            f"healthy run did not dark-publish: {repo.versions('xor')}")
+        assert repo.current_version("xor") == v1, (
+            "dark publish moved CURRENT — promotion is the deployer's "
+            "decision")
+        _, info2 = repo.load("xor", v2)
+        assert info2.provenance is not None
+        assert info2.provenance["checkpoint_step"] == 16
+        assert info2.provenance["run_id"].startswith("train-")
+        assert len(info2.provenance["eval"]["series_tail"]) > 0
+        off[v2] = offline(v2)
+        assert not np.array_equal(off[v1], off[v2])
+
+        # -- live traffic across both rollouts ------------------------
+        stop_traffic = threading.Event()
+        answers, errors = [], []
+        lock = threading.Lock()
+
+        def pump(k):
+            client = Client(server, retry=True)
+            try:
+                i = 0
+                while not stop_traffic.is_set():
+                    o = req_offsets[(k + i) % len(req_offsets)]
+                    got = client.predict("xor", tbl(rows[o:o + req]),
+                                         timeout=60)
+                    with lock:
+                        answers.append((o, sc(got)))
+                    i += 1
+            except BaseException as e:  # noqa: BLE001 — reported
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=pump, args=(k,))
+                   for k in range(2)]
+        for t in threads:
+            t.start()
+
+        # -- rollout 1: v2 shadow → canary → promoted -----------------
+        dep1 = Deployer(
+            lifecycle_dir, repo,
+            ServerTarget(server, "xor", example=tbl(rows[:1])),
+            policy=RolloutPolicy(advance_after=2),
+            refs={"train_journal": os.path.join(workdir, "svc_good",
+                                                "decisions.jsonl"),
+                  "serve_journal": os.path.join(serve_dir,
+                                                "decisions.jsonl")})
+        r1 = dep1.start_rollout("xor", version=v2)
+        outcome1 = dep1.run(r1, tick_s=0.05, timeout_s=90.0)
+        assert outcome1 == "promoted", (
+            f"healthy rollout ended {outcome1!r} "
+            f"(stage {r1.ledger.stage})")
+        assert repo.current_version("xor") == v2, (
+            "promotion did not flip the repo CURRENT pointer")
+        snap = server.snapshot()["xor"]
+        assert snap["version"] == v2, f"serving {snap.get('version')}"
+
+        # -- run 2: degraded fine-tune (shifted data) → dark v3 -------
+        sup2 = train_run(
+            "degraded",
+            {"MMLSPARK_TPU_SERVICE_SELFTEST_DATA_SEED": "3"})
+        v3 = v2 + 1
+        assert repo.versions("xor") == [v1, v2, v3]
+        assert repo.current_version("xor") == v2
+        off[v3] = offline(v3)
+
+        # -- rollout 2: v3 drifts in shadow → rolled back -------------
+        dep2 = Deployer(
+            lifecycle_dir, repo,
+            ServerTarget(server, "xor", example=tbl(rows[:1])),
+            policy=RolloutPolicy(advance_after=2,
+                                 parity_tolerance=1e-6),
+            refs={"train_journal": os.path.join(workdir, "svc_degraded",
+                                                "decisions.jsonl"),
+                  "serve_journal": os.path.join(serve_dir,
+                                                "decisions.jsonl")})
+        r2 = dep2.start_rollout("xor", version=v3)
+        outcome2 = dep2.run(r2, tick_s=0.05, timeout_s=90.0)
+        assert outcome2 == "rolled_back", (
+            f"degraded rollout ended {outcome2!r} — parity drift in "
+            "shadow must roll back")
+        assert repo.current_version("xor") == v2, (
+            "rollback did not pin the repo CURRENT back to the good "
+            "version")
+        assert server.canary_status("xor") is None
+
+        stop_traffic.set()
+        for t in threads:
+            t.join()
+
+        # -- zero drops; every answer is SOME version's exact output --
+        assert errors == [], f"requests dropped across the rollouts: " \
+                             f"{errors}"
+        assert len(answers) > 0
+        unmatched = 0
+        for o, got in answers:
+            if not any(np.array_equal(got, off[v][o:o + req])
+                       for v in off):
+                unmatched += 1
+        assert unmatched == 0, (
+            f"{unmatched}/{len(answers)} answers match NO published "
+            "version's offline transform bit-for-bit")
+        post = sc(server.predict("xor", tbl(rows[:req])))
+        assert np.array_equal(post, off[v2][:req]), (
+            "post-rollback serving is not on the good version")
+
+        # -- one journey, one trace -----------------------------------
+        lc_path = os.path.join(lifecycle_dir, "decisions.jsonl")
+        with open(lc_path, encoding="utf-8") as f:
+            lc_recs = [json.loads(ln) for ln in f if ln.strip()]
+        lc_kinds = [r["kind"] for r in lc_recs]
+        for expected in ("publish", "rollout", "stage", "promote",
+                         "rollback"):
+            assert expected in lc_kinds, f"{expected!r} not journaled"
+        ro_recs = [r for r in lc_recs if r["kind"] == "rollout"]
+        assert all("train_journal" in r and "serve_journal" in r
+                   for r in ro_recs), "rollouts missing journal refs"
+        for tag in ("good", "degraded"):
+            tj = os.path.join(workdir, f"svc_{tag}", "decisions.jsonl")
+            with open(tj, encoding="utf-8") as f:
+                t_recs = [json.loads(ln) for ln in f if ln.strip()]
+            pubs = [r for r in t_recs if r["kind"] == "publish"]
+            assert pubs and pubs[0]["lifecycle_journal"] == lc_path, (
+                f"train run {tag} does not cross-reference the "
+                "lifecycle journal")
+        journeys = replay_decisions(lc_path)
+        assert [j["outcome"] for j in journeys] == ["promoted",
+                                                    "rolled_back"]
+        assert journeys[0]["version"] == v2
+        assert journeys[0]["stages"] == ["shadow", "canary",
+                                         "promoting"]
+        assert journeys[1]["version"] == v3
+        assert journeys[1]["prior_version"] == v2
+
+        # -- obs: counters, the deploy gauge, the stitched fence ------
+        assert registry().value("lifecycle.rollouts") == 2
+        assert registry().value("lifecycle.rollbacks") == 1
+        wall = registry().value("deploy.wall_s", model="xor")
+        assert wall is not None and wall > 0
+        server.close()
+        server = None
+        obs_fleet.disable()  # final snapshot (this process's fences)
+        view = obs_fleet.FleetCollector(fleet_dir).collect()
+        meta = view.chrome_trace()["fleetMeta"]
+        assert meta["stitched_flows"] >= 1, (
+            "no cross-process flow stitched at the "
+            "lifecycle/publish_fence seam (worker result write vs "
+            "supervisor gate+publish)")
+        return {
+            "versions": repo.versions("xor"),
+            "current": repo.current_version("xor"),
+            "outcomes": [outcome1, outcome2],
+            "provenance_v2": {
+                "checkpoint_step": info2.provenance["checkpoint_step"],
+                "eval_points": info2.provenance["eval"]["points"]},
+            "responses": len(answers),
+            "dropped": len(errors),
+            "deploy_wall_s": wall,
+            "rollouts": int(registry().value("lifecycle.rollouts")),
+            "rollbacks": int(registry().value("lifecycle.rollbacks")),
+            "stitched_flows": meta["stitched_flows"],
+            "lifecycle_kinds": sorted(set(lc_kinds)),
+        }
+    finally:
+        if server is not None:
+            server.close()
+        obs_fleet.disable()
+        obs.disable()
+        obs.clear()
+        registry().reset()
+        leaked = [t.name for t in threading.enumerate()
+                  if t.name.startswith(THREAD_PREFIX)
+                  or t.name in ("FleetExporter", "TimeSeriesSampler")]
+        assert leaked == [], f"threads leaked: {leaked}"
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def _timed_once(pm, table, time_mod) -> float:
     t0 = time_mod.perf_counter()
     pm.transform(table)
@@ -2703,6 +3007,7 @@ def main() -> int:
         obs_tracing = check_obs_request_tracing()
         fleet_obs = check_fleet_obs()
         serve_fleet = check_serve_fleet()
+        train_to_serve = check_train_to_serve()
         flight_rec = check_flight_recorder()
         spmd = check_spmd_clean()
         concurrency = check_concurrency_clean()
@@ -2723,6 +3028,7 @@ def main() -> int:
                       "obs_request_tracing": obs_tracing,
                       "fleet_obs": fleet_obs,
                       "serve_fleet": serve_fleet,
+                      "train_to_serve": train_to_serve,
                       "flight_recorder": flight_rec, "spmd": spmd,
                       "concurrency": concurrency}))
     return 0

@@ -60,9 +60,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _load_versioned_repo(path: str, name: str | None
-                         ) -> list[tuple[str, object, int]]:
-    """[(serve name, model, version), ...] from a VERSIONED model repo
-    (models/repo.py layout): every model's CURRENT version, digest-
+                         ) -> list[tuple[str, object, object]]:
+    """[(serve name, model, ModelVersion), ...] from a VERSIONED model
+    repo (models/repo.py layout): every model's CURRENT version, digest-
     verified before deserialization — a torn or corrupt version is a
     typed refusal at startup, never a silently-wrong served model."""
     from mmlspark_tpu.models.repo import ModelRepo
@@ -73,7 +73,7 @@ def _load_versioned_repo(path: str, name: str | None
     out = []
     for n in names:
         model, info = repo.load(n)
-        out.append((n, model, info.version))
+        out.append((n, model, info))
     return out
 
 
@@ -241,6 +241,7 @@ def main(argv: list[str] | None = None) -> int:
         return 2
     server = ModelServer(config)
     versions = None
+    provenance = None
     try:
         if args.repo:
             from mmlspark_tpu.models.repo import ModelRepoError
@@ -250,10 +251,22 @@ def main(argv: list[str] | None = None) -> int:
                 print(str(e), file=sys.stderr)
                 return 2
             versions = {}
-            for model_name, model, version in loaded:
+            provenance = {}
+            for model_name, model, info in loaded:
                 server.add_model(model_name, model, schema=schema,
-                                 version=version)
-                versions[model_name] = version
+                                 version=info.version)
+                versions[model_name] = info.version
+                if info.provenance is not None:
+                    # the lifecycle Publisher's stamp: which checkpoint
+                    # step, which eval tail, which train run published
+                    # the version this process is about to serve
+                    provenance[model_name] = info.provenance
+                    print(f"serving {model_name} v{info.version} "
+                          f"(checkpoint step "
+                          f"{info.provenance.get('checkpoint_step')}, "
+                          f"run {info.provenance.get('run_id')}, "
+                          f"eval {info.provenance.get('eval')})",
+                          file=sys.stderr)
         else:
             for model_name, model in _load_models(args.model, args.name):
                 server.add_model(model_name, model, schema=schema)
@@ -266,6 +279,7 @@ def main(argv: list[str] | None = None) -> int:
     print(json.dumps({
         "serving": server.models(),
         "versions": versions,
+        "provenance": provenance,
         "host": httpd.server_address[0],
         "port": httpd.server_address[1],
         "buckets": list(config.buckets),

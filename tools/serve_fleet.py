@@ -20,6 +20,11 @@ Usage::
     # run AS one backend worker (what the supervisor launches)
     python tools/serve_fleet.py worker
 
+    # point-in-time fleet status from the run dir's beacons: per-
+    # backend state, port, served {model: version} map, deploy seq —
+    # the rollout-convergence view the lifecycle deployer reads
+    python tools/serve_fleet.py status --dir ./fleet
+
 Every supervisor decision (spawn/restart/scale_up/scale_down/...)
 lands in ``<dir>/decisions.jsonl``; with ``MMLSPARK_TPU_OBS=1`` the
 same decisions are obs ``fleet/*`` events + ``serve.fleet.*``
@@ -41,11 +46,63 @@ from typing import Sequence
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def status_main(argv: Sequence[str]) -> int:
+    """``serve_fleet status --dir D``: print one JSON fleet view from
+    the run directory's beacon files (works with no live connection to
+    the supervisor — beacons are the same sensor channel it reads).
+    Includes the per-backend served ``{model: version}`` map and the
+    condensed per-model rollout convergence."""
+    ap = argparse.ArgumentParser(prog="serve_fleet status")
+    ap.add_argument("--dir", required=True, dest="service_dir")
+    args = ap.parse_args(list(argv))
+
+    import re
+    beacon_re = re.compile(r"^beacon_(\d+)\.json$")
+    rows = []
+    try:
+        names = sorted(os.listdir(args.service_dir))
+    except OSError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    for fname in names:
+        m = beacon_re.match(fname)
+        if not m:
+            continue
+        try:
+            with open(os.path.join(args.service_dir, fname),
+                      encoding="utf-8") as f:
+                b = json.load(f)
+        except (OSError, ValueError):
+            continue
+        row = {"bid": int(m.group(1))}
+        for key in ("status", "generation", "host", "port",
+                    "burn_short", "versions", "deploy_seq",
+                    "deploy_error"):
+            if key in b:
+                row[key] = b[key]
+        rows.append(row)
+    by_model: dict = {}
+    for row in rows:
+        if row.get("status") != "running":
+            continue
+        for model, version in (row.get("versions") or {}).items():
+            by_model.setdefault(model, set()).add(version)
+    print(json.dumps({
+        "backends": rows,
+        "rollout": {model: {"converged": len(vs) == 1,
+                            "versions": sorted(vs)}
+                    for model, vs in sorted(by_model.items())},
+    }, indent=2))
+    return 0
+
+
 def main(argv: Sequence[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "worker":
         from mmlspark_tpu.serve.fleet.worker import run_backend_worker
         return run_backend_worker()
+    if argv and argv[0] == "status":
+        return status_main(argv[1:])
 
     ap = argparse.ArgumentParser(
         prog="serve_fleet",
@@ -61,6 +118,11 @@ def main(argv: Sequence[str] | None = None) -> int:
     ap.add_argument("--compile-cache", default=None,
                     help="shared AOT compile cache dir — restarts and "
                          "scale-ups warm from it (zero fresh compiles)")
+    ap.add_argument("--repo", default=None, metavar="DIR",
+                    help="versioned model repo root (models/repo.py): "
+                         "backends serve every model's CURRENT version "
+                         "and accept the lifecycle deployer's versioned "
+                         "hot-swap commands (docs/lifecycle.md)")
     ap.add_argument("--max-restarts", type=int, default=2,
                     help="per-backend restart budget")
     ap.add_argument("--min-backends", type=int, default=1)
@@ -109,6 +171,7 @@ def main(argv: Sequence[str] | None = None) -> int:
                           max_backends=args.max_backends,
                           cooldown_s=args.cooldown),
         compile_cache=args.compile_cache,
+        repo=args.repo,
         slo=json.loads(args.slo) if args.slo else None), pool=pool)
     router = FleetRouter(pool, host=args.host, port=args.port)
     sup.start()
